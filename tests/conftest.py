@@ -1,0 +1,26 @@
+"""Test session config.
+
+Tests must be hermetic and never require (or occupy) real TPU hardware: force
+JAX onto a virtual 8-device CPU mesh so sharding / collective tests exercise
+real multi-device paths on any machine.
+
+Two quirks of the dev image are handled explicitly:
+
+* a ``sitecustomize`` registers the TPU PJRT plugin at interpreter start and
+  force-sets ``jax_platforms`` — env vars alone don't win, so the config API
+  is used after import;
+* probe-subprocess tests spawn fresh interpreters, which would re-register the
+  TPU plugin; dropping the trigger env var keeps the children on the CPU mesh.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # children: no TPU plugin registration
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

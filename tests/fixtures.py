@@ -1,0 +1,146 @@
+"""Canned raw-k8s node fixtures for the five BASELINE.json measurement configs.
+
+The reference ships no tests (SURVEY §4); these fixtures implement its implied
+"multi-node without a real cluster" strategy: plain dicts shaped like
+``GET /api/v1/nodes`` items, one builder per scenario.
+
+Configs (BASELINE.json):
+  1. CPU-only cluster                      → exit 2
+  2. GKE GPU pool (nvidia.com/gpu=1)       → GPU regression path
+  3. TPU v5e-8 single-host                 → google.com/tpu + topology labels
+  4. TPU v5p-64 16-host slice              → taints + per-host breakdown
+  5. Mixed GPU+TPU, one NotReady TPU host  → exit 3 semantics with --slack-only-on-error
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def make_node(
+    name: str,
+    ready: bool = True,
+    allocatable: Optional[dict] = None,
+    capacity: Optional[dict] = None,
+    labels: Optional[dict] = None,
+    taints: Optional[list] = None,
+    conditions: Optional[list] = None,
+) -> dict:
+    """One raw node dict, shaped like a k8s REST ``V1Node`` serialization."""
+    alloc = {"cpu": "8", "memory": "32Gi", "pods": "110"}
+    if allocatable:
+        alloc.update(allocatable)
+    cap = dict(capacity) if capacity is not None else dict(alloc)
+    if conditions is None:
+        conditions = [
+            {"type": "MemoryPressure", "status": "False"},
+            {"type": "Ready", "status": "True" if ready else "False"},
+        ]
+    node = {
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {},
+        "status": {"allocatable": alloc, "capacity": cap, "conditions": conditions},
+    }
+    if taints:
+        node["spec"]["taints"] = taints
+    return node
+
+
+def cpu_only_cluster(n: int = 3) -> List[dict]:
+    """Config 1: kind/minikube-style CPU cluster — zero accelerator nodes."""
+    return [make_node(f"kind-worker-{i}") for i in range(n)]
+
+
+def gpu_pool(n: int = 2, ready: bool = True) -> List[dict]:
+    """Config 2: GKE GPU node pool, nvidia.com/gpu=1 per node."""
+    return [
+        make_node(
+            f"gke-gpu-pool-{i}",
+            ready=ready,
+            allocatable={"nvidia.com/gpu": "1"},
+            labels={"cloud.google.com/gke-nodepool": "gpu-pool"},
+            taints=[{"key": "nvidia.com/gpu", "value": "present", "effect": "NoSchedule"}],
+        )
+        for i in range(n)
+    ]
+
+
+TPU_TAINT = {"key": "google.com/tpu", "value": "present", "effect": "NoSchedule"}
+
+
+def tpu_v5e_single_host() -> List[dict]:
+    """Config 3: one v5e host with 8 chips (ct5lp-hightpu-8t, topology 2x4)."""
+    return [
+        make_node(
+            "gke-tpu-v5e-0",
+            allocatable={"google.com/tpu": "8"},
+            labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "2x4",
+                "cloud.google.com/gke-nodepool": "v5e-pool",
+            },
+            taints=[TPU_TAINT],
+        )
+    ]
+
+
+def tpu_v5p_64_slice(not_ready: int = 0) -> List[dict]:
+    """Config 4: v5p-64 — 64 chips over 16 hosts (4 chips/host, topology 4x4x4)."""
+    return [
+        make_node(
+            f"gke-tpu-v5p-{i}",
+            ready=i >= not_ready,
+            allocatable={"google.com/tpu": "4"},
+            labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+                "cloud.google.com/gke-tpu-topology": "4x4x4",
+                "cloud.google.com/gke-nodepool": "v5p-pool",
+            },
+            taints=[TPU_TAINT],
+        )
+        for i in range(16)
+    ]
+
+
+def tpu_v5e_256_slice(not_ready: int = 0) -> List[dict]:
+    """North-star scale: v5e-256 — 256 chips over 64 hosts (4/host, 16x16)."""
+    return [
+        make_node(
+            f"gke-tpu-v5e256-{i:03d}",
+            ready=i >= not_ready,
+            allocatable={"google.com/tpu": "4"},
+            labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "16x16",
+                "cloud.google.com/gke-nodepool": "v5e-256-pool",
+            },
+            taints=[TPU_TAINT],
+        )
+        for i in range(64)
+    ]
+
+
+def mixed_cluster_one_notready() -> List[dict]:
+    """Config 5: GPU pool + v5e slice where one TPU host is NotReady."""
+    nodes = gpu_pool(2)
+    nodes += [
+        make_node(
+            f"gke-tpu-mixed-{i}",
+            ready=(i != 1),
+            allocatable={"google.com/tpu": "4"},
+            labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "2x4",
+                "cloud.google.com/gke-nodepool": "v5e-mixed-pool",
+            },
+            taints=[TPU_TAINT],
+        )
+        for i in range(2)
+    ]
+    nodes += cpu_only_cluster(1)
+    return nodes
+
+
+def node_list(items: List[dict]) -> dict:
+    """Wrap items the way ``GET /api/v1/nodes`` does."""
+    return {"kind": "NodeList", "apiVersion": "v1", "items": items}

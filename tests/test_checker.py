@@ -1,0 +1,191 @@
+"""Exit-code contract matrix and orchestration tests.
+
+Contract under test (check-gpu-node.py:289-293,327 / README.md:135-142):
+0 = ≥1 Ready accelerator node, 2 = none exist, 3 = exist but none Ready,
+1 = any error — in both table and ``--json`` modes.
+"""
+
+import json
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, notify
+
+
+def args_for(*argv):
+    return cli.parse_args(list(argv))
+
+
+def write_nodes(tmp_path, nodes, name="nodes.json"):
+    p = tmp_path / name
+    p.write_text(json.dumps(fx.node_list(nodes)))
+    return str(p)
+
+
+class TestExitCodeMatrix:
+    def run(self, nodes, *extra):
+        return checker.one_shot(args_for(*extra), nodes=nodes)
+
+    def test_exit_0_ready_nodes(self, capsys):
+        assert self.run(fx.tpu_v5e_single_host()) == 0
+        assert self.run(fx.gpu_pool(2)) == 0
+        assert self.run(fx.tpu_v5e_single_host(), "--json") == 0
+
+    def test_exit_2_no_accel_nodes(self, capsys):
+        assert self.run(fx.cpu_only_cluster()) == 2
+        assert self.run(fx.cpu_only_cluster(), "--json") == 2
+        assert self.run([]) == 2
+
+    def test_exit_3_none_ready(self, capsys):
+        nodes = fx.gpu_pool(2, ready=False)
+        assert self.run(nodes) == 3
+        assert self.run(nodes, "--json") == 3
+
+    def test_exit_0_partial_ready(self, capsys):
+        # Reference semantics: ANY ready accelerator node → 0.
+        assert self.run(fx.mixed_cluster_one_notready()) == 0
+
+    def test_exit_3_strict_slices_incomplete(self, capsys):
+        nodes = fx.tpu_v5p_64_slice(not_ready=1)
+        assert self.run(nodes) == 0  # default keeps reference semantics
+        assert self.run(nodes, "--strict-slices") == 3
+
+    def test_exit_1_error_json(self, tmp_path, capsys):
+        code = cli.main(["--json", "--nodes-json", str(tmp_path / "missing.json")])
+        assert code == 1
+        # Machine-readable error on STDOUT (check-gpu-node.py:321-322).
+        out = json.loads(capsys.readouterr().out)
+        assert "error" in out
+
+    def test_exit_1_error_table_mode_stderr(self, tmp_path, capsys):
+        code = cli.main(["--nodes-json", str(tmp_path / "missing.json")])
+        assert code == 1
+        captured = capsys.readouterr()
+        assert "Error:" in captured.err
+        assert captured.out == ""
+
+
+class TestJsonOutput:
+    def test_payload_shape(self, tmp_path, capsys):
+        code = cli.main(
+            ["--json", "--nodes-json", write_nodes(tmp_path, fx.tpu_v5e_256_slice())]
+        )
+        assert code == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["total_nodes"] == 64
+        assert payload["ready_chips"] == 256
+        assert payload["slices"][0]["complete"] is True
+        assert payload["exit_code"] == 0
+        assert "timings_ms" in payload
+
+    def test_table_output(self, tmp_path, capsys):
+        code = cli.main(["--nodes-json", write_nodes(tmp_path, fx.tpu_v5p_64_slice())])
+        assert code == 0
+        out = capsys.readouterr().out
+        assert "✅" in out
+        assert "SLICE(NODEPOOL)" in out
+        assert "64/64" in out
+
+    def test_debug_timings(self, tmp_path, capsys):
+        cli.main(["--debug", "--nodes-json", write_nodes(tmp_path, fx.gpu_pool(1))])
+        assert "Timings (ms):" in capsys.readouterr().out
+
+
+class TestCustomResourceKeys:
+    def test_resource_key_flag(self, capsys):
+        nodes = [fx.make_node("gaudi-0", allocatable={"habana.ai/gaudi": "8"})]
+        assert checker.one_shot(args_for(), nodes=nodes) == 2
+        assert checker.one_shot(args_for("--resource-key", "habana.ai/gaudi"), nodes=nodes) == 0
+
+
+class TestSlackIntegration:
+    def _patch_send(self, monkeypatch, sent_log):
+        def fake_send(url, message, **kwargs):
+            sent_log.append({"url": url, "message": message, **kwargs})
+            return True
+
+        monkeypatch.setattr(notify, "send_slack_message", fake_send)
+
+    def test_sent_when_webhook_given(self, monkeypatch, capsys):
+        sent = []
+        self._patch_send(monkeypatch, sent)
+        code = checker.one_shot(
+            args_for("--slack-webhook", "https://hooks.example/x"),
+            nodes=fx.tpu_v5e_single_host(),
+        )
+        assert code == 0
+        assert len(sent) == 1
+        assert sent[0]["message"].startswith("✅")
+        assert "Slack notification sent." in capsys.readouterr().out
+
+    def test_only_on_error_suppresses_on_success(self, monkeypatch, capsys):
+        sent = []
+        self._patch_send(monkeypatch, sent)
+        checker.one_shot(
+            args_for("--slack-webhook", "https://x", "--slack-only-on-error"),
+            nodes=fx.gpu_pool(1),
+        )
+        assert sent == []
+
+    def test_only_on_error_fires_when_none_ready(self, monkeypatch, capsys):
+        sent = []
+        self._patch_send(monkeypatch, sent)
+        code = checker.one_shot(
+            args_for("--slack-webhook", "https://x", "--slack-only-on-error"),
+            nodes=fx.gpu_pool(2, ready=False),
+        )
+        assert code == 3
+        assert len(sent) == 1
+        assert sent[0]["message"].startswith("⚠️")
+
+    def test_json_mode_suppresses_console_confirmation(self, monkeypatch, capsys):
+        # check-gpu-node.py:268-271.
+        sent = []
+        self._patch_send(monkeypatch, sent)
+        checker.one_shot(
+            args_for("--json", "--slack-webhook", "https://x"),
+            nodes=fx.gpu_pool(1),
+        )
+        out = capsys.readouterr().out
+        assert "Slack notification" not in out
+        json.loads(out)  # still valid JSON payload
+
+    def test_retry_settings_forwarded(self, monkeypatch):
+        sent = []
+        self._patch_send(monkeypatch, sent)
+        checker.one_shot(
+            args_for(
+                "--slack-webhook", "https://x",
+                "--slack-retry-count", "5",
+                "--slack-retry-delay", "1.5",
+                "--slack-username", "custom-bot",
+            ),
+            nodes=fx.gpu_pool(1),
+        )
+        assert sent[0]["max_retries"] == 5
+        assert sent[0]["retry_delay"] == 1.5
+        assert sent[0]["username"] == "custom-bot"
+
+    def test_strict_slice_failure_alerts_with_degraded_header(self, monkeypatch, capsys):
+        # exit 3 via --strict-slices must fire --slack-only-on-error and must
+        # NOT be reported under a ✅ banner even though some hosts are Ready.
+        sent = []
+        self._patch_send(monkeypatch, sent)
+        code = checker.one_shot(
+            args_for(
+                "--strict-slices", "--slack-webhook", "https://x", "--slack-only-on-error"
+            ),
+            nodes=fx.tpu_v5p_64_slice(not_ready=1),
+        )
+        assert code == 3
+        assert len(sent) == 1
+        assert sent[0]["message"].startswith("⚠️")
+        assert "degraded" in sent[0]["message"]
+
+    def test_slack_failure_not_fatal(self, monkeypatch, capsys):
+        # check-gpu-node.py:269-271: delivery failure doesn't change exit code.
+        monkeypatch.setattr(notify, "send_slack_message", lambda *a, **k: False)
+        code = checker.one_shot(
+            args_for("--slack-webhook", "https://x"), nodes=fx.gpu_pool(1)
+        )
+        assert code == 0
+        assert "failed" in capsys.readouterr().err

@@ -1,0 +1,231 @@
+"""Cluster-access tests: kubeconfig parsing, precedence, in-cluster, client.
+
+No network: the HTTP boundary is a fake ``requests.Session``-shaped object
+(SURVEY §4 — "a CoreV1Api stub returning canned node lists" becomes a stub
+session returning a canned NodeList).
+"""
+
+import base64
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import cluster
+
+
+def write_kubeconfig(path, server="https://1.2.3.4:6443", token="tok", extra_user=None):
+    user = {"token": token}
+    if extra_user:
+        user = extra_user
+    doc = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server}}],
+        "users": [{"name": "u", "user": user}],
+    }
+    import yaml
+
+    path.write_text(yaml.safe_dump(doc))
+    return str(path)
+
+
+class TestKubeconfig:
+    def test_token_auth(self, tmp_path):
+        cfg = cluster.load_kubeconfig(write_kubeconfig(tmp_path / "kc"))
+        assert cfg.server == "https://1.2.3.4:6443"
+        assert cfg.token == "tok"
+        assert cfg.verify is True
+
+    def test_inline_ca_and_client_cert_data(self, tmp_path):
+        ca = base64.b64encode(b"CADATA").decode()
+        crt = base64.b64encode(b"CRT").decode()
+        key = base64.b64encode(b"KEY").decode()
+        doc = {
+            "current-context": "ctx",
+            "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [
+                {
+                    "name": "c",
+                    "cluster": {
+                        "server": "https://s:6443/",
+                        "certificate-authority-data": ca,
+                    },
+                }
+            ],
+            "users": [
+                {
+                    "name": "u",
+                    "user": {"client-certificate-data": crt, "client-key-data": key},
+                }
+            ],
+        }
+        import yaml
+
+        p = tmp_path / "kc"
+        p.write_text(yaml.safe_dump(doc))
+        cfg = cluster.load_kubeconfig(str(p))
+        assert cfg.server == "https://s:6443"  # trailing slash stripped
+        assert open(cfg.ca_file, "rb").read() == b"CADATA"
+        cert, keyf = cfg.client_cert
+        assert open(cert, "rb").read() == b"CRT"
+        assert open(keyf, "rb").read() == b"KEY"
+        # Credential material must not be world-readable.
+        assert stat.S_IMODE(os.stat(keyf).st_mode) == 0o600
+
+    def test_exec_plugin(self, tmp_path):
+        plugin = tmp_path / "fake-auth"
+        cred = {"apiVersion": "client.authentication.k8s.io/v1", "kind": "ExecCredential",
+                "status": {"token": "exec-token"}}
+        plugin.write_text(f"#!{sys.executable}\nprint('''{json.dumps(cred)}''')\n")
+        plugin.chmod(0o755)
+        cfg = cluster.load_kubeconfig(
+            write_kubeconfig(
+                tmp_path / "kc", extra_user={"exec": {"command": str(plugin)}}
+            )
+        )
+        assert cfg.token == "exec-token"
+
+    def test_exec_plugin_missing_command(self, tmp_path):
+        with pytest.raises(cluster.ClusterConfigError, match="not found"):
+            cluster.load_kubeconfig(
+                write_kubeconfig(
+                    tmp_path / "kc",
+                    extra_user={"exec": {"command": "/nonexistent/definitely-not-here"}},
+                )
+            )
+
+    def test_missing_context_rejected(self, tmp_path):
+        p = tmp_path / "kc"
+        p.write_text("apiVersion: v1\nkind: Config\n")
+        with pytest.raises(cluster.ClusterConfigError, match="current-context"):
+            cluster.load_kubeconfig(str(p))
+
+    def test_explicit_context_override(self, tmp_path):
+        import yaml
+
+        doc = {
+            "current-context": "a",
+            "contexts": [
+                {"name": "a", "context": {"cluster": "ca", "user": "u"}},
+                {"name": "b", "context": {"cluster": "cb", "user": "u"}},
+            ],
+            "clusters": [
+                {"name": "ca", "cluster": {"server": "https://a:1"}},
+                {"name": "cb", "cluster": {"server": "https://b:1"}},
+            ],
+            "users": [{"name": "u", "user": {"token": "t"}}],
+        }
+        p = tmp_path / "kc"
+        p.write_text(yaml.safe_dump(doc))
+        assert cluster.load_kubeconfig(str(p), context="b").server == "https://b:1"
+
+
+class TestPrecedence:
+    """Discovery precedence mirrors check-gpu-node.py:160-169, plus in-cluster."""
+
+    def test_flag_beats_env(self, tmp_path, monkeypatch):
+        flag_kc = write_kubeconfig(tmp_path / "flag", server="https://flag:1")
+        env_kc = write_kubeconfig(tmp_path / "env", server="https://env:1")
+        monkeypatch.setenv("KUBECONFIG", env_kc)
+        assert cluster.resolve_cluster_config(flag_kc).server == "https://flag:1"
+
+    def test_env_used_when_exists(self, tmp_path, monkeypatch):
+        env_kc = write_kubeconfig(tmp_path / "env", server="https://env:1")
+        monkeypatch.setenv("KUBECONFIG", env_kc)
+        assert cluster.resolve_cluster_config(None).server == "https://env:1"
+
+    def test_env_path_list_first_existing_wins(self, tmp_path, monkeypatch):
+        # kubectl semantics: $KUBECONFIG may be a pathsep-separated list.
+        real = write_kubeconfig(tmp_path / "real", server="https://real:1")
+        monkeypatch.setenv("KUBECONFIG", f"{tmp_path / 'missing'}{os.pathsep}{real}")
+        assert cluster.resolve_cluster_config(None).server == "https://real:1"
+
+    def test_credential_temp_files_registered_for_cleanup(self, tmp_path, monkeypatch):
+        cleaned = []
+        monkeypatch.setattr(cluster.atexit, "register", lambda fn, *a: cleaned.append(a))
+        key = base64.b64encode(b"KEY").decode()
+        crt = base64.b64encode(b"CRT").decode()
+        cfg = cluster.load_kubeconfig(
+            write_kubeconfig(
+                tmp_path / "kc",
+                extra_user={"client-certificate-data": crt, "client-key-data": key},
+            )
+        )
+        assert len(cleaned) == 2  # cert + key both registered for unlink
+        assert {c[0] for c in cleaned} == set(cfg.client_cert)
+
+    def test_env_ignored_when_missing(self, tmp_path, monkeypatch):
+        # Reference behavior: $KUBECONFIG used only if the path exists (:165-167).
+        monkeypatch.setenv("KUBECONFIG", str(tmp_path / "nope"))
+        monkeypatch.setattr(cluster, "DEFAULT_KUBECONFIG", str(tmp_path / "default-nope"))
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        with pytest.raises(cluster.ClusterConfigError):
+            cluster.resolve_cluster_config(None)
+
+    def test_in_cluster_fallback(self, tmp_path, monkeypatch):
+        sa = tmp_path / "sa"
+        sa.mkdir()
+        (sa / "token").write_text("sa-token\n")
+        (sa / "ca.crt").write_text("CA")
+        monkeypatch.delenv("KUBECONFIG", raising=False)
+        monkeypatch.setattr(cluster, "DEFAULT_KUBECONFIG", str(tmp_path / "nope"))
+        monkeypatch.setattr(cluster, "SERVICE_ACCOUNT_DIR", str(sa))
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+        cfg = cluster.resolve_cluster_config(None)
+        assert cfg.server == "https://10.0.0.1:443"
+        assert cfg.token == "sa-token"
+        assert cfg.source == "in-cluster"
+
+
+class FakeSession:
+    """requests.Session stand-in recording the single LIST call."""
+
+    def __init__(self, items):
+        self.items = items
+        self.calls = []
+        self.headers = {}
+        self.verify = None
+        self.cert = None
+        self.auth = None
+
+    def get(self, url, params=None, timeout=None):
+        self.calls.append({"url": url, "params": params, "timeout": timeout})
+
+        class R:
+            status_code = 200
+
+            def raise_for_status(self):
+                pass
+
+            def json(inner):
+                return fx.node_list(self.items)
+
+        return R()
+
+
+class TestKubeClient:
+    def test_list_nodes_single_call(self):
+        cfg = cluster.ClusterConfig(server="https://api:6443", token="t")
+        session = FakeSession(fx.tpu_v5e_single_host())
+        nodes = cluster.KubeClient(cfg, session=session).list_nodes()
+        assert len(nodes) == 1
+        assert len(session.calls) == 1  # exactly one API call, as check-gpu-node.py:217
+        assert session.calls[0]["url"] == "https://api:6443/api/v1/nodes"
+        assert session.headers["Authorization"] == "Bearer t"
+
+    def test_label_selector_param(self):
+        cfg = cluster.ClusterConfig(server="https://api:6443")
+        session = FakeSession([])
+        cluster.KubeClient(cfg, session=session).list_nodes(
+            label_selector="cloud.google.com/gke-tpu-accelerator"
+        )
+        assert session.calls[0]["params"] == {
+            "labelSelector": "cloud.google.com/gke-tpu-accelerator"
+        }

@@ -1,0 +1,201 @@
+"""Detection-core unit tests against the BASELINE.json fixture configs.
+
+Covers the reference behavior contract (is_ready check-gpu-node.py:172-178,
+capacity scan :181-196, node flattening :199-212, filtering :215-226) plus the
+TPU-only additions: allocatable-over-capacity, topology labels, slice grouping.
+"""
+
+from tests import fixtures as fx
+from tpu_node_checker.detect import (
+    extract_node_info,
+    group_slices,
+    is_ready,
+    parse_topology,
+    select_accelerator_nodes,
+    topology_chip_count,
+)
+
+
+class TestIsReady:
+    def test_ready_true(self):
+        assert is_ready(fx.make_node("n", ready=True))
+
+    def test_ready_false(self):
+        assert not is_ready(fx.make_node("n", ready=False))
+
+    def test_missing_conditions(self):
+        # Defensive defaults mirror check-gpu-node.py:173-178.
+        assert not is_ready({"status": {}})
+        assert not is_ready({})
+        assert not is_ready({"status": {"conditions": [{"type": "Ready"}]}})
+
+    def test_ready_unknown_status(self):
+        node = fx.make_node("n", conditions=[{"type": "Ready", "status": "Unknown"}])
+        assert not is_ready(node)
+
+
+class TestExtractNodeInfo:
+    def test_cpu_node_has_no_accelerators(self):
+        info = extract_node_info(fx.cpu_only_cluster(1)[0])
+        assert info.accelerators == 0
+        assert info.breakdown == {}
+        assert not info.is_tpu
+
+    def test_gpu_node(self):
+        info = extract_node_info(fx.gpu_pool(1)[0])
+        assert info.accelerators == 1
+        assert info.breakdown == {"nvidia.com/gpu": 1}
+        assert info.families == ("gpu",)
+        assert info.taints[0]["key"] == "nvidia.com/gpu"
+
+    def test_tpu_node_topology_fields(self):
+        info = extract_node_info(fx.tpu_v5e_single_host()[0])
+        assert info.is_tpu
+        assert info.accelerators == 8
+        assert info.tpu_accelerator == "tpu-v5-lite-podslice"
+        assert info.tpu_topology == "2x4"
+        assert info.nodepool == "v5e-pool"
+
+    def test_allocatable_preferred_over_capacity(self):
+        # Node reserves 1 of 4 GPUs: allocatable must win (reference reads
+        # capacity only — check-gpu-node.py:184-187 — and would report 4).
+        node = fx.make_node(
+            "n", allocatable={"nvidia.com/gpu": "3"}, capacity={"nvidia.com/gpu": "4"}
+        )
+        assert extract_node_info(node).accelerators == 3
+
+    def test_capacity_fallback_when_allocatable_absent(self):
+        node = {
+            "metadata": {"name": "n"},
+            "status": {"capacity": {"google.com/tpu": "4"}},
+        }
+        assert extract_node_info(node).accelerators == 4
+
+    def test_to_dict_shape(self):
+        d = extract_node_info(fx.tpu_v5e_single_host()[0]).to_dict()
+        assert d["tpu"] == {
+            "accelerator": "tpu-v5-lite-podslice",
+            "topology": "2x4",
+            "nodepool": "v5e-pool",
+        }
+        assert set(d) >= {"name", "ready", "accelerators", "breakdown", "labels", "taints"}
+
+
+class TestSelect:
+    def test_cpu_only_cluster_empty(self):
+        accel, ready = select_accelerator_nodes(fx.cpu_only_cluster())
+        assert accel == [] and ready == []
+
+    def test_mixed_cluster_counts(self):
+        accel, ready = select_accelerator_nodes(fx.mixed_cluster_one_notready())
+        assert len(accel) == 4  # 2 GPU + 2 TPU; the CPU node is excluded
+        assert len(ready) == 3  # one TPU host NotReady
+
+    def test_all_notready_still_detected(self):
+        accel, ready = select_accelerator_nodes(fx.gpu_pool(2, ready=False))
+        assert len(accel) == 2 and ready == []
+
+    def test_dead_device_plugin_visible_but_not_ready(self):
+        # allocatable advertises zero TPUs while capacity shows 4 (device
+        # plugin dead): the node must stay VISIBLE as an accelerator node
+        # (else exit 3 would flip to exit 2) but must not count as Ready.
+        node = fx.make_node(
+            "sick-tpu",
+            allocatable={"google.com/tpu": "0"},
+            capacity={"cpu": "8", "google.com/tpu": "4"},
+        )
+        accel, ready = select_accelerator_nodes([node])
+        assert len(accel) == 1
+        assert accel[0].accelerators == 4
+        assert accel[0].schedulable is False
+        assert ready == []
+
+
+class TestTopology:
+    def test_parse(self):
+        assert parse_topology("2x4") == (2, 4)
+        assert parse_topology("4x4x4") == (4, 4, 4)
+        assert parse_topology("16x16") == (16, 16)
+
+    def test_parse_garbage(self):
+        assert parse_topology(None) is None
+        assert parse_topology("") is None
+        assert parse_topology("axb") is None
+        assert parse_topology("0x4") is None
+
+    def test_chip_count(self):
+        assert topology_chip_count("4x4x4") == 64
+        assert topology_chip_count("16x16") == 256
+
+
+class TestSliceGrouping:
+    def _slices(self, nodes):
+        accel, _ = select_accelerator_nodes(nodes)
+        return group_slices(accel)
+
+    def test_v5p_64_one_slice(self):
+        slices = self._slices(fx.tpu_v5p_64_slice())
+        assert len(slices) == 1
+        s = slices[0]
+        assert len(s.hosts) == 16
+        assert s.expected_hosts == 16
+        assert s.chips == 64 and s.expected_chips == 64 and s.ready_chips == 64
+        assert s.complete
+
+    def test_v5p_one_host_down_incomplete(self):
+        s = self._slices(fx.tpu_v5p_64_slice(not_ready=1))[0]
+        assert len(s.ready_hosts) == 15
+        assert s.ready_chips == 60
+        assert not s.complete
+
+    def test_v5e_256_north_star(self):
+        s = self._slices(fx.tpu_v5e_256_slice())[0]
+        assert len(s.hosts) == 64 and s.expected_hosts == 64
+        assert s.chips == 256 and s.ready_chips == 256
+        assert s.complete
+
+    def test_missing_hosts_incomplete(self):
+        # Only 60 of 64 node objects exist (hosts deleted/rescheduling):
+        nodes = fx.tpu_v5e_256_slice()[:60]
+        s = self._slices(nodes)[0]
+        assert len(s.hosts) == 60 and s.expected_hosts == 64
+        assert not s.complete
+
+    def test_gpu_nodes_not_grouped(self):
+        assert self._slices(fx.gpu_pool(2)) == []
+
+    def test_mixed_cluster_slice(self):
+        slices = self._slices(fx.mixed_cluster_one_notready())
+        assert len(slices) == 1
+        assert not slices[0].complete  # the NotReady host breaks the slice
+
+    def test_two_distinct_pools_two_slices(self):
+        nodes = fx.tpu_v5p_64_slice() + fx.tpu_v5e_single_host()
+        assert len(self._slices(nodes)) == 2
+
+    def test_single_host_no_labels_degenerate_slice(self):
+        node = fx.make_node("bare-tpu", allocatable={"google.com/tpu": "4"})
+        slices = self._slices([node])
+        assert len(slices) == 1
+        assert slices[0].complete  # single ready host, no topology claim
+
+    def test_single_host_slice_pool_not_merged(self):
+        # 8 independent single-host v5e nodes (topology 2x2 fits on one host)
+        # in one nodepool, 7 of them NotReady: these are 8 slices, 7 degraded —
+        # NOT one "complete" slice.
+        nodes = [
+            fx.make_node(
+                f"gke-tpu-1h-{i}",
+                ready=(i == 0),
+                allocatable={"google.com/tpu": "4"},
+                labels={
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-device",
+                    "cloud.google.com/gke-tpu-topology": "2x2",
+                    "cloud.google.com/gke-nodepool": "onehost-pool",
+                },
+            )
+            for i in range(8)
+        ]
+        slices = self._slices(nodes)
+        assert len(slices) == 8
+        assert sum(1 for s in slices if s.complete) == 1

@@ -1,0 +1,118 @@
+"""Slack retry state-machine tests (contract: check-gpu-node.py:47-111,142-157).
+
+The HTTP boundary is faked with injectable ``post``/``sleep`` so every branch
+of the retry classifier runs without a network or wall-clock delay.
+"""
+
+import requests
+
+from tpu_node_checker import notify
+
+
+class FakeResponse:
+    def __init__(self, status_code):
+        self.status_code = status_code
+
+
+def make_post(script):
+    """``script`` is a list of status codes or exceptions, consumed in order."""
+    calls = []
+
+    def post(url, json=None, timeout=None):
+        calls.append({"url": url, "json": json, "timeout": timeout})
+        action = script.pop(0)
+        if isinstance(action, Exception):
+            raise action
+        return FakeResponse(action)
+
+    post.calls = calls
+    return post
+
+
+def no_sleep(_):
+    pass
+
+
+class TestGating:
+    def test_no_url_never_sends(self):
+        assert not notify.should_send_slack_message(None, False, healthy=True)
+        assert not notify.should_send_slack_message("", True, healthy=False)
+
+    def test_only_on_error(self):
+        url = "https://hooks.slack.example/x"
+        assert notify.should_send_slack_message(url, True, healthy=False)
+        assert not notify.should_send_slack_message(url, True, healthy=True)
+
+    def test_always_when_not_gated(self):
+        url = "https://hooks.slack.example/x"
+        assert notify.should_send_slack_message(url, False, healthy=False)
+        assert notify.should_send_slack_message(url, False, healthy=True)
+
+    def test_flag_beats_env(self, monkeypatch):
+        monkeypatch.setenv("SLACK_WEBHOOK_URL", "https://env.example")
+        assert notify.get_slack_webhook_url("https://flag.example") == "https://flag.example"
+        assert notify.get_slack_webhook_url(None) == "https://env.example"
+        monkeypatch.delenv("SLACK_WEBHOOK_URL")
+        assert notify.get_slack_webhook_url(None) is None
+
+
+class TestRetryStateMachine:
+    URL = "https://hooks.slack.example/x"
+
+    def test_success_first_try(self):
+        post = make_post([200])
+        assert notify.send_slack_message(self.URL, "m", post=post, sleep=no_sleep)
+        assert len(post.calls) == 1
+        assert post.calls[0]["json"]["text"] == "m"
+        assert post.calls[0]["timeout"] == notify.DEFAULT_TIMEOUT_S
+
+    def test_non_200_retries_then_succeeds(self):
+        # HTTP non-200 falls through to retry (check-gpu-node.py:83-84).
+        post = make_post([500, 500, 200])
+        assert notify.send_slack_message(self.URL, "m", post=post, sleep=no_sleep)
+        assert len(post.calls) == 3
+
+    def test_non_200_exhausts_retries(self):
+        post = make_post([500, 500, 500, 500])
+        assert not notify.send_slack_message(self.URL, "m", post=post, sleep=no_sleep)
+        assert len(post.calls) == 4  # max_retries=3 → 4 attempts
+
+    def test_connection_reset_retries(self):
+        # Only reset/abort connection errors retry (check-gpu-node.py:86-99).
+        post = make_post(
+            [requests.exceptions.ConnectionError("Connection reset by peer"), 200]
+        )
+        assert notify.send_slack_message(self.URL, "m", post=post, sleep=no_sleep)
+        assert len(post.calls) == 2
+
+    def test_connection_aborted_retries(self):
+        post = make_post(
+            [requests.exceptions.ConnectionError("('Connection aborted.', ...)"), 200]
+        )
+        assert notify.send_slack_message(self.URL, "m", post=post, sleep=no_sleep)
+        assert len(post.calls) == 2
+
+    def test_other_connection_error_fails_immediately(self):
+        post = make_post([requests.exceptions.ConnectionError("Name or service not known")])
+        assert not notify.send_slack_message(self.URL, "m", post=post, sleep=no_sleep)
+        assert len(post.calls) == 1
+
+    def test_other_request_exception_fails_immediately(self):
+        post = make_post([requests.exceptions.InvalidURL("bad url")])
+        assert not notify.send_slack_message(self.URL, "m", post=post, sleep=no_sleep)
+        assert len(post.calls) == 1
+
+    def test_retry_delay_passed_to_sleep(self):
+        sleeps = []
+        post = make_post([500, 200])
+        notify.send_slack_message(
+            self.URL, "m", post=post, sleep=sleeps.append, retry_delay=7.5
+        )
+        assert sleeps == [7.5]
+
+    def test_retry_count_zero_single_attempt(self):
+        post = make_post([500])
+        assert not notify.send_slack_message(
+            self.URL, "m", post=post, sleep=no_sleep, max_retries=0
+        )
+        assert len(post.calls) == 1

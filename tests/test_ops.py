@@ -1,0 +1,25 @@
+"""Single-chip compute probe tests (runs on the CPU backend; same jitted code
+paths as TPU — shapes kept small so the suite stays fast)."""
+
+from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn
+
+
+class TestMatmulBurn:
+    def test_burn_passes_on_healthy_backend(self):
+        r = matmul_burn(n=256, iters=2)
+        assert r.ok, r.error
+        assert r.tflops > 0
+        assert r.rel_err < 5e-2
+
+    def test_result_fields(self):
+        r = matmul_burn(n=128, iters=1)
+        assert r.n == 128 and r.iters == 1
+        assert r.elapsed_ms > 0
+
+
+class TestHbmProbe:
+    def test_bandwidth_positive(self):
+        r = hbm_bandwidth_probe(mib=8, iters=2)
+        assert r.ok, r.error
+        assert r.gbps > 0
+        assert r.bytes_moved == 2 * 8 * 1024 * 1024 * 2

@@ -1,0 +1,78 @@
+"""Mesh + ICI collective probe tests over the virtual 8-device CPU mesh
+(conftest forces ``--xla_force_host_platform_device_count=8``)."""
+
+import jax
+import pytest
+
+from tpu_node_checker.parallel import (
+    MeshSpec,
+    build_mesh,
+    collective_probe,
+    mesh_from_topology,
+    ring_probe,
+)
+
+
+def test_virtual_mesh_available():
+    assert len(jax.devices()) == 8
+
+
+class TestMeshBuild:
+    def test_flat_mesh(self):
+        mesh = build_mesh(MeshSpec((("d", 8),)))
+        assert mesh.axis_names == ("d",)
+        assert mesh.devices.shape == (8,)
+
+    def test_2d_mesh(self):
+        mesh = build_mesh(MeshSpec((("data", 4), ("model", 2))))
+        assert mesh.devices.shape == (4, 2)
+
+    def test_wrong_device_count_raises(self):
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            build_mesh(MeshSpec((("d", 16),)))
+
+    def test_mesh_from_topology_label(self):
+        mesh = mesh_from_topology("2x4")
+        assert mesh.devices.shape == (2, 4)
+        assert mesh.axis_names == ("t0", "t1")
+
+    def test_mesh_from_topology_mismatch_falls_back_flat(self):
+        mesh = mesh_from_topology("16x16")  # promises 256, we have 8
+        assert mesh.devices.shape == (8,)
+
+    def test_mesh_from_topology_none(self):
+        assert mesh_from_topology(None).devices.shape == (8,)
+
+
+class TestCollectiveProbe:
+    def test_psum_all_gather_all_devices(self):
+        r = collective_probe(payload=64, timed_iters=2)
+        assert r.ok, r.error
+        assert r.n_devices == 8
+        assert r.details == {"psum_ok": True, "all_gather_ok": True}
+        assert r.latency_us > 0
+
+    def test_over_2d_mesh_flattened(self):
+        mesh = build_mesh(MeshSpec((("x", 2), ("y", 4))))
+        r = collective_probe(mesh=mesh, payload=32, timed_iters=1)
+        assert r.ok, r.error
+        assert r.n_devices == 8
+
+    def test_subset_mesh(self):
+        mesh = build_mesh(MeshSpec((("d", 4),)), jax.devices()[:4])
+        r = collective_probe(mesh=mesh, payload=32, timed_iters=1)
+        assert r.ok, r.error
+        assert r.n_devices == 4
+
+
+class TestRingProbe:
+    def test_full_ring(self):
+        r = ring_probe(payload=32)
+        assert r.ok, r.error
+        assert r.n_devices == 8
+        assert r.details == {"hops": 8}
+
+    def test_ring_over_2d_mesh(self):
+        mesh = build_mesh(MeshSpec((("x", 4), ("y", 2))))
+        r = ring_probe(mesh=mesh, payload=16)
+        assert r.ok, r.error

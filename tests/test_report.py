@@ -1,0 +1,86 @@
+"""Formatter tests: table, JSON schema (reference superset), Slack mrkdwn."""
+
+import json
+
+from tests import fixtures as fx
+from tpu_node_checker import report
+from tpu_node_checker.detect import group_slices, select_accelerator_nodes
+
+
+def _analyzed(nodes):
+    accel, ready = select_accelerator_nodes(nodes)
+    return accel, ready, group_slices(accel)
+
+
+class TestTable:
+    def test_empty_message(self):
+        # Mirrors check-gpu-node.py:230-232.
+        assert "No accelerator nodes" in report.format_node_table([])
+
+    def test_columns_present(self):
+        accel, _, _ = _analyzed(fx.tpu_v5e_single_host())
+        table = report.format_node_table(accel)
+        assert "gke-tpu-v5e-0" in table
+        assert "google.com/tpu:8" in table
+        assert "tpu-v5-lite-podslice 2x4" in table
+
+    def test_notready_rendered(self):
+        accel, _, _ = _analyzed(fx.gpu_pool(1, ready=False))
+        assert "NotReady" in report.format_node_table(accel)
+
+    def test_slice_table(self):
+        accel, _, slices = _analyzed(fx.tpu_v5p_64_slice(not_ready=1))
+        table = report.format_slice_table(slices)
+        assert "v5p-pool" in table
+        assert "15/16" in table
+        assert "60/64" in table
+        assert "DEGRADED" in table
+
+
+class TestJsonPayload:
+    def test_reference_schema_superset(self):
+        # Reference payload keys (check-gpu-node.py:273-279) must all exist.
+        accel, ready, slices = _analyzed(fx.gpu_pool(2))
+        payload = report.build_json_payload(accel, ready, slices)
+        assert payload["total_nodes"] == 2
+        assert payload["ready_nodes"] == 2
+        node = payload["nodes"][0]
+        for key in ("name", "ready", "gpus", "gpu_breakdown", "labels", "taints"):
+            assert key in node
+        assert node["gpus"] == 1
+        assert node["gpu_breakdown"] == {"nvidia.com/gpu": 1}
+
+    def test_tpu_fields(self):
+        accel, ready, slices = _analyzed(fx.tpu_v5e_256_slice())
+        payload = report.build_json_payload(accel, ready, slices)
+        assert payload["total_chips"] == 256
+        assert payload["ready_chips"] == 256
+        assert payload["slices"][0]["expected_chips"] == 256
+        assert payload["slices"][0]["complete"] is True
+
+    def test_round_trips_through_json(self):
+        accel, ready, slices = _analyzed(fx.mixed_cluster_one_notready())
+        payload = report.build_json_payload(accel, ready, slices, timings_ms={"total": 1.0})
+        assert json.loads(report.dumps(payload)) == payload
+
+
+class TestSlackMessage:
+    def test_tri_state_headers(self):
+        # check-gpu-node.py:116-124 tri-state.
+        accel, ready, slices = _analyzed(fx.tpu_v5e_single_host())
+        assert report.format_slack_message(accel, ready, slices).startswith("✅")
+
+        accel, ready, slices = _analyzed(fx.gpu_pool(2, ready=False))
+        assert report.format_slack_message(accel, ready, slices).startswith("⚠️")
+
+        assert report.format_slack_message([], [], []).startswith("❌")
+
+    def test_node_bullets(self):
+        accel, ready, slices = _analyzed(fx.gpu_pool(1))
+        msg = report.format_slack_message(accel, ready, slices)
+        assert "• `gke-gpu-pool-0`: Ready, devices: 1 (nvidia.com/gpu:1)" in msg
+
+    def test_slice_line_degraded(self):
+        accel, ready, slices = _analyzed(fx.tpu_v5p_64_slice(not_ready=2))
+        msg = report.format_slack_message(accel, ready, slices)
+        assert "56/64 chips, DEGRADED" in msg

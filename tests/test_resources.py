@@ -1,0 +1,69 @@
+"""Registry + quantity parsing unit tests (reference keys: check-gpu-node.py:39-44)."""
+
+from tpu_node_checker.resources import KeyMatcher, ResourceRegistry, default_registry
+from tpu_node_checker.utils.quantity import parse_quantity
+
+
+class TestRegistry:
+    def test_reference_gpu_keys_all_match(self):
+        reg = default_registry()
+        for key in ("nvidia.com/gpu", "amd.com/gpu", "gpu.intel.com/i915", "intel.com/gpu"):
+            m = reg.match(key)
+            assert m is not None and m.family == "gpu"
+
+    def test_tpu_keys_match(self):
+        reg = default_registry()
+        assert reg.match("google.com/tpu").family == "tpu"
+        for key in ("cloud-tpus.google.com/v4", "cloud-tpus.google.com/v5e",
+                    "cloud-tpus.google.com/v5p", "cloud-tpus.google.com/v6e"):
+            m = reg.match(key)
+            assert m is not None and m.family == "tpu"
+
+    def test_non_accelerator_keys_do_not_match(self):
+        reg = default_registry()
+        for key in ("cpu", "memory", "pods", "ephemeral-storage",
+                    "cloud-tpus.google.com", "example.com/tpu"):
+            assert reg.match(key) is None
+
+    def test_scan_breakdown_and_families(self):
+        reg = default_registry()
+        matches = reg.scan({"cpu": "8", "nvidia.com/gpu": "2", "google.com/tpu": "4"})
+        got = {m.key: (m.count, m.family) for m in matches}
+        assert got == {"nvidia.com/gpu": (2, "gpu"), "google.com/tpu": (4, "tpu")}
+
+    def test_scan_drops_zero_and_garbage(self):
+        reg = default_registry()
+        assert reg.scan({"nvidia.com/gpu": "0"}) == []
+        assert reg.scan({"nvidia.com/gpu": "banana"}) == []
+        assert reg.scan(None) == []
+        assert reg.scan({}) == []
+
+    def test_with_extra_keys(self):
+        reg = default_registry().with_extra_keys(["habana.ai/gaudi"])
+        assert reg.match("habana.ai/gaudi").family == "gpu"
+
+    def test_exact_matcher_is_not_glob(self):
+        m = KeyMatcher("google.com/tpu", "tpu", "google")
+        assert not m.matches("google.com/tpux")
+
+    def test_first_match_wins_order(self):
+        reg = ResourceRegistry([KeyMatcher("a/*", "gpu", "x"), KeyMatcher("a/b", "tpu", "y")])
+        assert reg.match("a/b").family == "gpu"
+
+
+class TestQuantity:
+    def test_plain_ints(self):
+        assert parse_quantity("4") == 4
+        assert parse_quantity(8) == 8
+        assert parse_quantity("256") == 256
+
+    def test_suffixes(self):
+        assert parse_quantity("1Ki") == 1024
+        assert parse_quantity("2k") == 2000
+        assert parse_quantity("500m") == 0  # half a device floors to zero
+
+    def test_garbage(self):
+        assert parse_quantity("") is None
+        assert parse_quantity(None) is None
+        assert parse_quantity("NaNGi") is None
+        assert parse_quantity(True) is None

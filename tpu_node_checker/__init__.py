@@ -1,0 +1,45 @@
+"""tpu_node_checker — TPU-native Kubernetes accelerator-node health-check framework.
+
+Built from scratch with the capabilities of ``ahaljh/k8s-gpu-node-checker``
+(reference: ``check-gpu-node.py``, 332 lines), re-designed TPU-first:
+
+* accelerator detection reads ``node.status.allocatable`` (the reference reads
+  ``capacity``, check-gpu-node.py:184-187) through a pattern-matching resource-key
+  registry that covers the reference's four GPU keys (check-gpu-node.py:39-44)
+  plus ``google.com/tpu`` and ``cloud-tpus.google.com/v*``;
+* GKE TPU topology labels (``cloud.google.com/gke-tpu-accelerator``,
+  ``cloud.google.com/gke-tpu-topology``) are interpreted, and multi-host slices
+  are grouped so "ready" can mean *all hosts of the slice* ready — a concept the
+  reference (per-node only, check-gpu-node.py:220-225) has no analog for;
+* an optional in-pod data-plane probe enumerates live chips via
+  ``jax.devices()``/libtpu and can exercise the MXU, HBM, and ICI with real
+  compute (``tpu_node_checker.ops``, ``tpu_node_checker.parallel``);
+* the CLI surface, Slack notification path (retry state machine of
+  check-gpu-node.py:47-111), and exit-code contract 0/2/3/1
+  (check-gpu-node.py:289-293,327) are preserved.
+"""
+
+__version__ = "0.1.0"
+
+from tpu_node_checker.resources import AcceleratorMatch, ResourceRegistry, default_registry
+from tpu_node_checker.detect import (
+    NodeInfo,
+    SliceInfo,
+    extract_node_info,
+    group_slices,
+    is_ready,
+    select_accelerator_nodes,
+)
+
+__all__ = [
+    "AcceleratorMatch",
+    "ResourceRegistry",
+    "default_registry",
+    "NodeInfo",
+    "SliceInfo",
+    "extract_node_info",
+    "group_slices",
+    "is_ready",
+    "select_accelerator_nodes",
+    "__version__",
+]

@@ -1,0 +1,6 @@
+"""``python -m tpu_node_checker`` entry point."""
+
+from tpu_node_checker.cli import entrypoint
+
+if __name__ == "__main__":
+    entrypoint()

@@ -1,0 +1,196 @@
+"""Orchestration: one-shot check → notify → print → exit code.
+
+Re-design of the reference's ``one_shot`` (check-gpu-node.py:252-293),
+preserving its observable order and contract:
+
+* Slack delivery happens **before** any stdout output (:256-271);
+* ``--json`` suppresses the Slack success/failure console lines (:268-271);
+* exit codes: 0 = ≥1 Ready accelerator node, 2 = zero accelerator nodes,
+  3 = accelerator nodes exist but none Ready (:289-293); 1 is reserved for the
+  CLI's catch-all (:319-327);
+* Slack failure is never fatal (:269-271).
+
+TPU additions (all default-off or additive, so reference CI consumers keep
+their semantics):
+
+* an optional in-pod chip probe; a probed-and-failed host is excluded from the
+  *effective* ready set, so "node Ready, chips dead" lands on exit 3
+  (SURVEY §5.3's fourth failure grade);
+* ``--strict-slices`` escalates an incomplete multi-host slice to exit 3 even
+  when some hosts are Ready — an SPMD job cannot run on 63/64 hosts;
+* phase timings for the <2 s budget, surfaced via ``--debug`` and ``--json``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tpu_node_checker import notify, report
+from tpu_node_checker.detect import NodeInfo, SliceInfo, group_slices, select_accelerator_nodes
+from tpu_node_checker.resources import ResourceRegistry, default_registry
+from tpu_node_checker.utils.timing import PhaseTimer
+
+EXIT_OK = 0
+EXIT_ERROR = 1
+EXIT_NO_ACCEL_NODES = 2
+EXIT_NONE_READY = 3
+
+
+@dataclass
+class CheckResult:
+    exit_code: int
+    accel: List[NodeInfo] = field(default_factory=list)
+    ready: List[NodeInfo] = field(default_factory=list)  # effective (probe-adjusted)
+    slices: List[SliceInfo] = field(default_factory=list)
+    payload: dict = field(default_factory=dict)
+    local_probe: Optional[dict] = None
+
+
+def _registry_from_args(args) -> ResourceRegistry:
+    reg = default_registry()
+    extra = getattr(args, "resource_key", None) or []
+    if extra:
+        reg = reg.with_extra_keys(extra)
+    return reg
+
+
+def _fetch_nodes(args, timer: PhaseTimer) -> List[dict]:
+    """Node source: ``--nodes-json`` fixture file, or one live LIST call."""
+    nodes_json = getattr(args, "nodes_json", None)
+    if nodes_json:
+        with timer.phase("list"):
+            with open(nodes_json) as f:
+                doc = json.load(f)
+            # "items": null happens in Go-serialized NodeLists; treat as empty.
+            return (doc.get("items") or []) if isinstance(doc, dict) else doc
+    from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
+
+    with timer.phase("config"):
+        cfg = resolve_cluster_config(
+            getattr(args, "kubeconfig", None), getattr(args, "context", None)
+        )
+    with timer.phase("list"):
+        return KubeClient(cfg).list_nodes(
+            label_selector=getattr(args, "label_selector", None)
+        )
+
+
+def _run_probe(args, accel: List[NodeInfo], result: CheckResult) -> None:
+    """Attach the local chip probe to the matching node (or the payload).
+
+    The probe speaks for the host it runs on (``NODE_NAME`` downward-API env
+    or the kernel hostname); its verdict adjusts that host's effective
+    readiness only.  When the probed host isn't in the node list (running the
+    CLI outside the cluster), the result is surfaced as ``local_probe`` but
+    flips no node state.
+    """
+    import os
+
+    from tpu_node_checker.probe import run_local_probe
+
+    # Resolve the local node first so the probe can enforce the allocatable
+    # device count itself (run_local_probe's expected_devices check).
+    hostname = os.environ.get("NODE_NAME") or os.uname().nodename
+    local = next((n for n in accel if n.name == hostname), None)
+    probed = run_local_probe(
+        level=getattr(args, "probe_level", "enumerate"),
+        timeout_s=getattr(args, "probe_timeout", None) or 20.0,
+        expected_devices=local.accelerators if local else None,
+    )
+    if local is not None:
+        local.probe = probed.to_dict()
+    result.local_probe = probed.to_dict()
+
+
+def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
+    """Pure-ish core of the run: everything except printing and Slack I/O
+    gating decisions is computed here so tests can drive it directly."""
+    timer = PhaseTimer()
+    if nodes is None:
+        nodes = _fetch_nodes(args, timer)
+    result = CheckResult(exit_code=EXIT_OK)
+    with timer.phase("detect"):
+        accel, ready = select_accelerator_nodes(nodes, _registry_from_args(args))
+        slices = group_slices(accel)
+    result.accel, result.slices = accel, slices
+
+    if getattr(args, "probe", False):
+        with timer.phase("probe"):
+            _run_probe(args, accel, result)
+
+    # Effective readiness: kubelet Ready minus unschedulable/probe-failed hosts.
+    effective_ready = [n for n in ready if n.effectively_ready]
+    result.ready = effective_ready
+
+    if not accel:
+        result.exit_code = EXIT_NO_ACCEL_NODES
+    elif not effective_ready:
+        result.exit_code = EXIT_NONE_READY
+    elif getattr(args, "strict_slices", False) and any(not s.complete for s in slices):
+        result.exit_code = EXIT_NONE_READY
+    else:
+        result.exit_code = EXIT_OK
+
+    with timer.phase("render"):
+        payload = report.build_json_payload(
+            accel, effective_ready, slices, timings_ms=None
+        )
+        if result.local_probe is not None:
+            payload["local_probe"] = result.local_probe
+        payload["exit_code"] = result.exit_code
+    payload["timings_ms"] = timer.as_dict()
+    result.payload = payload
+    return result
+
+
+def one_shot(args, nodes: Optional[List[dict]] = None) -> int:
+    """Full run with side effects; returns the process exit code."""
+    result = run_check(args, nodes)
+    accel, ready, slices = result.accel, result.ready, result.slices
+
+    # Slack first, stdout second — the reference's order (check-gpu-node.py:256-271).
+    healthy = result.exit_code == EXIT_OK
+    webhook = notify.get_slack_webhook_url(getattr(args, "slack_webhook", None))
+    if notify.should_send_slack_message(
+        webhook, getattr(args, "slack_only_on_error", False), healthy
+    ):
+        message = report.format_slack_message(accel, ready, slices, healthy=healthy)
+        sent = notify.send_slack_message(
+            webhook,
+            message,
+            username=getattr(args, "slack_username", notify.DEFAULT_USERNAME),
+            max_retries=getattr(args, "slack_retry_count", notify.DEFAULT_MAX_RETRIES),
+            retry_delay=getattr(args, "slack_retry_delay", notify.DEFAULT_RETRY_DELAY_S),
+        )
+        if not getattr(args, "json", False):
+            # Console confirmation suppressed in JSON mode (check-gpu-node.py:268-271).
+            if sent:
+                print("Slack notification sent.")
+            else:
+                print("Slack notification failed (check stderr).", file=sys.stderr)
+
+    if getattr(args, "json", False):
+        print(report.dumps(result.payload))
+    else:
+        print(report.summary_line(accel, ready))
+        print()
+        print(report.format_node_table(accel))
+        slice_table = report.format_slice_table(slices)
+        if slice_table:
+            print()
+            print(slice_table)
+        if result.local_probe is not None:
+            status = "ok" if result.local_probe.get("ok") else "FAILED"
+            print()
+            print(
+                f"Local chip probe [{result.local_probe.get('level')}] {status}: "
+                f"{result.local_probe.get('device_count')} device(s), "
+                f"platform={result.local_probe.get('platform')}"
+            )
+        if getattr(args, "debug", False):
+            print()
+            print("Timings (ms): " + json.dumps(result.payload.get("timings_ms", {})))
+    return result.exit_code

@@ -1,0 +1,108 @@
+"""CLI / config / entry layer.
+
+Superset of the reference's L6 (``parse_args`` check-gpu-node.py:298-311,
+``main`` :314-327, entry guard :330-332): same flags and defaults, same
+three-source config precedence (flag → environment → ``.env`` file), same
+catch-all error contract (JSON mode prints ``{"error": ...}`` to **stdout**
+and exits 1; human mode prints the message plus traceback to stderr).
+
+New flags are all additive: ``--context``, ``--label-selector``,
+``--resource-key``, ``--nodes-json``, ``--probe``/``--probe-level``/
+``--probe-timeout``, ``--strict-slices``, ``--debug``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import traceback
+from typing import List, Optional
+
+from tpu_node_checker import __version__, checker
+from tpu_node_checker.probe.liveness import LEVELS as PROBE_LEVELS
+from tpu_node_checker.utils.env import load_dotenv
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="tpu-node-checker",
+        description=(
+            "Check a Kubernetes cluster for Ready accelerator nodes (GPU and, "
+            "natively, TPU slices). Exit codes: 0 = at least one Ready "
+            "accelerator node; 2 = no accelerator nodes; 3 = accelerator nodes "
+            "exist but none Ready (or the chip probe / strict slice check "
+            "failed); 1 = error."
+        ),
+    )
+    p.add_argument("--version", action="version", version=f"%(prog)s {__version__}")
+    p.add_argument("--kubeconfig", help="path to kubeconfig (default: $KUBECONFIG, then ~/.kube/config, then in-cluster)")
+    p.add_argument("--context", help="kubeconfig context to use (default: current-context)")
+    p.add_argument("--json", action="store_true", help="machine-readable JSON output")
+    p.add_argument(
+        "--label-selector",
+        help="server-side node label selector for the LIST call "
+        "(e.g. 'cloud.google.com/gke-tpu-accelerator')",
+    )
+    p.add_argument(
+        "--resource-key",
+        action="append",
+        metavar="KEY",
+        help="additional accelerator resource key or glob to detect (repeatable)",
+    )
+    p.add_argument(
+        "--nodes-json",
+        metavar="FILE",
+        help="read nodes from a JSON NodeList file instead of a live cluster "
+        "(offline mode for CI fixtures and demos)",
+    )
+    p.add_argument("--strict-slices", action="store_true",
+                   help="exit 3 if any multi-host TPU slice is incomplete")
+    p.add_argument("--debug", action="store_true", help="print phase timings")
+
+    probe = p.add_argument_group("Chip probe (data-plane liveness)")
+    probe.add_argument("--probe", action="store_true",
+                       help="probe this host's chips via jax.devices() in a sandboxed subprocess")
+    probe.add_argument("--probe-level", choices=PROBE_LEVELS, default="enumerate",
+                       help="enumerate chips, run MXU/HBM compute, or also ICI collectives")
+    probe.add_argument("--probe-timeout", type=float, default=20.0,
+                       help="hard wall-clock timeout for the probe subprocess (s)")
+
+    # Same group/flags/defaults as the reference (check-gpu-node.py:304-309).
+    slack = p.add_argument_group("Slack")
+    slack.add_argument("--slack-webhook", help="Slack incoming-webhook URL (or $SLACK_WEBHOOK_URL)")
+    slack.add_argument("--slack-username", default="tpu-node-checker")
+    slack.add_argument("--slack-only-on-error", action="store_true",
+                       help="notify only when zero accelerator nodes are Ready")
+    slack.add_argument("--slack-retry-count", type=int, default=3)
+    slack.add_argument("--slack-retry-delay", type=float, default=30.0)
+    return p.parse_args(argv)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    try:
+        return checker.one_shot(args)
+    except Exception as exc:  # noqa: BLE001 — the reference's catch-all (:319-327)
+        if args.json:
+            from tpu_node_checker.report import error_payload
+
+            print(error_payload(str(exc)))
+        else:
+            print(f"Error: {exc}", file=sys.stderr)
+            traceback.print_exc()
+        return checker.EXIT_ERROR
+
+
+def entrypoint() -> None:
+    """Console entry: load ``.env`` then exit with the check's code
+    (mirrors check-gpu-node.py:330-332)."""
+    # Die quietly when stdout is a closed pipe (`checker | head`), the
+    # conventional CLI behavior.
+    import signal
+
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    except (AttributeError, ValueError):  # non-POSIX or non-main thread
+        pass
+    load_dotenv()
+    sys.exit(main())

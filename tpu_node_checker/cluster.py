@@ -1,0 +1,253 @@
+"""Cluster access: kubeconfig/in-cluster discovery + a minimal k8s REST client.
+
+The reference delegates this layer to the ``kubernetes`` client package
+(``load_kube_config`` check-gpu-node.py:160-169, ``client.CoreV1Api()`` :253,
+``api.list_node()`` :217).  This build ships its own thin client over
+``requests`` instead: the checker makes exactly **one** GET, so a full client
+library is dead weight on the <2 s latency budget (importing ``kubernetes``
+alone costs hundreds of ms), and raw REST dicts are exactly what the pure core
+(``tpu_node_checker.detect``) consumes.
+
+Config discovery preserves the reference's precedence — ``--kubeconfig`` flag →
+``$KUBECONFIG`` (only if the path exists, check-gpu-node.py:165-167) → default
+``~/.kube/config`` — and fixes the reference's gap (SURVEY §2.1): when no
+kubeconfig exists, fall back to **in-cluster** service-account config, which is
+the configuration the in-pod chip probe actually runs under.
+
+Supported kubeconfig auth: CA/client-cert/key as paths or inline ``*-data``,
+bearer ``token`` / ``tokenFile``, basic auth, and ``exec`` credential plugins
+(the GKE path: ``gke-gcloud-auth-plugin``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import base64
+import json
+import os
+import subprocess
+import tempfile
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import requests
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+DEFAULT_KUBECONFIG = os.path.join(os.path.expanduser("~"), ".kube", "config")
+DEFAULT_TIMEOUT_S = 10.0
+
+
+class ClusterConfigError(RuntimeError):
+    """Raised when no usable cluster configuration can be resolved."""
+
+
+@dataclass
+class ClusterConfig:
+    """Resolved connection parameters for one API server."""
+
+    server: str
+    ca_file: Optional[str] = None
+    insecure_skip_tls_verify: bool = False
+    client_cert: Optional[Tuple[str, str]] = None  # (cert_path, key_path)
+    token: Optional[str] = None
+    basic_auth: Optional[Tuple[str, str]] = None
+    source: str = "unknown"  # "kubeconfig:<path>" | "in-cluster"
+    _temp_files: List[str] = field(default_factory=list, repr=False)
+
+    @property
+    def verify(self):
+        if self.insecure_skip_tls_verify:
+            return False
+        return self.ca_file if self.ca_file else True
+
+
+def _cleanup_temp(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _materialize(data_b64: str, suffix: str, temp_files: List[str]) -> str:
+    """Write base64 ``*-data`` kubeconfig material to a temp file, return path."""
+    return _materialize_bytes(base64.b64decode(data_b64), suffix, temp_files)
+
+
+def _materialize_bytes(raw: bytes, suffix: str, temp_files: List[str]) -> str:
+    """Write credential bytes to a mode-0600 temp file, return path.
+
+    Files hold credential material (client keys), so each is registered for
+    unconditional removal at interpreter exit — a cron-driven checker must not
+    accumulate key files in /tmp.
+    """
+    fd, path = tempfile.mkstemp(prefix="tpu-node-checker-", suffix=suffix)
+    try:
+        os.write(fd, raw)
+    finally:
+        os.close(fd)
+    os.chmod(path, 0o600)
+    temp_files.append(path)
+    atexit.register(_cleanup_temp, path)
+    return path
+
+
+def _named(entries: list, name: str, kind: str) -> dict:
+    for e in entries or []:
+        if e.get("name") == name:
+            return e.get(kind) or {}
+    raise ClusterConfigError(f"kubeconfig references unknown {kind} {name!r}")
+
+
+def _run_exec_plugin(spec: dict) -> dict:
+    """Run a client-go exec credential plugin and return its ``status`` dict."""
+    cmd = [spec["command"], *(spec.get("args") or [])]
+    env = dict(os.environ)
+    for pair in spec.get("env") or []:
+        env[pair["name"]] = pair["value"]
+    try:
+        out = subprocess.run(
+            cmd, capture_output=True, env=env, timeout=30, check=True, text=True
+        ).stdout
+    except FileNotFoundError as exc:
+        raise ClusterConfigError(f"exec auth plugin not found: {spec['command']}") from exc
+    except subprocess.CalledProcessError as exc:
+        raise ClusterConfigError(
+            f"exec auth plugin failed ({exc.returncode}): {exc.stderr.strip()[:500]}"
+        ) from exc
+    except subprocess.TimeoutExpired as exc:
+        raise ClusterConfigError(f"exec auth plugin timed out: {spec['command']}") from exc
+    try:
+        return json.loads(out).get("status") or {}
+    except json.JSONDecodeError as exc:
+        raise ClusterConfigError("exec auth plugin emitted invalid JSON") from exc
+
+
+def load_kubeconfig(path: str, context: Optional[str] = None) -> ClusterConfig:
+    """Parse one kubeconfig file into a :class:`ClusterConfig`."""
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    ctx_name = context or doc.get("current-context")
+    if not ctx_name:
+        raise ClusterConfigError(f"kubeconfig {path} has no current-context")
+    ctx = _named(doc.get("contexts"), ctx_name, "context")
+    cluster = _named(doc.get("clusters"), ctx.get("cluster"), "cluster")
+    user = _named(doc.get("users"), ctx.get("user"), "user") if ctx.get("user") else {}
+
+    server = cluster.get("server")
+    if not server:
+        raise ClusterConfigError(f"kubeconfig {path}: cluster has no server URL")
+
+    temp_files: List[str] = []
+    cfg = ClusterConfig(server=server.rstrip("/"), source=f"kubeconfig:{path}", _temp_files=temp_files)
+    cfg.insecure_skip_tls_verify = bool(cluster.get("insecure-skip-tls-verify"))
+    if cluster.get("certificate-authority"):
+        cfg.ca_file = cluster["certificate-authority"]
+    elif cluster.get("certificate-authority-data"):
+        cfg.ca_file = _materialize(cluster["certificate-authority-data"], ".ca.crt", temp_files)
+
+    cert = user.get("client-certificate")
+    key = user.get("client-key")
+    if user.get("client-certificate-data"):
+        cert = _materialize(user["client-certificate-data"], ".client.crt", temp_files)
+    if user.get("client-key-data"):
+        key = _materialize(user["client-key-data"], ".client.key", temp_files)
+    if cert and key:
+        cfg.client_cert = (cert, key)
+
+    if user.get("token"):
+        cfg.token = user["token"]
+    elif user.get("tokenFile"):
+        with open(user["tokenFile"]) as f:
+            cfg.token = f.read().strip()
+    elif user.get("username") and user.get("password"):
+        cfg.basic_auth = (user["username"], user["password"])
+    elif user.get("exec"):
+        status = _run_exec_plugin(user["exec"])
+        if status.get("token"):
+            cfg.token = status["token"]
+        if status.get("clientCertificateData") and status.get("clientKeyData"):
+            # ExecCredential status carries plaintext PEM, not base64.
+            cfg.client_cert = (
+                _materialize_bytes(
+                    status["clientCertificateData"].encode(), ".exec.crt", temp_files
+                ),
+                _materialize_bytes(status["clientKeyData"].encode(), ".exec.key", temp_files),
+            )
+    return cfg
+
+
+def load_incluster_config(sa_dir: Optional[str] = None) -> ClusterConfig:
+    """Service-account config for pods — the reference never implements this
+    (``config.load_incluster_config`` is never called; SURVEY §2.1), yet the
+    in-pod chip probe requires it."""
+    sa_dir = sa_dir or SERVICE_ACCOUNT_DIR
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    token_path = os.path.join(sa_dir, "token")
+    ca_path = os.path.join(sa_dir, "ca.crt")
+    if not host or not os.path.exists(token_path):
+        raise ClusterConfigError("not running in a cluster (no service account present)")
+    with open(token_path) as f:
+        token = f.read().strip()
+    return ClusterConfig(
+        server=f"https://{host}:{port}",
+        ca_file=ca_path if os.path.exists(ca_path) else None,
+        token=token,
+        source="in-cluster",
+    )
+
+
+def resolve_cluster_config(
+    kubeconfig_flag: Optional[str] = None, context: Optional[str] = None
+) -> ClusterConfig:
+    """Discovery precedence: flag → $KUBECONFIG (if exists) → ~/.kube/config →
+    in-cluster.  First three mirror check-gpu-node.py:160-169; the last is new."""
+    if kubeconfig_flag:
+        return load_kubeconfig(kubeconfig_flag, context)
+    env_value = os.environ.get("KUBECONFIG")
+    if env_value:
+        # $KUBECONFIG may be a pathsep-separated list (kubectl semantics);
+        # use the first existing entry rather than silently ignoring the
+        # variable and checking a different cluster than kubectl would.
+        for env_path in env_value.split(os.pathsep):
+            if env_path and os.path.exists(env_path):
+                return load_kubeconfig(env_path, context)
+    if os.path.exists(DEFAULT_KUBECONFIG):
+        return load_kubeconfig(DEFAULT_KUBECONFIG, context)
+    return load_incluster_config()
+
+
+class KubeClient:
+    """Just enough Kubernetes API for this tool: one LIST.
+
+    RBAC footprint is identical to the reference's (ClusterRole with
+    ``nodes: get,list`` — README.md:144-159 of the reference).
+    """
+
+    def __init__(self, config: ClusterConfig, session: Optional[requests.Session] = None):
+        self.config = config
+        self._session = session or requests.Session()
+        self._session.verify = config.verify
+        if config.client_cert:
+            self._session.cert = config.client_cert
+        if config.token:
+            self._session.headers["Authorization"] = f"Bearer {config.token}"
+        elif config.basic_auth:
+            self._session.auth = config.basic_auth
+
+    def list_nodes(
+        self, label_selector: Optional[str] = None, timeout: float = DEFAULT_TIMEOUT_S
+    ) -> List[dict]:
+        """``GET /api/v1/nodes`` — the single API call per run, as in
+        check-gpu-node.py:217 — optionally server-side filtered by label
+        selector so a v5e-256 check pulls 64 node objects, not the cluster."""
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        resp = self._session.get(
+            f"{self.config.server}/api/v1/nodes", params=params, timeout=timeout
+        )
+        resp.raise_for_status()
+        return resp.json().get("items") or []

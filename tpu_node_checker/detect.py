@@ -1,0 +1,324 @@
+"""Pure detection / analysis core.
+
+TPU-first re-design of the reference's L2 layer (``is_ready``
+check-gpu-node.py:172-178, ``gpu_capacity`` :181-196, ``extract_node_info``
+:199-212, ``list_gpu_nodes`` :215-226).  Differences, all deliberate:
+
+* Operates on **raw Kubernetes REST dicts** (``{"metadata": ..., "status": ...}``)
+  instead of ``kubernetes.client`` model objects — the framework ships its own
+  dependency-free HTTPS client (``tpu_node_checker.cluster``), and plain dicts
+  make the core trivially testable with JSON fixtures.
+* Reads ``status.allocatable`` (what pods can actually schedule against) with a
+  ``capacity`` fallback; the reference reads only ``capacity``
+  (check-gpu-node.py:184-187), which over-reports on nodes with reserved devices.
+* Interprets GKE TPU topology labels the reference collects but ignores
+  (labels gathered at check-gpu-node.py:207, surfaced raw only in ``--json``):
+  ``cloud.google.com/gke-tpu-accelerator`` and
+  ``cloud.google.com/gke-tpu-topology``.
+* Adds slice grouping: a v5e-256 slice is 64 node objects that form ONE logical
+  accelerator; :func:`group_slices` reconstructs that unit so readiness can be
+  judged slice-wide (the reference judges per-node only,
+  check-gpu-node.py:220-225).
+
+Everything here is a pure function of its inputs: no I/O, no globals beyond the
+default registry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_node_checker.resources import AcceleratorMatch, ResourceRegistry, default_registry
+
+# GKE node labels that describe TPU hardware.  The accelerator/topology pair is
+# the authoritative slice descriptor; the nodepool label is the slice identity
+# (every host of one multi-host slice lives in one node pool).
+LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+LABEL_NODEPOOL = "cloud.google.com/gke-nodepool"
+
+
+def is_ready(node: dict) -> bool:
+    """True iff a NodeCondition has type=="Ready" and status=="True".
+
+    Same rule as check-gpu-node.py:172-178, including the defensive defaults:
+    missing ``status``/``conditions`` → not ready.
+    """
+    conditions = (node.get("status") or {}).get("conditions") or []
+    for cond in conditions:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+def accelerator_allocatable(
+    node: dict, registry: Optional[ResourceRegistry] = None
+) -> Tuple[List[AcceleratorMatch], bool]:
+    """Accelerator devices a node offers → (matches, schedulable).
+
+    The reference's ``gpu_capacity`` (check-gpu-node.py:181-196) reads
+    ``capacity`` only; allocatable is what schedulers actually see, so it is
+    the primary source here.  Two fallback cases keep sick nodes *visible*
+    instead of silently dropping them (which would flip exit 3 into exit 2):
+
+    * allocatable map entirely absent (kubelet mid-registration) → use
+      capacity, ``schedulable`` stays True (nothing contradicts it);
+    * allocatable present but advertising zero accelerators while capacity
+      shows some (dead device plugin) → report the capacity devices with
+      ``schedulable=False``, so the node counts as an accelerator node that
+      is not effectively Ready.
+    """
+    registry = registry or default_registry()
+    status = node.get("status") or {}
+    allocatable = status.get("allocatable")
+    capacity = status.get("capacity")
+    if allocatable is None:
+        return registry.scan(capacity), True
+    matches = registry.scan(allocatable)
+    if matches:
+        return matches, True
+    cap_matches = registry.scan(capacity)
+    if cap_matches:
+        return cap_matches, False  # devices physically present, none schedulable
+    return [], True
+
+
+@dataclass
+class NodeInfo:
+    """Flattened view of one node — superset of the reference's dict
+    (``extract_node_info``, check-gpu-node.py:199-212)."""
+
+    name: str
+    ready: bool
+    accelerators: int  # total devices across matched keys ("gpus" in the reference)
+    breakdown: Dict[str, int]  # per-key attribution ("gpu_breakdown")
+    families: Tuple[str, ...]  # ("tpu",), ("gpu",), or both for mixed nodes
+    labels: Dict[str, str]
+    taints: List[Dict[str, Optional[str]]]
+    # False when capacity shows devices but allocatable advertises none
+    # (dead device plugin): the node is visible but must not count as Ready.
+    schedulable: bool = True
+    # TPU-only fields (None on GPU/CPU nodes):
+    tpu_accelerator: Optional[str] = None  # e.g. "tpu-v5-lite-podslice"
+    tpu_topology: Optional[str] = None  # e.g. "16x16"
+    nodepool: Optional[str] = None
+    # Data-plane probe result, attached later by the probe layer (None = not probed):
+    probe: Optional[dict] = None
+
+    @property
+    def is_tpu(self) -> bool:
+        return "tpu" in self.families
+
+    @property
+    def effectively_ready(self) -> bool:
+        """Kubelet Ready AND schedulable AND (if probed) chips alive.
+
+        This is the readiness the exit-code and slice logic consume; plain
+        ``ready`` stays the raw kubelet condition for reporting parity with
+        the reference.
+        """
+        if not self.ready or not self.schedulable:
+            return False
+        return self.probe is None or bool(self.probe.get("ok"))
+
+    def to_dict(self) -> dict:
+        """JSON shape — superset of the reference payload's node entries
+        (check-gpu-node.py:273-279: name/ready/gpus/gpu_breakdown/labels/taints)."""
+        d = {
+            "name": self.name,
+            "ready": self.ready,
+            "schedulable": self.schedulable,
+            "accelerators": self.accelerators,
+            "breakdown": dict(self.breakdown),
+            "families": list(self.families),
+            "labels": dict(self.labels),
+            "taints": list(self.taints),
+        }
+        if self.is_tpu:
+            d["tpu"] = {
+                "accelerator": self.tpu_accelerator,
+                "topology": self.tpu_topology,
+                "nodepool": self.nodepool,
+            }
+        if self.probe is not None:
+            d["probe"] = self.probe
+        return d
+
+
+def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -> NodeInfo:
+    """Flatten a raw node dict into :class:`NodeInfo`.
+
+    Mirrors check-gpu-node.py:199-212 (name, ready, totals, breakdown, labels,
+    taints) and additionally interprets the TPU topology labels.
+    """
+    metadata = node.get("metadata") or {}
+    labels = metadata.get("labels") or {}
+    matches, schedulable = accelerator_allocatable(node, registry)
+    breakdown = {m.key: m.count for m in matches}
+    families = tuple(sorted({m.family for m in matches}))
+    taints = [
+        {"key": t.get("key"), "value": t.get("value"), "effect": t.get("effect")}
+        for t in ((node.get("spec") or {}).get("taints") or [])
+    ]
+    return NodeInfo(
+        name=metadata.get("name") or "",
+        ready=is_ready(node),
+        accelerators=sum(breakdown.values()),
+        breakdown=breakdown,
+        families=families,
+        labels=dict(labels),
+        taints=taints,
+        schedulable=schedulable,
+        tpu_accelerator=labels.get(LABEL_TPU_ACCELERATOR),
+        tpu_topology=labels.get(LABEL_TPU_TOPOLOGY),
+        nodepool=labels.get(LABEL_NODEPOOL),
+    )
+
+
+def select_accelerator_nodes(
+    nodes: Sequence[dict], registry: Optional[ResourceRegistry] = None
+) -> Tuple[List[NodeInfo], List[NodeInfo]]:
+    """Filter a node list to accelerator nodes; return (all, ready).
+
+    Same contract as ``list_gpu_nodes`` (check-gpu-node.py:215-226) minus the
+    API call — the transport layer hands raw dicts in.
+    """
+    infos = [extract_node_info(n, registry) for n in nodes]
+    accel = [i for i in infos if i.accelerators > 0]
+    ready = [i for i in accel if i.ready and i.schedulable]
+    return accel, ready
+
+
+# --------------------------------------------------------------------------- #
+# Slice grouping — no reference analog (SURVEY §7 "hard parts").
+# --------------------------------------------------------------------------- #
+
+
+def parse_topology(topology: Optional[str]) -> Optional[Tuple[int, ...]]:
+    """Parse a GKE topology label value like ``"2x2x1"`` or ``"16x16"``."""
+    if not topology:
+        return None
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError:
+        return None
+    return dims if dims and all(d > 0 for d in dims) else None
+
+
+def topology_chip_count(topology: Optional[str]) -> Optional[int]:
+    """Total chips a topology describes: the product of its dimensions."""
+    dims = parse_topology(topology)
+    if dims is None:
+        return None
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+@dataclass
+class SliceInfo:
+    """One logical TPU slice reconstructed from its member node objects.
+
+    Identity is (nodepool, accelerator, topology): all hosts of a GKE
+    multi-host slice share one node pool and carry identical topology labels.
+    """
+
+    accelerator: Optional[str]
+    topology: Optional[str]
+    nodepool: Optional[str]
+    hosts: List[NodeInfo] = field(default_factory=list)
+
+    @property
+    def ready_hosts(self) -> List[NodeInfo]:
+        # Probe-aware: a kubelet-Ready host with dead chips is not a usable
+        # slice member (properties re-evaluate after the probe layer attaches
+        # results to the shared NodeInfo objects).
+        return [h for h in self.hosts if h.effectively_ready]
+
+    @property
+    def chips(self) -> int:
+        return sum(h.accelerators for h in self.hosts)
+
+    @property
+    def ready_chips(self) -> int:
+        return sum(h.accelerators for h in self.ready_hosts)
+
+    @property
+    def expected_chips(self) -> Optional[int]:
+        return topology_chip_count(self.topology)
+
+    @property
+    def expected_hosts(self) -> Optional[int]:
+        """Hosts the topology implies: expected chips / per-host chip count."""
+        total = self.expected_chips
+        if total is None or not self.hosts:
+            return None
+        per_host = max((h.accelerators for h in self.hosts), default=0)
+        if per_host <= 0:
+            return None
+        return max(1, total // per_host)
+
+    @property
+    def complete(self) -> bool:
+        """All hosts the topology implies are present AND Ready.
+
+        This is the slice-wide readiness the reference cannot express: one
+        NotReady (or missing) host makes the whole slice unusable for SPMD
+        jobs even though every other node object reads Ready.
+        """
+        expected = self.expected_hosts
+        if expected is None:
+            return bool(self.hosts) and len(self.ready_hosts) == len(self.hosts)
+        return len(self.ready_hosts) >= expected
+
+    def to_dict(self) -> dict:
+        return {
+            "accelerator": self.accelerator,
+            "topology": self.topology,
+            "nodepool": self.nodepool,
+            "hosts": len(self.hosts),
+            "ready_hosts": len(self.ready_hosts),
+            "expected_hosts": self.expected_hosts,
+            "chips": self.chips,
+            "ready_chips": self.ready_chips,
+            "expected_chips": self.expected_chips,
+            "complete": self.complete,
+            "host_names": [h.name for h in self.hosts],
+        }
+
+
+def group_slices(infos: Sequence[NodeInfo]) -> List[SliceInfo]:
+    """Group TPU nodes into logical slices by (nodepool, accelerator, topology).
+
+    Nodes without TPU devices are ignored; TPU nodes without topology labels
+    each form a degenerate single-host slice.
+    """
+    by_key: Dict[Tuple, SliceInfo] = {}
+    for info in infos:
+        if not info.is_tpu:
+            continue
+        expected = topology_chip_count(info.tpu_topology)
+        if expected is not None and expected <= info.accelerators:
+            # Single-host slice type (topology fits on one host): every node
+            # is its own logical slice.  Grouping them by nodepool would let
+            # one Ready host mark a pool of dead ones "complete".
+            key = ("__single__", info.name)
+        elif info.tpu_topology is None and info.nodepool is None:
+            key = ("__single__", info.name)
+        else:
+            key = (info.nodepool, info.tpu_accelerator, info.tpu_topology)
+        s = by_key.get(key)
+        if s is None:
+            s = by_key[key] = SliceInfo(
+                accelerator=info.tpu_accelerator,
+                topology=info.tpu_topology,
+                nodepool=info.nodepool,
+            )
+        s.hosts.append(info)
+    # Deterministic order: by nodepool then first host name.
+    return sorted(
+        by_key.values(),
+        key=lambda s: (s.nodepool or "", s.hosts[0].name if s.hosts else ""),
+    )

@@ -1,0 +1,116 @@
+"""Slack webhook notification with the reference's retry state machine.
+
+Re-implements ``send_slack_message`` (check-gpu-node.py:47-111),
+``get_slack_webhook_url`` (:142-144) and ``should_send_slack_message``
+(:147-157) with the same observable semantics:
+
+* POST ``{text, username, icon_emoji}`` with a 10 s timeout (:73-78);
+* retry **only** on connection errors whose message contains
+  ``"Connection reset by peer"`` or ``"Connection aborted"`` (:86-99), up to
+  ``max_retries`` times with ``retry_delay`` seconds between attempts;
+* HTTP non-200 responses also retry (the reference's loop falls through,
+  :83-84);
+* any other exception fails immediately (:101-109);
+* success after a retry logs the attempt count (:80-82);
+* delivery failure is never fatal to the check itself (:269-271).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+import requests
+
+DEFAULT_USERNAME = "tpu-node-checker"
+DEFAULT_ICON = ":robot_face:"
+DEFAULT_TIMEOUT_S = 10.0
+DEFAULT_MAX_RETRIES = 3
+DEFAULT_RETRY_DELAY_S = 30.0
+
+_RETRYABLE_FRAGMENTS = ("Connection reset by peer", "Connection aborted")
+
+
+def get_slack_webhook_url(flag_value: Optional[str]) -> Optional[str]:
+    """Flag beats environment (check-gpu-node.py:142-144)."""
+    return flag_value or os.environ.get("SLACK_WEBHOOK_URL") or None
+
+
+def should_send_slack_message(
+    webhook_url: Optional[str], only_on_error: bool, healthy: bool
+) -> bool:
+    """Gating policy (check-gpu-node.py:147-157): no URL → never;
+    only-on-error → only when the check failed; else always.
+
+    The reference gates on ``len(ready)==0``; here ``healthy`` is the full
+    check outcome (exit code 0), so strict-slice and probe failures also
+    count as errors — otherwise ``--strict-slices --slack-only-on-error``
+    could exit 3 while Slack stays silent.
+    """
+    if not webhook_url:
+        return False
+    if only_on_error:
+        return not healthy
+    return True
+
+
+def _is_retryable(exc: Exception) -> bool:
+    """Exactly the reference's classification (check-gpu-node.py:86-99):
+    ConnectionError/Timeout AND the message names a reset/abort."""
+    if not isinstance(exc, (requests.exceptions.ConnectionError, requests.exceptions.Timeout)):
+        return False
+    msg = str(exc)
+    return any(frag in msg for frag in _RETRYABLE_FRAGMENTS)
+
+
+def send_slack_message(
+    webhook_url: str,
+    message: str,
+    username: str = DEFAULT_USERNAME,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+    retry_delay: float = DEFAULT_RETRY_DELAY_S,
+    timeout: float = DEFAULT_TIMEOUT_S,
+    sleep: Callable[[float], None] = time.sleep,
+    post: Optional[Callable] = None,
+) -> bool:
+    """Deliver one message; returns True on HTTP 200.
+
+    ``sleep`` and ``post`` are injectable so tests can drive the retry state
+    machine without wall-clock delays or a live webhook.
+    """
+    post = post or requests.post
+    payload = {"text": message, "username": username, "icon_emoji": DEFAULT_ICON}
+    attempts = max_retries + 1
+    for attempt in range(1, attempts + 1):
+        try:
+            resp = post(webhook_url, json=payload, timeout=timeout)
+            if getattr(resp, "status_code", None) == 200:
+                if attempt > 1:
+                    print(
+                        f"Slack message delivered after {attempt} attempts.",
+                        file=sys.stderr,
+                    )
+                return True
+            print(
+                f"Slack webhook returned HTTP {getattr(resp, 'status_code', '?')} "
+                f"(attempt {attempt}/{attempts}).",
+                file=sys.stderr,
+            )
+        except (requests.exceptions.ConnectionError, requests.exceptions.Timeout) as exc:
+            if not _is_retryable(exc):
+                print(f"Slack delivery failed: {exc}", file=sys.stderr)
+                return False
+            print(
+                f"Slack connection error (attempt {attempt}/{attempts}): {exc}",
+                file=sys.stderr,
+            )
+        except requests.exceptions.RequestException as exc:
+            # Non-connection request errors fail immediately (check-gpu-node.py:101-109).
+            print(f"Slack delivery failed: {exc}", file=sys.stderr)
+            return False
+        if attempt < attempts:
+            sleep(retry_delay)
+    print(f"Slack delivery failed after {attempts} attempts.", file=sys.stderr)
+    return False

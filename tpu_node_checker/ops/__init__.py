@@ -1,0 +1,22 @@
+"""Single-chip compute probes: real math as a health signal.
+
+The reference performs zero accelerator computation (SURVEY §2.3 — it never
+imports torch/jax/cuda).  A TPU-native health check can do better than
+enumerate chips: run the hardware's two critical paths and compare against
+known-good results —
+
+* :func:`matmul_burn` — bf16 matmul chain on the **MXU** (systolic array),
+  sized and batched so XLA tiles it fully; reports achieved TFLOP/s and a
+  numerical cross-check (MXU result vs a VPU-computed invariant);
+* :func:`hbm_bandwidth_probe` — streaming elementwise kernel bounded by **HBM**
+  bandwidth; reports achieved GB/s.
+
+Both are pure JAX under ``jax.jit`` with static shapes, so they compile once
+and run anywhere (TPU, CPU test mesh) — device-kind thresholds live in the
+caller, not here.
+"""
+
+from tpu_node_checker.ops.burn import BurnResult, matmul_burn
+from tpu_node_checker.ops.hbm import HbmResult, hbm_bandwidth_probe
+
+__all__ = ["BurnResult", "matmul_burn", "HbmResult", "hbm_bandwidth_probe"]

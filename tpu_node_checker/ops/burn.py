@@ -1,0 +1,110 @@
+"""MXU matmul burn-in probe.
+
+Design notes (TPU-first):
+
+* bf16 inputs with ``preferred_element_type=float32`` accumulation — the MXU's
+  native mode; ``n`` defaults to 2048, a multiple of the 128×128 systolic tile
+  so XLA tiles with no padding waste.
+* The timed chain is a ``lax.scan`` over matmuls inside one ``jit`` — one
+  compiled program, no per-iteration dispatch from Python, no data-dependent
+  control flow.
+* Correctness is checked with an invariant the VPU can verify cheaply:
+  ``trace(A @ Aᵀ) == ||A||²_F``.  The left side exercises the MXU; the right
+  side is an elementwise square-reduce on the VPU.  Disagreement beyond bf16
+  tolerance marks the chip sick (the gpu-burn pattern, re-done the XLA way).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class BurnResult:
+    ok: bool
+    tflops: float
+    elapsed_ms: float
+    rel_err: float
+    n: int
+    iters: int
+    error: Optional[str] = None
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _burn_chain(a: jax.Array, iters: int) -> jax.Array:
+    """``iters`` chained bf16 matmuls; rescaled each step to stay finite.
+
+    Returns a f32 scalar checksum of the final product rather than the matrix:
+    the reduction fuses into the same compiled program, and fetching the
+    scalar to the host is an unambiguous completion barrier — on remote/
+    tunneled TPU transports, ``block_until_ready`` alone can return before
+    the work is observable, which made burn timings meaningless.
+    """
+    scale = jnp.float32(1.0 / jnp.sqrt(jnp.float32(a.shape[0])))
+
+    def step(x, _):
+        y = jnp.dot(x, a, preferred_element_type=jnp.float32)
+        return (y * scale).astype(a.dtype), None
+
+    out, _ = jax.lax.scan(step, a, None, length=iters)
+    return jnp.sum(out.astype(jnp.float32))
+
+
+@jax.jit
+def _invariant(a: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(trace(A@Aᵀ) via MXU, ||A||²_F via VPU) — must agree."""
+    prod = jnp.dot(a, a.T, preferred_element_type=jnp.float32)
+    return jnp.trace(prod), jnp.sum(jnp.square(a.astype(jnp.float32)))
+
+
+def matmul_burn(
+    n: int = 2048,
+    iters: int = 16,
+    device: Optional[jax.Device] = None,
+    rel_tol: float = 5e-2,
+) -> BurnResult:
+    """Run the burn on one device (default: first local device)."""
+    try:
+        device = device or jax.local_devices()[0]
+        key = jax.random.PRNGKey(0)
+        a = jax.device_put(
+            jax.random.normal(key, (n, n), dtype=jnp.bfloat16), device
+        )
+        # Warm-up compiles and runs once; the timed run measures steady state.
+        # float() forces host materialization — the completion barrier.
+        checksum = float(_burn_chain(a, iters))
+        t0 = time.perf_counter()
+        checksum = float(_burn_chain(a, iters))
+        elapsed = time.perf_counter() - t0
+        tflops = (2.0 * n * n * n * iters) / elapsed / 1e12
+        if not jnp.isfinite(checksum):
+            return BurnResult(
+                ok=False, tflops=tflops, elapsed_ms=elapsed * 1e3,
+                rel_err=float("inf"), n=n, iters=iters,
+                error=f"burn checksum is not finite: {checksum}",
+            )
+
+        mxu, vpu = _invariant(a)
+        mxu, vpu = float(mxu), float(vpu)
+        rel_err = abs(mxu - vpu) / max(abs(vpu), 1e-9)
+        ok = rel_err < rel_tol and jnp.isfinite(mxu)
+        return BurnResult(
+            ok=bool(ok),
+            tflops=tflops,
+            elapsed_ms=elapsed * 1e3,
+            rel_err=rel_err,
+            n=n,
+            iters=iters,
+            error=None if ok else f"MXU/VPU invariant mismatch: rel_err={rel_err:.3e}",
+        )
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return BurnResult(
+            ok=False, tflops=0.0, elapsed_ms=0.0, rel_err=float("inf"), n=n, iters=iters,
+            error=f"{type(exc).__name__}: {exc}",
+        )

@@ -1,0 +1,30 @@
+"""Device-mesh construction and ICI collective health probes.
+
+The reference has no distributed backend at all (SURVEY §2.3 — its only I/O is
+HTTPS REST).  The TPU-native mapping of that role (SURVEY §5.8) is the
+control-plane (k8s labels, handled in :mod:`tpu_node_checker.detect`) plus this
+data-plane: build a ``jax.sharding.Mesh`` over the live chips and push XLA
+collectives (``psum``, ``all_gather``, ``ppermute``) across the ICI links via
+``shard_map``.  A slice whose hosts are all kubelet-Ready but whose ICI is
+broken fails here and nowhere else.
+"""
+
+from tpu_node_checker.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    mesh_from_topology,
+)
+from tpu_node_checker.parallel.collectives import (
+    CollectiveResult,
+    collective_probe,
+    ring_probe,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "mesh_from_topology",
+    "CollectiveResult",
+    "collective_probe",
+    "ring_probe",
+]

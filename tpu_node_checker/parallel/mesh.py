@@ -1,0 +1,83 @@
+"""Mesh construction from GKE topology labels or live devices.
+
+Bridges the control-plane view (a topology label like ``"4x4x4"`` on node
+objects, parsed by :func:`tpu_node_checker.detect.parse_topology`) and the
+data-plane view (a ``jax.sharding.Mesh`` over live devices).  The health
+question "does the fabric match the label?" becomes: build the mesh the label
+promises and run collectives over it (:mod:`tpu_node_checker.parallel.collectives`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpu_node_checker.detect import parse_topology
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Named mesh axes and their sizes, e.g. (("data", 4), ("model", 2))."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(name for name, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(size for _, size in self.axes)
+
+    @property
+    def device_count(self) -> int:
+        n = 1
+        for _, size in self.axes:
+            n *= size
+        return n
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a ``jax.sharding.Mesh`` for ``spec`` over ``devices``.
+
+    Lazy-imports jax so control-plane-only runs never pay for backend init.
+    Raises ``ValueError`` when the device count doesn't match the spec — the
+    probe layer converts that into a health failure ("label promises 8 chips,
+    fabric shows 4").
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != spec.device_count:
+        raise ValueError(
+            f"mesh spec {spec.axes} needs {spec.device_count} devices, "
+            f"got {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(spec.shape)
+    return Mesh(arr, spec.axis_names)
+
+
+def mesh_from_topology(
+    topology: Optional[str], devices: Optional[Sequence] = None, axis_prefix: str = "t"
+):
+    """Mesh shaped like a GKE topology label (``"2x4"`` → axes t0=2, t1=4).
+
+    Falls back to one flat axis over all devices when the label is absent or
+    doesn't match the live device count — enumeration health is reported
+    separately, and a flat mesh still lets collectives run.
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    dims = parse_topology(topology)
+    if dims is not None:
+        total = 1
+        for d in dims:
+            total *= d
+        if total == len(devices):
+            spec = MeshSpec(tuple((f"{axis_prefix}{i}", d) for i, d in enumerate(dims)))
+            return build_mesh(spec, devices)
+    return build_mesh(MeshSpec((("d", len(devices)),)), devices)

@@ -1,0 +1,20 @@
+"""Data-plane liveness probes.
+
+The reference stops at kubelet-level health (NodeCondition Ready,
+check-gpu-node.py:172-178).  On TPU nodes that is not enough: a host can be
+Ready while its chips are wedged (libtpu init hangs, a neighbor holds the chip
+lock, ICI links are down).  This subpackage adds the missing grade of health:
+
+* :mod:`tpu_node_checker.probe.liveness` — subprocess-isolated
+  ``jax.devices()`` enumeration with a hard timeout (``jax`` can hang forever
+  on an unhealthy slice, so it must never run in the checker's own process —
+  SURVEY §7 "hard parts");
+* compute probes (``--probe-level compute`` / ``collective``) that run real
+  math on the chips via :mod:`tpu_node_checker.ops` (MXU matmul burn, HBM
+  bandwidth) and :mod:`tpu_node_checker.parallel` (ICI collectives over a
+  device mesh).
+"""
+
+from tpu_node_checker.probe.liveness import ProbeResult, run_local_probe
+
+__all__ = ["ProbeResult", "run_local_probe"]

@@ -1,0 +1,171 @@
+"""Subprocess-isolated chip liveness probe.
+
+Runs ``jax.devices()`` (and optionally real compute) in a **child process**
+with a hard wall-clock timeout.  Rationale (SURVEY §7 "hard parts"): libtpu
+initialization can hang indefinitely on an unhealthy slice or when another
+process holds the chips; the checker itself must stay inside the <2 s budget
+(minus probe allowance) and must never be taken down by the probe.  The child
+reports over a pipe as one JSON line; anything else — timeout, crash, OOM,
+import error — degrades to a structured failure, never an exception.
+
+Probe levels:
+
+* ``enumerate`` — backend init + device enumeration (platform, chip count);
+* ``compute``   — plus an MXU matmul burn and HBM bandwidth sample on one chip
+                  (:mod:`tpu_node_checker.ops`);
+* ``collective`` — plus a psum over all local chips
+                  (:mod:`tpu_node_checker.parallel`), exercising intra-host ICI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+DEFAULT_TIMEOUT_S = 20.0
+LEVELS = ("enumerate", "compute", "collective")
+
+# The child script is spelled as a standalone -c program (not a fork) so the
+# parent process never imports jax and a wedged libtpu cannot leak into it.
+_CHILD_SCRIPT = r"""
+import json, sys, time
+level = sys.argv[1]
+out = {"ok": False, "level": level}
+t0 = time.perf_counter()
+try:
+    import jax
+    devices = jax.devices()
+    out["platform"] = devices[0].platform if devices else None
+    out["device_count"] = len(devices)
+    out["device_kinds"] = sorted({d.device_kind for d in devices})
+    out["process_index"] = jax.process_index()
+    out["process_count"] = jax.process_count()
+    out["ok"] = len(devices) > 0
+    if level in ("compute", "collective") and out["ok"]:
+        from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn
+        burn = matmul_burn()
+        out["matmul_tflops"] = round(burn.tflops, 3)
+        out["matmul_ok"] = burn.ok
+        hbm = hbm_bandwidth_probe()
+        out["hbm_gbps"] = round(hbm.gbps, 2)
+        out["ok"] = out["ok"] and burn.ok
+    if level == "collective" and out["ok"]:
+        from tpu_node_checker.parallel import collective_probe
+        coll = collective_probe()
+        out["collective_ok"] = coll.ok
+        out["collective_latency_us"] = round(coll.latency_us, 1)
+        out["ok"] = out["ok"] and coll.ok
+except Exception as exc:  # noqa: BLE001 - the whole point is to catch anything
+    out["error"] = f"{type(exc).__name__}: {exc}"
+out["elapsed_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+print(json.dumps(out))
+"""
+
+
+@dataclass
+class ProbeResult:
+    """Outcome of one local probe run; ``to_dict()`` feeds the JSON payload."""
+
+    ok: bool
+    level: str
+    hostname: str
+    elapsed_ms: float
+    device_count: int = 0
+    platform: Optional[str] = None
+    device_kinds: List[str] = field(default_factory=list)
+    error: Optional[str] = None
+    details: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {
+            "ok": self.ok,
+            "level": self.level,
+            "hostname": self.hostname,
+            "elapsed_ms": self.elapsed_ms,
+            "device_count": self.device_count,
+            "platform": self.platform,
+            "device_kinds": self.device_kinds,
+        }
+        if self.error:
+            d["error"] = self.error
+        d.update(self.details)
+        return d
+
+
+def run_local_probe(
+    level: str = "enumerate",
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+    expected_devices: Optional[int] = None,
+    python: Optional[str] = None,
+) -> ProbeResult:
+    """Probe this host's chips in a child process; never raises.
+
+    ``expected_devices`` (e.g. a node's ``google.com/tpu`` allocatable count)
+    turns a *partial* enumeration into a failure: 3 of 4 chips alive is a sick
+    host even though ``jax.devices()`` succeeded.
+    """
+    if level not in LEVELS:
+        raise ValueError(f"unknown probe level {level!r}; expected one of {LEVELS}")
+    hostname = os.environ.get("NODE_NAME") or os.uname().nodename
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [python or sys.executable, "-c", _CHILD_SCRIPT, level],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env={**os.environ, "PYTHONPATH": _pythonpath()},
+        )
+    except subprocess.TimeoutExpired:
+        return ProbeResult(
+            ok=False,
+            level=level,
+            hostname=hostname,
+            elapsed_ms=round((time.perf_counter() - t0) * 1e3, 1),
+            error=f"probe timed out after {timeout_s}s (libtpu hang?)",
+        )
+    elapsed_ms = round((time.perf_counter() - t0) * 1e3, 1)
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        data = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        return ProbeResult(
+            ok=False,
+            level=level,
+            hostname=hostname,
+            elapsed_ms=elapsed_ms,
+            error=(
+                f"probe subprocess exited {proc.returncode} without a report: "
+                f"{(proc.stderr or '').strip()[-500:]}"
+            ),
+        )
+    known = {"ok", "level", "platform", "device_count", "device_kinds", "error", "elapsed_ms"}
+    result = ProbeResult(
+        ok=bool(data.get("ok")),
+        level=level,
+        hostname=hostname,
+        elapsed_ms=elapsed_ms,
+        device_count=int(data.get("device_count") or 0),
+        platform=data.get("platform"),
+        device_kinds=list(data.get("device_kinds") or []),
+        error=data.get("error"),
+        details={k: v for k, v in data.items() if k not in known},
+    )
+    if result.ok and expected_devices is not None and result.device_count < expected_devices:
+        result.ok = False
+        result.error = (
+            f"only {result.device_count}/{expected_devices} expected devices enumerated"
+        )
+    return result
+
+
+def _pythonpath() -> str:
+    """Child must be able to import tpu_node_checker for compute levels."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    existing = os.environ.get("PYTHONPATH", "")
+    return f"{pkg_root}{os.pathsep}{existing}" if existing else pkg_root

@@ -1,0 +1,189 @@
+"""Presentation layer: human table + ``--json`` machine format.
+
+Re-design of the reference's L3 (``print_table`` check-gpu-node.py:229-249 and
+the JSON payload assembly :273-279).  The table gains TPU columns; the JSON
+payload is a superset of the reference schema ``{total_nodes, ready_nodes,
+nodes:[{name, ready, gpus, gpu_breakdown, labels, taints}]}`` and keeps the
+legacy ``gpus`` / ``gpu_breakdown`` aliases inside each node entry so CI
+consumers of the reference can switch without edits.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from tpu_node_checker.detect import NodeInfo, SliceInfo
+
+
+def _render_columns(headers: Sequence[str], rows: Sequence[Sequence[str]]) -> str:
+    """Dynamic-width text table, same technique as check-gpu-node.py:234-249."""
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    lines = [
+        "  ".join(h.ljust(widths[i]) for i, h in enumerate(headers)).rstrip(),
+        "  ".join("-" * widths[i] for i in range(len(headers))),
+    ]
+    for row in rows:
+        lines.append("  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)).rstrip())
+    return "\n".join(lines)
+
+
+def _status(n: NodeInfo) -> str:
+    """Kubelet readiness, annotated when the device plugin is dead (node is
+    Ready but allocatable advertises zero devices)."""
+    if not n.ready:
+        return "NotReady"
+    return "Ready" if n.schedulable else "Ready/NoAlloc"
+
+
+def format_node_table(nodes: Sequence[NodeInfo]) -> str:
+    """NAME / READY / ACCEL(TOTAL) / KEYS / TPU-TOPOLOGY table.
+
+    Empty input prints a single informational line, mirroring
+    check-gpu-node.py:230-232.
+    """
+    if not nodes:
+        return "No accelerator nodes found in the cluster."
+    rows = []
+    for n in nodes:
+        keys = ", ".join(f"{k}:{v}" for k, v in sorted(n.breakdown.items()))
+        topo = ""
+        if n.is_tpu:
+            topo = f"{n.tpu_accelerator or '?'} {n.tpu_topology or ''}".strip()
+        probe = "-"
+        if n.probe is not None:
+            probe = "ok" if n.probe.get("ok") else "FAIL"
+        rows.append([n.name, _status(n), str(n.accelerators), keys, topo, probe])
+    return _render_columns(["NAME", "READY", "ACCEL", "KEYS", "TPU", "PROBE"], rows)
+
+
+def format_slice_table(slices: Sequence[SliceInfo]) -> str:
+    """Per-slice readiness summary — no reference analog (slice grouping is new)."""
+    if not slices:
+        return ""
+    rows = []
+    for s in slices:
+        expected_hosts = s.expected_hosts
+        hosts = f"{len(s.ready_hosts)}/{expected_hosts if expected_hosts else len(s.hosts)}"
+        expected_chips = s.expected_chips
+        chips = f"{s.ready_chips}/{expected_chips if expected_chips else s.chips}"
+        rows.append(
+            [
+                s.nodepool or "-",
+                s.accelerator or "-",
+                s.topology or "-",
+                hosts,
+                chips,
+                "complete" if s.complete else "DEGRADED",
+            ]
+        )
+    return _render_columns(
+        ["SLICE(NODEPOOL)", "ACCELERATOR", "TOPOLOGY", "HOSTS", "CHIPS", "STATUS"], rows
+    )
+
+
+def summary_line(accel: Sequence[NodeInfo], ready: Sequence[NodeInfo]) -> str:
+    """Emoji status line in the spirit of check-gpu-node.py:281-287."""
+    total_chips = sum(n.accelerators for n in accel)
+    ready_chips = sum(n.accelerators for n in ready)
+    if not accel:
+        return "❌ No accelerator nodes found."
+    if len(ready) == len(accel):
+        return (
+            f"✅ {len(ready)}/{len(accel)} accelerator nodes Ready "
+            f"({ready_chips}/{total_chips} chips)."
+        )
+    if ready:
+        return (
+            f"⚠️ {len(ready)}/{len(accel)} accelerator nodes Ready "
+            f"({ready_chips}/{total_chips} chips)."
+        )
+    return f"❌ 0/{len(accel)} accelerator nodes Ready (0/{total_chips} chips)."
+
+
+def _node_entry(n: NodeInfo) -> dict:
+    d = n.to_dict()
+    # Drop-in aliases for the reference schema (check-gpu-node.py:273-279).
+    d["gpus"] = n.accelerators
+    d["gpu_breakdown"] = dict(n.breakdown)
+    return d
+
+
+def build_json_payload(
+    accel: Sequence[NodeInfo],
+    ready: Sequence[NodeInfo],
+    slices: Sequence[SliceInfo],
+    timings_ms: Optional[Dict[str, float]] = None,
+    error: Optional[str] = None,
+) -> dict:
+    payload = {
+        "total_nodes": len(accel),
+        "ready_nodes": len(ready),
+        "total_chips": sum(n.accelerators for n in accel),
+        "ready_chips": sum(n.accelerators for n in ready),
+        "nodes": [_node_entry(n) for n in accel],
+        "slices": [s.to_dict() for s in slices],
+    }
+    if timings_ms is not None:
+        payload["timings_ms"] = timings_ms
+    if error is not None:
+        payload["error"] = error
+    return payload
+
+
+def dumps(payload: dict) -> str:
+    """Match the reference's serialization options (check-gpu-node.py:273:
+    ``ensure_ascii=False, indent=2``)."""
+    return json.dumps(payload, ensure_ascii=False, indent=2)
+
+
+def error_payload(message: str) -> str:
+    """Machine-readable error object for --json mode (check-gpu-node.py:321-322)."""
+    return json.dumps({"error": message}, ensure_ascii=False)
+
+
+def format_slack_message(
+    accel: Sequence[NodeInfo],
+    ready: Sequence[NodeInfo],
+    slices: Sequence[SliceInfo] = (),
+    healthy: Optional[bool] = None,
+) -> str:
+    """Slack mrkdwn message.
+
+    Preserves the reference's structure (format_slack_message,
+    check-gpu-node.py:114-139): tri-state ✅/⚠️/❌ header, then per-node
+    bullets with backticked names and per-key breakdown — and appends
+    slice-status lines for TPU slices.  The header honors the *overall*
+    check outcome when given (``healthy``), so a strict-slice or probe
+    failure can't be reported under a ✅ banner; ``healthy=None`` falls back
+    to the reference's ready>0 rule.
+    """
+    if healthy is None:
+        healthy = bool(ready)
+    if ready and healthy:
+        header = "✅ *Accelerator node check: OK*"
+    elif ready:
+        header = "⚠️ *Accelerator node check: degraded (slice incomplete or chip probe failed)*"
+    elif accel:
+        header = "⚠️ *Accelerator node check: nodes found but none Ready*"
+    else:
+        header = "❌ *Accelerator node check: no accelerator nodes*"
+    lines: List[str] = [header, summary_line(accel, ready)]
+    for n in accel:
+        keys = ", ".join(f"{k}:{v}" for k, v in sorted(n.breakdown.items()))
+        line = f"• `{n.name}`: {_status(n)}, devices: {n.accelerators} ({keys})"
+        if n.probe is not None and not n.probe.get("ok"):
+            line += " — chip probe FAILED"
+        lines.append(line)
+    for s in slices:
+        expected = s.expected_chips or s.chips
+        state = "complete" if s.complete else "DEGRADED"
+        lines.append(
+            f"• slice `{s.nodepool or s.accelerator or '?'}` "
+            f"[{s.accelerator or '?'} {s.topology or '?'}]: "
+            f"{s.ready_chips}/{expected} chips, {state}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,40 @@
+"""Minimal ``.env`` loader.
+
+The reference calls ``dotenv.load_dotenv()`` at entry (check-gpu-node.py:331;
+template ``.env-template:1`` holds ``SLACK_WEBHOOK_URL``).  ``python-dotenv``
+is not a baked-in dependency here, and the needed subset is ~20 lines, so the
+framework ships its own: ``KEY=VALUE`` lines, ``#`` comments, optional
+``export`` prefix, single/double quote stripping, and — like the upstream
+default — existing environment variables are **not** overridden.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def load_dotenv(path: str = ".env") -> bool:
+    """Load ``path`` into ``os.environ`` (setdefault semantics). Returns
+    True iff the file existed."""
+    if not os.path.isfile(path):
+        return False
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            if line.startswith("export "):
+                line = line[len("export ") :]
+            key, _, value = line.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if len(value) >= 2 and value[0] == value[-1] and value[0] in "'\"":
+                value = value[1:-1]
+            if key:
+                os.environ.setdefault(key, value)
+    return True
+
+
+def env_or(name: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(name, default)

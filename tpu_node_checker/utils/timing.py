@@ -1,0 +1,44 @@
+"""Phase timing for the <2 s latency budget.
+
+The reference has no timing at all (SURVEY §5.1); the build target demands the
+checker exit in <2 s on a v5e-256 slice, so the orchestrator times its phases
+(k8s LIST, detection, probe, notify, render) and surfaces them under
+``--debug`` and in the ``--json`` payload's ``timings_ms`` field.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator
+
+
+@dataclass
+class Phase:
+    name: str
+    elapsed_ms: float
+
+
+@dataclass
+class PhaseTimer:
+    """Collects named phase durations; cheap enough to always be on."""
+
+    phases: Dict[str, float] = field(default_factory=dict)
+    _start: float = field(default_factory=time.perf_counter)
+
+    @contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + (time.perf_counter() - t0) * 1e3
+
+    def total_ms(self) -> float:
+        return (time.perf_counter() - self._start) * 1e3
+
+    def as_dict(self) -> Dict[str, float]:
+        out = {k: round(v, 2) for k, v in self.phases.items()}
+        out["total"] = round(self.total_ms(), 2)
+        return out
