@@ -1,0 +1,107 @@
+"""Headline benchmark: end-to-end check latency on the north-star config.
+
+BASELINE.json metric: "detected TPU chips vs. node.allocatable ground truth;
+check latency p50 (ms)"; target: a v5e-256 slice (64 hosts × 4 chips)
+reported 256/256 Ready with exit 0 in under 2 s.
+
+The run is end-to-end through the real stack: a local HTTP server plays the
+Kubernetes API (serving a 64-node v5e-256 NodeList), the checker resolves a
+kubeconfig, makes its single LIST call over HTTP, parses, groups slices,
+builds the JSON payload, and decides the exit code.  p50 over repeated runs
+is reported; correctness (256/256 chips detected, exit 0) is asserted before
+any number is printed.
+
+Prints ONE JSON line:
+  {"metric": "check_latency_p50_ms", "value": <p50 ms>, "unit": "ms",
+   "vs_baseline": <2000 / p50>}   # >1.0 ⇔ faster than the 2 s target
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+
+def _fixture_nodes():
+    sys.path.insert(0, "tests")
+    import fixtures as fx
+
+    return fx.node_list(fx.tpu_v5e_256_slice())
+
+
+def _serve(payload: bytes):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def main() -> int:
+    payload = json.dumps(_fixture_nodes()).encode()
+    server = _serve(payload)
+    port = server.server_address[1]
+
+    kubeconfig = tempfile.NamedTemporaryFile(
+        "w", suffix=".kubeconfig", delete=False
+    )
+    kubeconfig.write(
+        f"""
+apiVersion: v1
+kind: Config
+current-context: bench
+contexts: [{{name: bench, context: {{cluster: bench, user: bench}}}}]
+clusters: [{{name: bench, cluster: {{server: "http://127.0.0.1:{port}"}}}}]
+users: [{{name: bench, user: {{token: bench-token}}}}]
+"""
+    )
+    kubeconfig.close()
+
+    from tpu_node_checker import checker, cli
+
+    args = cli.parse_args(["--kubeconfig", kubeconfig.name, "--json"])
+
+    # Correctness gate: the numbers mean nothing if detection is wrong.
+    result = checker.run_check(args)
+    assert result.exit_code == 0, result.exit_code
+    assert result.payload["total_chips"] == 256, result.payload["total_chips"]
+    assert result.payload["ready_chips"] == 256, result.payload["ready_chips"]
+    assert result.payload["slices"][0]["complete"] is True
+
+    latencies = []
+    for _ in range(41):
+        result = checker.run_check(args)
+        latencies.append(result.payload["timings_ms"]["total"])
+    p50 = statistics.median(latencies)
+
+    server.shutdown()
+    baseline_ms = 2000.0  # the <2 s north-star budget
+    print(
+        json.dumps(
+            {
+                "metric": "check_latency_p50_ms",
+                "value": round(p50, 2),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / p50, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,23 @@ class TestMatmulBurn:
         assert r.elapsed_ms > 0
 
 
+class TestPallasProbe:
+    def test_interpreted_matmul_matches_xla(self):
+        from tpu_node_checker.ops import pallas_matmul_probe
+
+        r = pallas_matmul_probe(m=256, k=256, n=256)
+        assert r.ok, r.error
+        assert r.interpreted  # CPU backend → interpreter mode
+        assert r.max_rel_err < 2e-2
+
+    def test_non_tile_shape_rejected_cleanly(self):
+        from tpu_node_checker.ops import pallas_matmul_probe
+
+        r = pallas_matmul_probe(m=100, k=100, n=100)  # not tile-divisible
+        assert not r.ok
+        assert "invalid shape" in r.error  # usage error, not a chip fault
+
+
 class TestHbmProbe:
     def test_bandwidth_positive(self):
         r = hbm_bandwidth_probe(mib=8, iters=2)

@@ -63,6 +63,14 @@ class TestComputeLevels:
         r = run_local_probe(level="collective", timeout_s=300)
         assert r.ok, r.error
         assert r.details.get("collective_ok") is True
+        assert r.details.get("ring_ok") is True
+
+    def test_workload_level(self):
+        r = run_local_probe(level="workload", timeout_s=600)
+        assert r.ok, r.error
+        assert r.details.get("workload_ok") is True
+        assert r.details.get("ring_attention_ok") is True
+        assert len(r.details.get("workload_losses", [])) >= 2
 
 
 class TestProbeWiring:

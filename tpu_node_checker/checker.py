@@ -97,7 +97,7 @@ def _run_probe(args, accel: List[NodeInfo], result: CheckResult) -> None:
     local = next((n for n in accel if n.name == hostname), None)
     probed = run_local_probe(
         level=getattr(args, "probe_level", "enumerate"),
-        timeout_s=getattr(args, "probe_timeout", None) or 20.0,
+        timeout_s=getattr(args, "probe_timeout", None),  # None → per-level budget
         expected_devices=local.accelerators if local else None,
     )
     if local is not None:

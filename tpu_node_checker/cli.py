@@ -63,9 +63,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     probe.add_argument("--probe", action="store_true",
                        help="probe this host's chips via jax.devices() in a sandboxed subprocess")
     probe.add_argument("--probe-level", choices=PROBE_LEVELS, default="enumerate",
-                       help="enumerate chips, run MXU/HBM compute, or also ICI collectives")
-    probe.add_argument("--probe-timeout", type=float, default=20.0,
-                       help="hard wall-clock timeout for the probe subprocess (s)")
+                       help="enumerate chips; add MXU/HBM/Pallas compute; add ICI "
+                       "collectives; or run a full sharded training step (workload)")
+    probe.add_argument("--probe-timeout", type=float, default=None,
+                       help="hard wall-clock timeout for the probe subprocess (s); "
+                       "default scales with --probe-level (30s enumerate … 600s workload)")
 
     # Same group/flags/defaults as the reference (check-gpu-node.py:304-309).
     slack = p.add_argument_group("Slack")

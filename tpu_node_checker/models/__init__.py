@@ -1,0 +1,31 @@
+"""Workload-level health probes: real SPMD training as the final health grade.
+
+The strongest statement a health checker can make about a TPU slice is "a
+real sharded training step ran on it and the loss went down".  This package
+provides that grade: a small but structurally realistic transformer
+(:mod:`tpu_node_checker.models.burnin`) whose forward/backward step is jitted
+over a ``jax.sharding.Mesh`` with data- and tensor-parallel shardings, so one
+step exercises the MXU (matmuls), HBM (activations/optimizer state), and ICI
+(GSPMD-inserted collectives) together — failures that only appear under
+combined load show up here and nowhere else.
+"""
+
+from tpu_node_checker.models.burnin import (
+    BurninConfig,
+    WorkloadResult,
+    forward,
+    init_params,
+    make_train_step,
+    param_specs,
+    workload_probe,
+)
+
+__all__ = [
+    "BurninConfig",
+    "WorkloadResult",
+    "forward",
+    "init_params",
+    "make_train_step",
+    "param_specs",
+    "workload_probe",
+]

@@ -1,0 +1,274 @@
+"""Burn-in workload: a sharded transformer training step as a health probe.
+
+TPU-first design decisions:
+
+* **Scanned layers** — layer parameters are stacked on a leading axis and the
+  block is applied with ``lax.scan``, so compile time is O(1) in depth and XLA
+  sees one fused layer body (no Python-unrolled graph blowup).
+* **bf16 activations, f32 params/optimizer** — the MXU's native regime; all
+  matmuls carry ``preferred_element_type=float32``.
+* **GSPMD sharding, not manual collectives** — parameters and the batch carry
+  ``PartitionSpec`` annotations over a ``Mesh`` with axes ``("data",
+  "model")``; XLA inserts the all-reduces/all-gathers over ICI.  The probe's
+  job is to make the compiler emit the same collective patterns a real
+  training job would, then check the numerics.
+* **Static shapes everywhere**; the causal mask is a compile-time constant.
+
+Health contract: :func:`workload_probe` runs a few steps and reports
+``ok = loss finite and strictly decreasing`` — a wedged chip or a corrupting
+ICI link breaks one of the two.
+
+The reference performs no computation at all (SURVEY §2.3); this subsystem is
+the TPU-native answer to "is the accelerator actually usable", the question
+kubelet Ready cannot answer.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class BurninConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    n_layers: int = 2
+    seq: int = 128
+    batch: int = 8
+    dtype: str = "bfloat16"  # activation dtype; params stay float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def act_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(key: jax.Array, cfg: BurninConfig) -> dict:
+    """Stacked-layer parameter pytree (leading axis = layer) in float32."""
+    k_emb, k_attn, k_mlp, k_out = jax.random.split(key, 4)
+
+    def dense(k, *shape, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(shape[-2])
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(jnp.float32)
+
+    L, D, F, V = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab
+    ka = jax.random.split(k_attn, 4)
+    km = jax.random.split(k_mlp, 2)
+    return {
+        "embed": dense(k_emb, V, D, scale=0.02),
+        "layers": {
+            "wq": dense(ka[0], L, D, D),
+            "wk": dense(ka[1], L, D, D),
+            "wv": dense(ka[2], L, D, D),
+            "wo": dense(ka[3], L, D, D),
+            "w1": dense(km[0], L, D, F),
+            "w2": dense(km[1], L, F, D),
+            "ln1": jnp.ones((L, D), jnp.float32),
+            "ln2": jnp.ones((L, D), jnp.float32),
+        },
+        "ln_f": jnp.ones((D,), jnp.float32),
+        "unembed": dense(k_out, D, V),
+    }
+
+
+def param_specs(cfg: BurninConfig) -> dict:
+    """PartitionSpecs mirroring :func:`init_params` — the tensor-parallel
+    layout: attention heads and the MLP hidden dim shard over ``"model"``;
+    layer norms replicate; the layer axis is never sharded (scan carries it).
+    """
+    return {
+        "embed": P(None, "model"),
+        "layers": {
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "w1": P(None, None, "model"),
+            "w2": P(None, "model", None),
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+        },
+        "ln_f": P(None),
+        "unembed": P(None, "model"),
+    }
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _attention(x: jax.Array, lp: dict, cfg: BurninConfig, mask: jax.Array) -> jax.Array:
+    B, S, D = x.shape
+    H, Hd = cfg.n_heads, cfg.head_dim
+    dt = cfg.act_dtype
+
+    def proj(w):
+        return jnp.dot(x, w.astype(dt), preferred_element_type=jnp.float32)
+
+    q = proj(lp["wq"]).reshape(B, S, H, Hd).astype(dt)
+    k = proj(lp["wk"]).reshape(B, S, H, Hd).astype(dt)
+    v = proj(lp["wv"]).reshape(B, S, H, Hd).astype(dt)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(Hd) + mask
+    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+    ctx = jnp.einsum("bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32)
+    ctx = ctx.reshape(B, S, D).astype(dt)
+    return jnp.dot(ctx, lp["wo"].astype(dt), preferred_element_type=jnp.float32).astype(dt)
+
+
+def _mlp(x: jax.Array, lp: dict, cfg: BurninConfig) -> jax.Array:
+    dt = cfg.act_dtype
+    h = jnp.dot(x, lp["w1"].astype(dt), preferred_element_type=jnp.float32)
+    h = jax.nn.gelu(h.astype(jnp.float32)).astype(dt)
+    return jnp.dot(h, lp["w2"].astype(dt), preferred_element_type=jnp.float32).astype(dt)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: BurninConfig) -> jax.Array:
+    """Token ids (B, S) → logits (B, S, V).  Layers applied via ``lax.scan``."""
+    dt = cfg.act_dtype
+    x = params["embed"].astype(dt)[tokens]
+    mask = jnp.where(
+        np.tril(np.ones((cfg.seq, cfg.seq), np.bool_)), 0.0, -1e9
+    ).astype(jnp.float32)[None, None, :, :]
+
+    def block(carry, lp):
+        h = carry
+        h = h + _attention(_layer_norm(h, lp["ln1"]), lp, cfg, mask)
+        h = h + _mlp(_layer_norm(h, lp["ln2"]), lp, cfg)
+        return h, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = _layer_norm(x, params["ln_f"])
+    return jnp.dot(
+        x, params["unembed"].astype(dt), preferred_element_type=jnp.float32
+    )
+
+
+def _loss(params: dict, tokens: jax.Array, cfg: BurninConfig) -> jax.Array:
+    """Next-token cross entropy (tokens double as inputs and shifted targets)."""
+    logits = forward(params, tokens, cfg)[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+def make_train_step(
+    cfg: BurninConfig,
+    mesh: Optional[Mesh] = None,
+    learning_rate: float = 1e-3,
+):
+    """Build (jitted train_step, init_fn).
+
+    With a mesh, parameters/optimizer state follow :func:`param_specs` and the
+    batch shards over ``"data"`` — XLA's GSPMD partitioner inserts the ICI
+    collectives (gradient all-reduce over "data", activation collectives over
+    "model").  Without a mesh everything stays single-device (probe level for
+    one chip).
+    """
+    tx = optax.adam(learning_rate)
+
+    def init_fn(key: jax.Array):
+        params = init_params(key, cfg)
+        opt_state = tx.init(params)
+        return params, opt_state
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(_loss)(params, tokens, cfg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step), init_fn
+
+    specs = param_specs(cfg)
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    data_sh = NamedSharding(mesh, P("data", None))
+
+    # Optimizer-state shardings are inferred from the arguments
+    # (in_shardings=None): adam moments are built with zeros_like over already-
+    # sharded params in sharded_init, so they inherit the parameter layout.
+    sharded_step = jax.jit(
+        step,
+        in_shardings=(param_sh, None, data_sh),
+        out_shardings=(param_sh, None, None),
+    )
+
+    def sharded_init(key: jax.Array):
+        params = jax.device_put(init_params(key, cfg), param_sh)
+        opt_state = tx.init(params)
+        return params, opt_state
+
+    return sharded_step, sharded_init
+
+
+@dataclass
+class WorkloadResult:
+    ok: bool
+    losses: Tuple[float, ...] = field(default_factory=tuple)
+    step_time_ms: float = 0.0
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d = {"ok": self.ok, "losses": list(self.losses), "step_time_ms": self.step_time_ms}
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+def workload_probe(
+    cfg: Optional[BurninConfig] = None,
+    mesh: Optional[Mesh] = None,
+    steps: int = 3,
+    seed: int = 0,
+) -> WorkloadResult:
+    """Run ``steps`` training steps; healthy ⇔ finite, strictly decreasing loss."""
+    try:
+        cfg = cfg or BurninConfig()
+        step, init_fn = make_train_step(cfg, mesh)
+        key = jax.random.PRNGKey(seed)
+        params, opt_state = init_fn(key)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(seed + 1), (cfg.batch, cfg.seq), 0, cfg.vocab
+        )
+        if mesh is not None:
+            tokens = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+        losses = []
+        t0 = None
+        for i in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))  # host sync each step
+            if i == 0:
+                t0 = time.perf_counter()  # steady-state timing after compile
+        elapsed_ms = (
+            (time.perf_counter() - t0) / max(steps - 1, 1) * 1e3 if t0 else 0.0
+        )
+        finite = all(np.isfinite(l) for l in losses)
+        decreasing = all(b < a for a, b in zip(losses, losses[1:]))
+        ok = finite and decreasing
+        err = None
+        if not finite:
+            err = f"non-finite loss: {losses}"
+        elif not decreasing:
+            err = f"loss not decreasing: {losses}"
+        return WorkloadResult(ok=ok, losses=tuple(losses), step_time_ms=elapsed_ms, error=err)
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return WorkloadResult(ok=False, error=f"{type(exc).__name__}: {exc}")

@@ -19,6 +19,12 @@ from tpu_node_checker.parallel.collectives import (
     collective_probe,
     ring_probe,
 )
+from tpu_node_checker.parallel.ring_attention import (
+    RingAttentionResult,
+    make_ring_attention,
+    reference_causal_attention,
+    ring_attention_probe,
+)
 
 __all__ = [
     "MeshSpec",
@@ -27,4 +33,8 @@ __all__ = [
     "CollectiveResult",
     "collective_probe",
     "ring_probe",
+    "RingAttentionResult",
+    "make_ring_attention",
+    "reference_causal_attention",
+    "ring_attention_probe",
 ]

@@ -8,13 +8,16 @@ process holds the chips; the checker itself must stay inside the <2 s budget
 reports over a pipe as one JSON line; anything else — timeout, crash, OOM,
 import error — degrades to a structured failure, never an exception.
 
-Probe levels:
+Probe levels (each includes the previous):
 
-* ``enumerate`` — backend init + device enumeration (platform, chip count);
-* ``compute``   — plus an MXU matmul burn and HBM bandwidth sample on one chip
-                  (:mod:`tpu_node_checker.ops`);
-* ``collective`` — plus a psum over all local chips
-                  (:mod:`tpu_node_checker.parallel`), exercising intra-host ICI.
+* ``enumerate``  — backend init + device enumeration (platform, chip count);
+* ``compute``    — MXU matmul burn, HBM bandwidth sample, and a Pallas/Mosaic
+                   kernel cross-check on one chip (:mod:`tpu_node_checker.ops`);
+* ``collective`` — psum/all_gather and a ppermute ring walk over all local
+                   chips (:mod:`tpu_node_checker.parallel`), exercising ICI;
+* ``workload``   — a sharded transformer training step and a ring-attention
+                   pass (:mod:`tpu_node_checker.models`): the full stack under
+                   combined load, the strongest health grade.
 """
 
 from __future__ import annotations
@@ -27,8 +30,16 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-DEFAULT_TIMEOUT_S = 20.0
-LEVELS = ("enumerate", "compute", "collective")
+LEVELS = ("enumerate", "compute", "collective", "workload")
+# Per-level wall-clock budgets: each level compiles and runs strictly more
+# programs (first jit compile on TPU alone is ~20-40 s).
+LEVEL_TIMEOUTS_S = {
+    "enumerate": 30.0,
+    "compute": 180.0,
+    "collective": 300.0,
+    "workload": 600.0,
+}
+DEFAULT_TIMEOUT_S = LEVEL_TIMEOUTS_S["enumerate"]
 
 # The child script is spelled as a standalone -c program (not a fork) so the
 # parent process never imports jax and a wedged libtpu cannot leak into it.
@@ -46,20 +57,48 @@ try:
     out["process_index"] = jax.process_index()
     out["process_count"] = jax.process_count()
     out["ok"] = len(devices) > 0
-    if level in ("compute", "collective") and out["ok"]:
-        from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn
+    if level in ("compute", "collective", "workload") and out["ok"]:
+        from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn, pallas_matmul_probe
         burn = matmul_burn()
         out["matmul_tflops"] = round(burn.tflops, 3)
         out["matmul_ok"] = burn.ok
         hbm = hbm_bandwidth_probe()
         out["hbm_gbps"] = round(hbm.gbps, 2)
-        out["ok"] = out["ok"] and burn.ok
-    if level == "collective" and out["ok"]:
-        from tpu_node_checker.parallel import collective_probe
+        out["hbm_ok"] = hbm.ok
+        pallas = pallas_matmul_probe()
+        out["pallas_ok"] = pallas.ok
+        out["ok"] = out["ok"] and burn.ok and hbm.ok and pallas.ok
+    if level in ("collective", "workload") and out["ok"]:
+        from tpu_node_checker.parallel import collective_probe, ring_probe
         coll = collective_probe()
         out["collective_ok"] = coll.ok
         out["collective_latency_us"] = round(coll.latency_us, 1)
-        out["ok"] = out["ok"] and coll.ok
+        ring = ring_probe()
+        out["ring_ok"] = ring.ok
+        out["ok"] = out["ok"] and coll.ok and ring.ok
+    if level == "workload" and out["ok"]:
+        import jax as _jax
+        from tpu_node_checker.models import BurninConfig, workload_probe
+        from tpu_node_checker.parallel import MeshSpec, build_mesh, ring_attention_probe
+        # Shard the training step over ALL local chips (data x model mesh) so
+        # the strongest grade actually pushes GSPMD collectives over ICI; a
+        # single-chip host degenerates to mesh=None cleanly.
+        n_dev = len(_jax.devices())
+        cfg = BurninConfig()
+        mesh = None
+        if n_dev > 1:
+            model = 2 if n_dev % 2 == 0 else 1
+            data = n_dev // model
+            if cfg.batch % data == 0:
+                mesh = build_mesh(MeshSpec((("data", data), ("model", model))))
+        wl = workload_probe(cfg, mesh=mesh)
+        out["workload_ok"] = wl.ok
+        out["workload_devices"] = n_dev if mesh is not None else 1
+        out["workload_losses"] = [round(l, 4) for l in wl.losses]
+        out["workload_step_ms"] = round(wl.step_time_ms, 1)
+        ra = ring_attention_probe(seq_per_device=16)
+        out["ring_attention_ok"] = ra.ok
+        out["ok"] = out["ok"] and wl.ok and ra.ok
 except Exception as exc:  # noqa: BLE001 - the whole point is to catch anything
     out["error"] = f"{type(exc).__name__}: {exc}"
 out["elapsed_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
@@ -99,7 +138,7 @@ class ProbeResult:
 
 def run_local_probe(
     level: str = "enumerate",
-    timeout_s: float = DEFAULT_TIMEOUT_S,
+    timeout_s: Optional[float] = None,
     expected_devices: Optional[int] = None,
     python: Optional[str] = None,
 ) -> ProbeResult:
@@ -107,10 +146,13 @@ def run_local_probe(
 
     ``expected_devices`` (e.g. a node's ``google.com/tpu`` allocatable count)
     turns a *partial* enumeration into a failure: 3 of 4 chips alive is a sick
-    host even though ``jax.devices()`` succeeded.
+    host even though ``jax.devices()`` succeeded.  ``timeout_s=None`` picks
+    the per-level budget from :data:`LEVEL_TIMEOUTS_S`.
     """
     if level not in LEVELS:
         raise ValueError(f"unknown probe level {level!r}; expected one of {LEVELS}")
+    if timeout_s is None:
+        timeout_s = LEVEL_TIMEOUTS_S[level]
     hostname = os.environ.get("NODE_NAME") or os.uname().nodename
     t0 = time.perf_counter()
     try:
