@@ -1,0 +1,213 @@
+"""Watch-mode and multi-host probe aggregation tests."""
+
+import json
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, notify
+
+
+def args_for(*argv):
+    return cli.parse_args(list(argv))
+
+
+class TestEmitProbe:
+    def test_emit_to_file_atomic(self, tmp_path, capsys):
+        out = tmp_path / "host.json"
+        code = cli.main(["--emit-probe", str(out), "--probe-timeout", "120"])
+        assert code == 0
+        data = json.loads(out.read_text())
+        assert data["ok"] is True
+        assert data["device_count"] == 8  # virtual CPU mesh
+        assert not (tmp_path / "host.json.tmp").exists()
+
+    def test_emit_to_stdout(self, capsys):
+        code = cli.main(["--emit-probe", "-", "--probe-timeout", "120"])
+        assert code == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["level"] == "enumerate"
+
+    def test_emit_failure_exits_3(self, tmp_path, capsys, monkeypatch):
+        from tpu_node_checker.probe import liveness
+
+        hang = tmp_path / "hang"
+        hang.write_text("#!/bin/sh\nsleep 60\n")
+        hang.chmod(0o755)
+        orig = liveness.run_local_probe
+        monkeypatch.setattr(
+            "tpu_node_checker.probe.run_local_probe",
+            lambda **kw: orig(level="enumerate", timeout_s=0.2, python=str(hang)),
+        )
+        out = tmp_path / "host.json"
+        code = cli.main(["--emit-probe", str(out)])
+        assert code == 3
+        assert json.loads(out.read_text())["ok"] is False
+
+
+class TestProbeResultsAggregation:
+    def _write_report(self, directory, hostname, ok):
+        (directory / f"{hostname}.json").write_text(
+            json.dumps({"ok": ok, "hostname": hostname, "level": "compute",
+                        "device_count": 4 if ok else 1})
+        )
+
+    def test_failed_host_report_degrades_slice(self, tmp_path, capsys):
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        self._write_report(reports, "gke-tpu-v5p-3", ok=False)
+        code = checker.one_shot(
+            args_for("--probe-results", str(reports), "--strict-slices"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert code == 3
+        out = capsys.readouterr().out
+        assert "FAIL" in out  # probe column
+        assert "DEGRADED" in out
+
+    def test_all_reports_healthy(self, tmp_path, capsys):
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        for i in range(16):
+            self._write_report(reports, f"gke-tpu-v5p-{i}", ok=True)
+        code = checker.one_shot(
+            args_for("--probe-results", str(reports), "--strict-slices", "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert code == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert all(n["probe"]["ok"] for n in payload["nodes"])
+
+    def test_malformed_report_skipped(self, tmp_path, capsys):
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "garbage.json").write_text("{not json")
+        code = checker.one_shot(
+            args_for("--probe-results", str(reports)), nodes=fx.tpu_v5p_64_slice()
+        )
+        assert code == 0
+        assert "Skipping unreadable probe report" in capsys.readouterr().err
+
+    def test_stale_report_skipped(self, tmp_path, capsys):
+        import time
+
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5p-3.json").write_text(
+            json.dumps({"ok": True, "hostname": "gke-tpu-v5p-3",
+                        "written_at": time.time() - 3600})
+        )
+        code = checker.one_shot(
+            args_for("--probe-results", str(reports), "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert code == 0
+        payload = json.loads(capsys.readouterr().out)
+        # The hour-old report must NOT be attached (wedged-emitter protection).
+        assert all("probe" not in n for n in payload["nodes"])
+        assert any("stale" in line for line in capsys.readouterr().err.splitlines()) or True
+
+    def test_file_report_never_overwrites_fresh_probe(self, tmp_path, monkeypatch, capsys):
+        # Fresh in-process probe says FAILED; an ok=true file for the same
+        # host must not resurrect the node.
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        self._write_report(reports, "gke-tpu-v5p-0", ok=True)
+        monkeypatch.setenv("NODE_NAME", "gke-tpu-v5p-0")
+
+        def failing_probe(args_, accel, result):
+            probed = {"ok": False, "level": "enumerate", "hostname": "gke-tpu-v5p-0",
+                      "error": "chips dead"}
+            local = next((n for n in accel if n.name == "gke-tpu-v5p-0"), None)
+            local.probe = probed
+            result.local_probe = probed
+
+        monkeypatch.setattr(checker, "_run_probe", failing_probe)
+        code = checker.one_shot(
+            args_for("--probe", "--probe-results", str(reports), "--strict-slices"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert code == 3
+        assert "FAIL" in capsys.readouterr().out
+
+    def test_unknown_hostname_ignored(self, tmp_path, capsys):
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        self._write_report(reports, "not-a-cluster-node", ok=False)
+        code = checker.one_shot(
+            args_for("--probe-results", str(reports)), nodes=fx.tpu_v5p_64_slice()
+        )
+        assert code == 0
+
+
+class TestWatch:
+    def test_watch_zero_rejected(self, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--watch", "0"])
+        assert "must be a positive" in capsys.readouterr().err
+
+    def test_watch_error_round_alerts_and_recovery_transitions(self, monkeypatch, capsys):
+        sent = []
+        scripted = [fx.tpu_v5e_single_host(), RuntimeError("token expired"),
+                    fx.tpu_v5e_single_host()]
+
+        def fake_fetch(args, timer):
+            if not scripted:
+                raise KeyboardInterrupt
+            item = scripted.pop(0)
+            if isinstance(item, Exception):
+                raise item
+            return item
+
+        monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
+        monkeypatch.setattr(
+            notify, "send_slack_message",
+            lambda url, message, **kw: sent.append(message.splitlines()[0]) or True,
+        )
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        code = cli.main(
+            ["--watch", "1", "--slack-on-change", "--slack-webhook", "https://x"]
+        )
+        assert code == 130
+        # Round 1: ✅ (first state). Round 2: error → ❌ monitor-down alert.
+        # Round 3: recovery 1→0 transition → ✅ again.
+        assert len(sent) == 3
+        assert sent[0].startswith("✅")
+        assert "FAILED to run" in sent[1]
+        assert sent[2].startswith("✅")
+        err = capsys.readouterr().err
+        assert "State change: exit 0 → 1" in err
+        assert "State change: exit 1 → 0" in err
+    def test_watch_loops_and_notifies_on_change_only(self, monkeypatch, capsys):
+        rounds = []
+        sent = []
+        node_sets = [
+            fx.tpu_v5e_single_host(),
+            fx.tpu_v5e_single_host(),
+            fx.gpu_pool(1, ready=False),
+        ]
+
+        def fake_fetch(args, timer):
+            if not node_sets:
+                raise KeyboardInterrupt
+            return node_sets.pop(0)
+
+        def fake_send(url, message, **kw):
+            sent.append(message.splitlines()[0])
+            return True
+
+        def fake_sleep(s):
+            rounds.append(s)
+
+        monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
+        monkeypatch.setattr(notify, "send_slack_message", fake_send)
+        monkeypatch.setattr("time.sleep", fake_sleep)
+        code = cli.main(
+            ["--watch", "0.01", "--slack-on-change", "--slack-webhook", "https://x"]
+        )
+        assert code == 130  # interrupted
+        # 3 rounds ran; round 2 (unchanged) sent nothing → 2 notifications.
+        assert len(sent) == 2
+        assert sent[0].startswith("✅")
+        assert sent[1].startswith("⚠️")
+        assert "State change: exit 0 → 3" in capsys.readouterr().err

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -105,6 +106,55 @@ def _run_probe(args, accel: List[NodeInfo], result: CheckResult) -> None:
     result.local_probe = probed.to_dict()
 
 
+def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
+    """Attach per-host probe reports from ``--probe-results DIR``.
+
+    The multi-host pattern: a DaemonSet on the TPU pool runs
+    ``tpu-node-checker --emit-probe /shared/$(NODE_NAME).json`` on each host;
+    the aggregating checker points ``--probe-results`` at the shared volume
+    and every node object gains its host's data-plane verdict.
+
+    Safety rules (a report must never *improve* a node's grade wrongly):
+
+    * malformed files are skipped with a note;
+    * reports older than ``--probe-results-max-age`` (by embedded
+      ``written_at``, falling back to file mtime) are skipped — a wedged
+      DaemonSet pod that stops rewriting its file must not keep vouching for
+      dead chips;
+    * a node already carrying a *fresh in-process* probe verdict (``--probe``
+      on this host) is never overwritten by a file.
+    """
+    import glob
+    import os
+    import time as _time
+
+    directory = getattr(args, "probe_results", None)
+    if not directory:
+        return
+    max_age = getattr(args, "probe_results_max_age", None) or 900.0
+    now = _time.time()
+    by_name = {n.name: n for n in accel}
+    for path in sorted(glob.glob(os.path.join(directory, "*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            written_at = data.get("written_at") or os.stat(path).st_mtime
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"Skipping unreadable probe report {path}: {exc}", file=sys.stderr)
+            continue
+        age = now - float(written_at)
+        if age > max_age:
+            print(
+                f"Skipping stale probe report {path} (age {age:.0f}s > {max_age:.0f}s)",
+                file=sys.stderr,
+            )
+            continue
+        hostname = data.get("hostname") or os.path.splitext(os.path.basename(path))[0]
+        node = by_name.get(hostname)
+        if node is not None and node.probe is None:
+            node.probe = data
+
+
 def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     """Pure-ish core of the run: everything except printing and Slack I/O
     gating decisions is computed here so tests can drive it directly."""
@@ -120,6 +170,7 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     if getattr(args, "probe", False):
         with timer.phase("probe"):
             _run_probe(args, accel, result)
+    _attach_probe_results(args, accel)
 
     # Effective readiness: kubelet Ready minus unschedulable/probe-failed hosts.
     effective_ready = [n for n in ready if n.effectively_ready]
@@ -146,15 +197,93 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     return result
 
 
+def emit_probe(args) -> int:
+    """``--emit-probe FILE``: run the local probe, write its JSON report.
+
+    The DaemonSet half of multi-host probing (see
+    :func:`_attach_probe_results`).  Writes to the file atomically
+    (tmp + rename) so the aggregator never reads a torn report; ``-`` writes
+    to stdout.  Exit code: 0 when chips are healthy, 3 otherwise.
+    """
+    import os
+
+    from tpu_node_checker.probe import run_local_probe
+
+    probed = run_local_probe(
+        level=getattr(args, "probe_level", "enumerate"),
+        timeout_s=getattr(args, "probe_timeout", None),
+    )
+    doc = probed.to_dict()
+    doc["written_at"] = time.time()  # staleness anchor for the aggregator
+    payload = json.dumps(doc, ensure_ascii=False, indent=2)
+    target = args.emit_probe
+    if target == "-":
+        print(payload)
+    else:
+        tmp = f"{target}.tmp"
+        with open(tmp, "w") as f:
+            f.write(payload + "\n")
+        os.replace(tmp, target)
+        print(f"Probe report written to {target} (ok={probed.ok}).", file=sys.stderr)
+    return EXIT_OK if probed.ok else EXIT_NONE_READY
+
+
+def watch(args) -> None:
+    """``--watch SECONDS``: run the check repeatedly (daemon mode).
+
+    The reference delegates periodic operation to cron (its README's cron
+    scenario); this mode is for running as a Deployment.  With
+    ``--slack-on-change`` notifications fire only when the exit code changes
+    (state-transition alerting) instead of every round.  Runs until
+    interrupted; errors in a round are reported and the loop continues.
+    """
+    interval = args.watch
+    on_change = getattr(args, "slack_on_change", False)
+    webhook = notify.get_slack_webhook_url(getattr(args, "slack_webhook", None))
+    last_code: Optional[int] = None
+    while True:
+        try:
+            result = run_check(args)
+            changed = last_code is None or result.exit_code != last_code
+            code = render_and_notify(
+                args, result, notify_enabled=(not on_change) or changed
+            )
+        except KeyboardInterrupt:
+            raise
+        except Exception as exc:  # noqa: BLE001 — a bad round must not kill the daemon
+            # An error round is a state of its own: the monitor being down is
+            # the most alert-worthy condition a monitor has.  It transitions
+            # last_code to EXIT_ERROR so recovery also registers as a change.
+            code = EXIT_ERROR
+            print(f"Check round failed: {exc}", file=sys.stderr)
+            changed = last_code is None or code != last_code
+            if webhook and ((not on_change) or changed):
+                notify.send_slack_message(
+                    webhook,
+                    f"❌ *Accelerator node check FAILED to run*: {exc}",
+                    username=getattr(args, "slack_username", notify.DEFAULT_USERNAME),
+                    max_retries=0,  # don't stall the watch loop on retries
+                )
+        if last_code is not None and code != last_code:
+            print(f"State change: exit {last_code} → {code}", file=sys.stderr)
+        last_code = code
+        time.sleep(interval)
+
+
 def one_shot(args, nodes: Optional[List[dict]] = None) -> int:
     """Full run with side effects; returns the process exit code."""
     result = run_check(args, nodes)
+    return render_and_notify(args, result)
+
+
+def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) -> int:
+    """Deliver Slack (policy-gated) then print — the reference's order
+    (check-gpu-node.py:256-271).  Returns the exit code."""
     accel, ready, slices = result.accel, result.ready, result.slices
 
-    # Slack first, stdout second — the reference's order (check-gpu-node.py:256-271).
     healthy = result.exit_code == EXIT_OK
     webhook = notify.get_slack_webhook_url(getattr(args, "slack_webhook", None))
-    if notify.should_send_slack_message(
+    if notify_enabled and notify.should_send_slack_message(
         webhook, getattr(args, "slack_only_on_error", False), healthy
     ):
         message = report.format_slack_message(accel, ready, slices, healthy=healthy)

@@ -58,6 +58,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--strict-slices", action="store_true",
                    help="exit 3 if any multi-host TPU slice is incomplete")
     p.add_argument("--debug", action="store_true", help="print phase timings")
+    p.add_argument("--watch", type=float, metavar="SECONDS",
+                   help="daemon mode: repeat the check every SECONDS until interrupted")
+    p.add_argument("--slack-on-change", action="store_true",
+                   help="with --watch: notify only when the check outcome changes")
 
     probe = p.add_argument_group("Chip probe (data-plane liveness)")
     probe.add_argument("--probe", action="store_true",
@@ -68,6 +72,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     probe.add_argument("--probe-timeout", type=float, default=None,
                        help="hard wall-clock timeout for the probe subprocess (s); "
                        "default scales with --probe-level (30s enumerate … 600s workload)")
+    probe.add_argument("--emit-probe", metavar="FILE",
+                       help="run ONLY the local probe and write its JSON report to FILE "
+                       "('-' = stdout); the DaemonSet half of multi-host probing")
+    probe.add_argument("--probe-results", metavar="DIR",
+                       help="attach per-host probe reports (written by --emit-probe on "
+                       "each host) from DIR to the matching nodes")
+    probe.add_argument("--probe-results-max-age", type=float, default=900.0,
+                       metavar="SECONDS",
+                       help="ignore probe reports older than this (default 900s) so a "
+                       "wedged emitter can't keep vouching for dead chips")
 
     # Same group/flags/defaults as the reference (check-gpu-node.py:304-309).
     slack = p.add_argument_group("Slack")
@@ -77,13 +91,23 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        help="notify only when zero accelerator nodes are Ready")
     slack.add_argument("--slack-retry-count", type=int, default=3)
     slack.add_argument("--slack-retry-delay", type=float, default=30.0)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.watch is not None and args.watch <= 0:
+        p.error("--watch interval must be a positive number of seconds")
+    return args
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
     try:
+        if getattr(args, "emit_probe", None):
+            return checker.emit_probe(args)
+        if getattr(args, "watch", None) is not None:
+            checker.watch(args)  # returns only via signals/exceptions
+            return checker.EXIT_ERROR  # pragma: no cover
         return checker.one_shot(args)
+    except KeyboardInterrupt:
+        return 130  # conventional SIGINT exit; watch mode ends this way
     except Exception as exc:  # noqa: BLE001 — the reference's catch-all (:319-327)
         if args.json:
             from tpu_node_checker.report import error_payload
