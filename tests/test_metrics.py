@@ -1,0 +1,142 @@
+"""Metrics endpoint, JSONL state log, and instance-type inference tests."""
+
+import json
+import urllib.request
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+from tpu_node_checker.detect import chips_per_host_from_instance_type, extract_node_info, group_slices
+from tpu_node_checker.metrics import MetricsServer, render_metrics
+
+
+def args_for(*argv):
+    return cli.parse_args(list(argv))
+
+
+class TestRenderMetrics:
+    def _result(self, nodes, *extra):
+        return checker.run_check(args_for(*extra), nodes=nodes)
+
+    def test_families_present(self):
+        text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
+        assert 'tpu_node_checker_nodes{state="ready"} 64' in text
+        assert 'tpu_node_checker_chips{state="total"} 256' in text
+        assert 'tpu_node_checker_slice_complete{nodepool="v5e-256-pool",topology="16x16"} 1.0' in text
+        assert "tpu_node_checker_exit_code 0" in text
+        assert "# TYPE tpu_node_checker_nodes gauge" in text
+
+    def test_degraded_slice_zero(self):
+        text = render_metrics(self._result(fx.tpu_v5p_64_slice(not_ready=2)))
+        assert 'tpu_node_checker_slice_complete{nodepool="v5p-pool",topology="4x4x4"} 0.0' in text
+        assert 'tpu_node_checker_slice_ready_chips{nodepool="v5p-pool",topology="4x4x4"} 56' in text
+
+    def test_label_escaping(self):
+        nodes = fx.tpu_v5e_single_host()
+        nodes[0]["metadata"]["labels"]["cloud.google.com/gke-nodepool"] = 'we"ird\npool'
+        text = render_metrics(self._result(nodes))
+        assert r'nodepool="we\"ird\npool"' in text
+
+
+class TestMetricsServer:
+    def test_serves_latest_result(self):
+        server = MetricsServer(0, host="127.0.0.1")
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            body = urllib.request.urlopen(url, timeout=5).read().decode()
+            assert "no check completed yet" in body
+            result = checker.run_check(args_for(), nodes=fx.tpu_v5e_256_slice())
+            server.update(result)
+            body = urllib.request.urlopen(url, timeout=5).read().decode()
+            assert 'tpu_node_checker_chips{state="ready"} 256' in body
+            assert (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics", timeout=5
+                ).status
+                == 200
+            )
+        finally:
+            server.close()
+
+    def test_unknown_path_404(self):
+        import urllib.error
+
+        server = MetricsServer(0, host="127.0.0.1")
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/nope", timeout=5
+                )
+                raised = False
+            except urllib.error.HTTPError as e:
+                raised = e.code == 404
+            assert raised
+        finally:
+            server.close()
+
+
+class TestStateLog:
+    def test_one_shot_appends(self, tmp_path, capsys):
+        log = tmp_path / "state.jsonl"
+        code = checker.one_shot(
+            args_for("--log-jsonl", str(log)), nodes=fx.tpu_v5p_64_slice(not_ready=1)
+        )
+        assert code == 0
+        entry = json.loads(log.read_text().strip())
+        assert entry["ready_chips"] == 60
+        assert entry["slices_complete"] == 0
+        assert entry["exit_code"] == 0
+        assert "ts" in entry and "duration_ms" in entry
+
+    def test_appends_accumulate(self, tmp_path, capsys):
+        log = tmp_path / "state.jsonl"
+        for _ in range(3):
+            checker.one_shot(args_for("--log-jsonl", str(log)), nodes=fx.gpu_pool(1))
+        assert len(log.read_text().splitlines()) == 3
+
+    def test_unwritable_log_not_fatal(self, capsys):
+        code = checker.one_shot(
+            args_for("--log-jsonl", "/nonexistent-dir/state.jsonl"),
+            nodes=fx.gpu_pool(1),
+        )
+        assert code == 0
+        assert "Cannot append state log" in capsys.readouterr().err
+
+
+class TestInstanceTypeInference:
+    def test_parse(self):
+        assert chips_per_host_from_instance_type("ct5lp-hightpu-4t") == 4
+        assert chips_per_host_from_instance_type("ct5lp-hightpu-8t") == 8
+        assert chips_per_host_from_instance_type("ct6e-standard-4t") == 4
+        assert chips_per_host_from_instance_type("n1-standard-8") is None
+        assert chips_per_host_from_instance_type(None) is None
+
+    def test_fully_dead_device_plugins_still_exit_3_with_expectations(self, capsys):
+        # Every host of the slice has a completely dead device plugin: no
+        # allocatable, no capacity, only the GKE TPU labels. The cluster must
+        # grade exit 3 (nodes exist, unusable) — not exit 2 — and the slice
+        # expectation must come from the machine type (ct5p-hightpu-4t → 4
+        # chips/host → 16 hosts for 4x4x4).
+        nodes = [
+            fx.make_node(
+                f"dead-{i}",
+                ready=True,  # kubelet happy, device plugin dead
+                allocatable={},
+                capacity={},
+                labels={
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+                    "cloud.google.com/gke-tpu-topology": "4x4x4",
+                    "cloud.google.com/gke-nodepool": "p",
+                    "node.kubernetes.io/instance-type": "ct5p-hightpu-4t",
+                },
+            )
+            for i in range(16)
+        ]
+        code = checker.one_shot(args_for("--json"), nodes=nodes)
+        assert code == 3
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["total_nodes"] == 16
+        assert payload["ready_nodes"] == 0
+        s = payload["slices"][0]
+        assert s["expected_hosts"] == 16
+        assert s["expected_chips"] == 64
+        assert s["complete"] is False

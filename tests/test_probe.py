@@ -89,7 +89,7 @@ class TestProbeWiring:
         # Force failure fast by pointing the probe at a sleeping child.
         import tpu_node_checker.checker as chk
 
-        def failing_probe(args_, accel, result):
+        def failing_probe(args_, accel, result, slices=()):
             from tpu_node_checker.probe import run_local_probe
 
             probed = run_local_probe(level="enumerate", timeout_s=0.1, python="/bin/sleep")

@@ -102,10 +102,11 @@ class TestProbeResultsAggregation:
             nodes=fx.tpu_v5p_64_slice(),
         )
         assert code == 0
-        payload = json.loads(capsys.readouterr().out)
+        captured = capsys.readouterr()
+        payload = json.loads(captured.out)
         # The hour-old report must NOT be attached (wedged-emitter protection).
         assert all("probe" not in n for n in payload["nodes"])
-        assert any("stale" in line for line in capsys.readouterr().err.splitlines()) or True
+        assert "Skipping stale probe report" in captured.err
 
     def test_file_report_never_overwrites_fresh_probe(self, tmp_path, monkeypatch, capsys):
         # Fresh in-process probe says FAILED; an ok=true file for the same
@@ -115,7 +116,7 @@ class TestProbeResultsAggregation:
         self._write_report(reports, "gke-tpu-v5p-0", ok=True)
         monkeypatch.setenv("NODE_NAME", "gke-tpu-v5p-0")
 
-        def failing_probe(args_, accel, result):
+        def failing_probe(args_, accel, result, slices=()):
             probed = {"ok": False, "level": "enumerate", "hostname": "gke-tpu-v5p-0",
                       "error": "chips dead"}
             local = next((n for n in accel if n.name == "gke-tpu-v5p-0"), None)
@@ -130,6 +131,26 @@ class TestProbeResultsAggregation:
         assert code == 3
         assert "FAIL" in capsys.readouterr().out
 
+    def test_required_coverage_degrades_missing_reports(self, tmp_path, capsys):
+        # Full-coverage mode: a stale report AND 15 report-less hosts all
+        # grade as probe-failed → nothing effectively Ready → exit 3.
+        import time
+
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5p-3.json").write_text(
+            json.dumps({"ok": True, "hostname": "gke-tpu-v5p-3",
+                        "written_at": time.time() - 3600})
+        )
+        code = checker.one_shot(
+            args_for("--probe-results", str(reports), "--probe-results-required", "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert code == 3
+        payload = json.loads(capsys.readouterr().out)
+        assert all(n["probe"]["ok"] is False for n in payload["nodes"])
+        assert payload["ready_chips"] == 0
+
     def test_unknown_hostname_ignored(self, tmp_path, capsys):
         reports = tmp_path / "reports"
         reports.mkdir()
@@ -138,6 +159,31 @@ class TestProbeResultsAggregation:
             args_for("--probe-results", str(reports)), nodes=fx.tpu_v5p_64_slice()
         )
         assert code == 0
+
+
+class TestEmitWatch:
+    def test_emit_probe_with_watch_loops(self, tmp_path, monkeypatch, capsys):
+        # DaemonSet pattern: --emit-probe --watch re-writes the report each
+        # round instead of exiting after one emission.
+        emissions = []
+        from tpu_node_checker.probe.liveness import ProbeResult
+
+        monkeypatch.setattr(
+            "tpu_node_checker.probe.run_local_probe",
+            lambda **kw: emissions.append(1)
+            or ProbeResult(ok=True, level="enumerate", hostname="h", elapsed_ms=1.0,
+                           device_count=8),
+        )
+
+        def fake_sleep(s):
+            if len(emissions) >= 3:
+                raise KeyboardInterrupt
+        monkeypatch.setattr("time.sleep", fake_sleep)
+        out = tmp_path / "h.json"
+        code = cli.main(["--emit-probe", str(out), "--watch", "1"])
+        assert code == 130
+        assert len(emissions) == 3
+        assert json.loads(out.read_text())["ok"] is True
 
 
 class TestWatch:

@@ -27,7 +27,7 @@ import json
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from tpu_node_checker import notify, report
 from tpu_node_checker.detect import NodeInfo, SliceInfo, group_slices, select_accelerator_nodes
@@ -79,7 +79,9 @@ def _fetch_nodes(args, timer: PhaseTimer) -> List[dict]:
         )
 
 
-def _run_probe(args, accel: List[NodeInfo], result: CheckResult) -> None:
+def _run_probe(
+    args, accel: List[NodeInfo], result: CheckResult, slices: Sequence[SliceInfo] = ()
+) -> None:
     """Attach the local chip probe to the matching node (or the payload).
 
     The probe speaks for the host it runs on (``NODE_NAME`` downward-API env
@@ -96,10 +98,19 @@ def _run_probe(args, accel: List[NodeInfo], result: CheckResult) -> None:
     # device count itself (run_local_probe's expected_devices check).
     hostname = os.environ.get("NODE_NAME") or os.uname().nodename
     local = next((n for n in accel if n.name == hostname), None)
+    distributed = getattr(args, "probe_distributed", False)
+    expected = local.accelerators if local else None
+    if distributed and local is not None:
+        # Global enumeration: the expectation is the whole slice's chip count.
+        for s in slices:
+            if any(h.name == local.name for h in s.hosts):
+                expected = s.expected_chips or s.chips
+                break
     probed = run_local_probe(
         level=getattr(args, "probe_level", "enumerate"),
         timeout_s=getattr(args, "probe_timeout", None),  # None → per-level budget
-        expected_devices=local.accelerators if local else None,
+        expected_devices=expected,
+        distributed=distributed,
     )
     if local is not None:
         local.probe = probed.to_dict()
@@ -153,6 +164,19 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
         node = by_name.get(hostname)
         if node is not None and node.probe is None:
             node.probe = data
+    if getattr(args, "probe_results_required", False):
+        # Coverage enforcement: every TPU node must carry a FRESH report.
+        # A host whose emitter wedged (stale report skipped above) or never
+        # reported is graded as probe-failed — without this, a dead emitter
+        # on a dead host would read as healthy.
+        for node in accel:
+            if node.is_tpu and node.probe is None:
+                node.probe = {
+                    "ok": False,
+                    "level": "missing",
+                    "hostname": node.name,
+                    "error": f"no fresh probe report in {directory}",
+                }
 
 
 def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
@@ -169,7 +193,7 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
 
     if getattr(args, "probe", False):
         with timer.phase("probe"):
-            _run_probe(args, accel, result)
+            _run_probe(args, accel, result, slices)
     _attach_probe_results(args, accel)
 
     # Effective readiness: kubelet Ready minus unschedulable/probe-failed hosts.
@@ -212,6 +236,7 @@ def emit_probe(args) -> int:
     probed = run_local_probe(
         level=getattr(args, "probe_level", "enumerate"),
         timeout_s=getattr(args, "probe_timeout", None),
+        distributed=getattr(args, "probe_distributed", False),
     )
     doc = probed.to_dict()
     doc["written_at"] = time.time()  # staleness anchor for the aggregator
@@ -240,22 +265,26 @@ def watch(args) -> None:
     interval = args.watch
     on_change = getattr(args, "slack_on_change", False)
     webhook = notify.get_slack_webhook_url(getattr(args, "slack_webhook", None))
+    metrics_server = None
+    if getattr(args, "metrics_port", None) is not None:
+        from tpu_node_checker.metrics import MetricsServer
+
+        metrics_server = MetricsServer(args.metrics_port)
+        print(f"Serving /metrics on port {metrics_server.port}", file=sys.stderr)
     last_code: Optional[int] = None
     while True:
+        # The try covers ONLY the check itself: a failure here means "the
+        # monitor is down" — a state of its own (EXIT_ERROR) so that recovery
+        # also registers as a transition.  Render/notify problems afterwards
+        # are reported but do not reclassify a successful round.
         try:
             result = run_check(args)
-            changed = last_code is None or result.exit_code != last_code
-            code = render_and_notify(
-                args, result, notify_enabled=(not on_change) or changed
-            )
         except KeyboardInterrupt:
             raise
         except Exception as exc:  # noqa: BLE001 — a bad round must not kill the daemon
-            # An error round is a state of its own: the monitor being down is
-            # the most alert-worthy condition a monitor has.  It transitions
-            # last_code to EXIT_ERROR so recovery also registers as a change.
             code = EXIT_ERROR
             print(f"Check round failed: {exc}", file=sys.stderr)
+            _append_state_log(args, None, error=str(exc))
             changed = last_code is None or code != last_code
             if webhook and ((not on_change) or changed):
                 notify.send_slack_message(
@@ -264,15 +293,58 @@ def watch(args) -> None:
                     username=getattr(args, "slack_username", notify.DEFAULT_USERNAME),
                     max_retries=0,  # don't stall the watch loop on retries
                 )
+        else:
+            code = result.exit_code
+            if metrics_server is not None:
+                metrics_server.update(result)
+            _append_state_log(args, result)
+            changed = last_code is None or code != last_code
+            try:
+                render_and_notify(args, result, notify_enabled=(not on_change) or changed)
+            except Exception as exc:  # noqa: BLE001 — e.g. stdout pipe gone
+                print(f"Render/notify failed (check itself OK): {exc}", file=sys.stderr)
         if last_code is not None and code != last_code:
             print(f"State change: exit {last_code} → {code}", file=sys.stderr)
         last_code = code
         time.sleep(interval)
 
 
+def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] = None) -> None:
+    """``--log-jsonl FILE``: append one line per check round.
+
+    A durable trend record for post-incident analysis — when did the slice
+    degrade, how long was the API unreachable — that the print-based surface
+    (the reference's only observability, SURVEY §5.5) cannot answer.
+    """
+    path = getattr(args, "log_jsonl", None)
+    if not path:
+        return
+    entry: dict = {"ts": round(time.time(), 3)}
+    if result is not None:
+        p = result.payload
+        entry.update(
+            exit_code=result.exit_code,
+            total_nodes=p.get("total_nodes"),
+            ready_nodes=p.get("ready_nodes"),
+            total_chips=p.get("total_chips"),
+            ready_chips=p.get("ready_chips"),
+            slices_complete=sum(1 for s in p.get("slices", []) if s.get("complete")),
+            slices=len(p.get("slices", [])),
+            duration_ms=p.get("timings_ms", {}).get("total"),
+        )
+    else:
+        entry.update(exit_code=EXIT_ERROR, error=error)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(entry, ensure_ascii=False) + "\n")
+    except OSError as exc:
+        print(f"Cannot append state log {path}: {exc}", file=sys.stderr)
+
+
 def one_shot(args, nodes: Optional[List[dict]] = None) -> int:
     """Full run with side effects; returns the process exit code."""
     result = run_check(args, nodes)
+    _append_state_log(args, result)
     return render_and_notify(args, result)
 
 

@@ -62,6 +62,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="daemon mode: repeat the check every SECONDS until interrupted")
     p.add_argument("--slack-on-change", action="store_true",
                    help="with --watch: notify only when the check outcome changes")
+    p.add_argument("--metrics-port", type=int, metavar="PORT",
+                   help="with --watch: serve Prometheus metrics on this port (0 = ephemeral)")
+    p.add_argument("--log-jsonl", metavar="FILE",
+                   help="append one JSON line per check round to FILE (trend log)")
 
     probe = p.add_argument_group("Chip probe (data-plane liveness)")
     probe.add_argument("--probe", action="store_true",
@@ -78,10 +82,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     probe.add_argument("--probe-results", metavar="DIR",
                        help="attach per-host probe reports (written by --emit-probe on "
                        "each host) from DIR to the matching nodes")
+    probe.add_argument("--probe-distributed", action="store_true",
+                       help="join the jax.distributed rendezvous before enumerating, so "
+                       "the probe sees GLOBAL chips of a multi-host slice and its "
+                       "collectives cross hosts")
     probe.add_argument("--probe-results-max-age", type=float, default=900.0,
                        metavar="SECONDS",
                        help="ignore probe reports older than this (default 900s) so a "
                        "wedged emitter can't keep vouching for dead chips")
+    probe.add_argument("--probe-results-required", action="store_true",
+                       help="with --probe-results: grade any TPU node WITHOUT a fresh "
+                       "report as probe-failed (full DaemonSet coverage expected)")
 
     # Same group/flags/defaults as the reference (check-gpu-node.py:304-309).
     slack = p.add_argument_group("Slack")
@@ -101,6 +112,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
     try:
         if getattr(args, "emit_probe", None):
+            if args.watch is not None:
+                # Periodic re-emission — the DaemonSet pattern: keep the
+                # shared-volume report fresher than --probe-results-max-age.
+                import time as _time
+
+                while True:
+                    checker.emit_probe(args)
+                    _time.sleep(args.watch)
             return checker.emit_probe(args)
         if getattr(args, "watch", None) is not None:
             checker.watch(args)  # returns only via signals/exceptions

@@ -26,6 +26,7 @@ default registry.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -37,6 +38,22 @@ from tpu_node_checker.resources import AcceleratorMatch, ResourceRegistry, defau
 LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
 LABEL_NODEPOOL = "cloud.google.com/gke-nodepool"
+LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+
+_INSTANCE_CHIPS_RE = re.compile(r"-(\d+)t$")
+
+
+def chips_per_host_from_instance_type(instance_type: Optional[str]) -> Optional[int]:
+    """Chips per host from a GKE TPU machine type (``ct5lp-hightpu-4t`` → 4).
+
+    TPU machine types encode the per-host chip count as a trailing ``-<n>t``;
+    used as a fallback when NotReady hosts report no allocatable devices, so
+    slice expectations stay correct even with every host down.
+    """
+    if not instance_type:
+        return None
+    m = _INSTANCE_CHIPS_RE.search(instance_type)
+    return int(m.group(1)) if m else None
 
 
 def is_ready(node: dict) -> bool:
@@ -157,6 +174,13 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
     matches, schedulable = accelerator_allocatable(node, registry)
     breakdown = {m.key: m.count for m in matches}
     families = tuple(sorted({m.family for m in matches}))
+    if not matches and LABEL_TPU_ACCELERATOR in labels:
+        # The GKE label says this is a TPU host even though the device plugin
+        # advertises nothing (fully dead plugin): keep the node visible as an
+        # unschedulable TPU node so the cluster grades exit 3 ("nodes exist,
+        # none usable"), not exit 2 ("no accelerator nodes").
+        families = ("tpu",)
+        schedulable = False
     taints = [
         {"key": t.get("key"), "value": t.get("value"), "effect": t.get("effect")}
         for t in ((node.get("spec") or {}).get("taints") or [])
@@ -185,7 +209,9 @@ def select_accelerator_nodes(
     API call — the transport layer hands raw dicts in.
     """
     infos = [extract_node_info(n, registry) for n in nodes]
-    accel = [i for i in infos if i.accelerators > 0]
+    # TPU-labeled nodes stay visible even with zero advertised devices (dead
+    # device plugin) — they are accelerator nodes that cannot serve.
+    accel = [i for i in infos if i.accelerators > 0 or i.is_tpu]
     ready = [i for i in accel if i.ready and i.schedulable]
     return accel, ready
 
@@ -251,11 +277,30 @@ class SliceInfo:
 
     @property
     def expected_hosts(self) -> Optional[int]:
-        """Hosts the topology implies: expected chips / per-host chip count."""
+        """Hosts the topology implies: expected chips / per-host chip count.
+
+        Per-host count comes from the largest live allocatable report, with a
+        machine-type fallback (``ct5lp-hightpu-4t`` → 4) so a slice whose
+        hosts are all down — reporting zero allocatable — still has correct
+        expectations instead of disappearing from strictness checks.
+        """
         total = self.expected_chips
         if total is None or not self.hosts:
             return None
         per_host = max((h.accelerators for h in self.hosts), default=0)
+        if per_host <= 0:
+            per_host = (
+                max(
+                    (
+                        chips_per_host_from_instance_type(
+                            h.labels.get(LABEL_INSTANCE_TYPE)
+                        )
+                        or 0
+                        for h in self.hosts
+                    ),
+                    default=0,
+                )
+            )
         if per_host <= 0:
             return None
         return max(1, total // per_host)

@@ -1,0 +1,150 @@
+"""Prometheus-format metrics endpoint for watch mode.
+
+The reference's observability surface is print-based (SURVEY §5.5); for a
+daemonized checker the lingua franca is a ``/metrics`` scrape target.  This is
+a dependency-free implementation: a background ``http.server`` thread serving
+the latest check's gauges in Prometheus text exposition format.
+
+Exported metric families:
+
+* ``tpu_node_checker_nodes{state="total|ready"}`` — accelerator node counts;
+* ``tpu_node_checker_chips{state="total|ready"}`` — device counts;
+* ``tpu_node_checker_slice_complete{nodepool,topology}`` — per-slice 0/1;
+* ``tpu_node_checker_slice_ready_chips{nodepool,topology}`` / ``..._expected_chips``;
+* ``tpu_node_checker_exit_code`` — the would-be CLI exit code (0/2/3);
+* ``tpu_node_checker_check_duration_ms`` — end-to-end phase total;
+* ``tpu_node_checker_last_run_timestamp_seconds`` — staleness detector.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _line(name: str, value: float, labels: Optional[dict] = None) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{_escape(str(v))}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+def render_metrics(result) -> str:
+    """CheckResult → Prometheus text exposition (version 0.0.4)."""
+    lines: List[str] = []
+
+    def family(name: str, mtype: str, help_text: str, samples: List[Tuple[dict, float]]):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lines.append(_line(name, value, labels or None))
+
+    payload = result.payload
+    family(
+        "tpu_node_checker_nodes",
+        "gauge",
+        "Accelerator node counts by state.",
+        [({"state": "total"}, payload.get("total_nodes", 0)),
+         ({"state": "ready"}, payload.get("ready_nodes", 0))],
+    )
+    family(
+        "tpu_node_checker_chips",
+        "gauge",
+        "Accelerator device counts by state.",
+        [({"state": "total"}, payload.get("total_chips", 0)),
+         ({"state": "ready"}, payload.get("ready_chips", 0))],
+    )
+    slice_labels = lambda s: {  # noqa: E731
+        "nodepool": s.get("nodepool") or "", "topology": s.get("topology") or ""
+    }
+    slices = payload.get("slices", [])
+    family(
+        "tpu_node_checker_slice_complete",
+        "gauge",
+        "1 when every host the slice topology implies is effectively Ready.",
+        [(slice_labels(s), 1.0 if s.get("complete") else 0.0) for s in slices],
+    )
+    family(
+        "tpu_node_checker_slice_ready_chips",
+        "gauge",
+        "Effectively-Ready chips per slice.",
+        [(slice_labels(s), s.get("ready_chips", 0)) for s in slices],
+    )
+    family(
+        "tpu_node_checker_slice_expected_chips",
+        "gauge",
+        "Chips the slice topology label promises.",
+        [(slice_labels(s), s.get("expected_chips") or 0) for s in slices],
+    )
+    family(
+        "tpu_node_checker_exit_code",
+        "gauge",
+        "Exit code the equivalent one-shot run would return (0 ok, 2 none, 3 degraded).",
+        [({}, result.exit_code)],
+    )
+    family(
+        "tpu_node_checker_check_duration_ms",
+        "gauge",
+        "End-to-end duration of the last check.",
+        [({}, payload.get("timings_ms", {}).get("total", 0.0))],
+    )
+    family(
+        "tpu_node_checker_last_run_timestamp_seconds",
+        "gauge",
+        "Unix time of the last completed check (staleness detector).",
+        [({}, time.time())],
+    )
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Background /metrics endpoint fed by ``update(result)``."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        self._body = b"# tpu-node-checker: no check completed yet\n"
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # A stalled client must never block scrapes: threaded server +
+            # per-connection timeout.
+            timeout = 10
+
+            def do_GET(self):
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                with outer._lock:
+                    body = outer._body
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def update(self, result) -> None:
+        body = render_metrics(result).encode()
+        with self._lock:
+            self._body = body
+
+    def close(self) -> None:
+        self._server.shutdown()

@@ -49,8 +49,17 @@ level = sys.argv[1]
 out = {"ok": False, "level": level}
 t0 = time.perf_counter()
 try:
+    import os
     import jax
+    if os.environ.get("TNC_PROBE_DISTRIBUTED") == "1":
+        # Multi-host slice: join the jax.distributed rendezvous (TPU pods
+        # autodetect coordinator/process ids from the environment) so
+        # jax.devices() enumerates GLOBAL chips and collectives cross hosts
+        # over ICI/DCN.  Failure to rendezvous is itself a health failure.
+        jax.distributed.initialize()
+        out["distributed"] = True
     devices = jax.devices()
+    out["local_device_count"] = len(jax.local_devices())
     out["platform"] = devices[0].platform if devices else None
     out["device_count"] = len(devices)
     out["device_kinds"] = sorted({d.device_kind for d in devices})
@@ -141,6 +150,7 @@ def run_local_probe(
     timeout_s: Optional[float] = None,
     expected_devices: Optional[int] = None,
     python: Optional[str] = None,
+    distributed: bool = False,
 ) -> ProbeResult:
     """Probe this host's chips in a child process; never raises.
 
@@ -155,13 +165,16 @@ def run_local_probe(
         timeout_s = LEVEL_TIMEOUTS_S[level]
     hostname = os.environ.get("NODE_NAME") or os.uname().nodename
     t0 = time.perf_counter()
+    child_env = {**os.environ, "PYTHONPATH": _pythonpath()}
+    if distributed:
+        child_env["TNC_PROBE_DISTRIBUTED"] = "1"
     try:
         proc = subprocess.run(
             [python or sys.executable, "-c", _CHILD_SCRIPT, level],
             capture_output=True,
             text=True,
             timeout=timeout_s,
-            env={**os.environ, "PYTHONPATH": _pythonpath()},
+            env=child_env,
         )
     except subprocess.TimeoutExpired:
         return ProbeResult(
