@@ -1,0 +1,108 @@
+"""Property-based fuzzing of the detection core.
+
+The reference's defensive null-handling (check-gpu-node.py:173,184,203-211)
+is a behavior contract: *no* node object shape may crash the checker.  These
+properties throw arbitrary JSON-ish structures at the pure core and assert
+totality plus the invariants the exit-code contract rests on.
+"""
+
+import json
+
+from hypothesis import given, settings, strategies as st
+
+from tpu_node_checker.detect import (
+    extract_node_info,
+    group_slices,
+    is_ready,
+    select_accelerator_nodes,
+)
+from tpu_node_checker.utils.quantity import parse_quantity
+
+# JSON-ish scalars that could appear anywhere in a node object.
+scalars = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(10**18), max_value=10**18),
+    st.floats(allow_nan=True, allow_infinity=True),
+    st.text(max_size=30),
+)
+
+json_values = st.recursive(
+    scalars,
+    lambda children: st.one_of(
+        st.lists(children, max_size=4),
+        st.dictionaries(st.text(max_size=20), children, max_size=4),
+    ),
+    max_leaves=20,
+)
+
+# Node-shaped but with garbage in every slot.
+node_like = st.fixed_dictionaries(
+    {},
+    optional={
+        "metadata": st.one_of(
+            json_values,
+            st.fixed_dictionaries(
+                {},
+                optional={
+                    "name": scalars,
+                    "labels": st.dictionaries(st.text(max_size=40), scalars, max_size=5),
+                },
+            ),
+        ),
+        "spec": st.one_of(
+            json_values,
+            st.fixed_dictionaries({}, optional={"taints": st.lists(json_values, max_size=3)}),
+        ),
+        "status": st.one_of(
+            json_values,
+            st.fixed_dictionaries(
+                {},
+                optional={
+                    "allocatable": st.dictionaries(st.text(max_size=40), scalars, max_size=6),
+                    "capacity": st.dictionaries(st.text(max_size=40), scalars, max_size=6),
+                    "conditions": st.lists(json_values, max_size=3),
+                },
+            ),
+        ),
+    },
+)
+
+
+def _normalize(node):
+    """Keep inputs JSON-shaped: dict at top level, like a real API response."""
+    return node if isinstance(node, dict) else {"metadata": node}
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.lists(node_like, max_size=6))
+def test_pipeline_is_total(nodes):
+    """No input shape may raise; all invariants of the analyzed output hold."""
+    nodes = [_normalize(n) for n in nodes]
+    try:
+        accel, ready = select_accelerator_nodes(nodes)
+    except (TypeError, AttributeError) as exc:  # defensive contract violated
+        raise AssertionError(f"detection crashed on {json.dumps(nodes, default=str)[:500]}: {exc}")
+    assert set(map(id, ready)) <= set(map(id, accel))
+    for info in accel:
+        assert info.accelerators >= 0
+        assert sum(info.breakdown.values()) == info.accelerators or info.accelerators == 0
+        d = info.to_dict()
+        json.dumps(d)  # payload must always be serializable
+    slices = group_slices(accel)
+    for s in slices:
+        assert 0 <= len(s.ready_hosts) <= len(s.hosts)
+        json.dumps(s.to_dict())
+
+
+@settings(max_examples=300, deadline=None)
+@given(node_like)
+def test_is_ready_total(node):
+    assert is_ready(_normalize(node)) in (True, False)
+
+
+@settings(max_examples=500, deadline=None)
+@given(scalars)
+def test_parse_quantity_total(raw):
+    out = parse_quantity(raw)
+    assert out is None or isinstance(out, int)

@@ -21,14 +21,56 @@ class TestRenderMetrics:
         text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
         assert 'tpu_node_checker_nodes{state="ready"} 64' in text
         assert 'tpu_node_checker_chips{state="total"} 256' in text
-        assert 'tpu_node_checker_slice_complete{nodepool="v5e-256-pool",topology="16x16"} 1.0' in text
+        assert ('tpu_node_checker_slice_complete{nodepool="v5e-256-pool",'
+                'slice="v5e-256-pool",topology="16x16"} 1.0') in text
         assert "tpu_node_checker_exit_code 0" in text
         assert "# TYPE tpu_node_checker_nodes gauge" in text
 
     def test_degraded_slice_zero(self):
         text = render_metrics(self._result(fx.tpu_v5p_64_slice(not_ready=2)))
-        assert 'tpu_node_checker_slice_complete{nodepool="v5p-pool",topology="4x4x4"} 0.0' in text
-        assert 'tpu_node_checker_slice_ready_chips{nodepool="v5p-pool",topology="4x4x4"} 56' in text
+        assert ('tpu_node_checker_slice_complete{nodepool="v5p-pool",'
+                'slice="v5p-pool",topology="4x4x4"} 0.0') in text
+        assert ('tpu_node_checker_slice_ready_chips{nodepool="v5p-pool",'
+                'slice="v5p-pool",topology="4x4x4"} 56') in text
+
+    def test_single_host_slice_pool_unique_series(self):
+        # N single-host slices in one pool share nodepool+topology; the
+        # "slice" label must keep every series unique or Prometheus drops
+        # the whole scrape as duplicate samples.
+        nodes = [
+            fx.make_node(
+                f"oneh-{i}",
+                allocatable={"google.com/tpu": "4"},
+                labels={
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-device",
+                    "cloud.google.com/gke-tpu-topology": "2x2",
+                    "cloud.google.com/gke-nodepool": "onehost",
+                },
+            )
+            for i in range(3)
+        ]
+        text = render_metrics(self._result(nodes))
+        complete_lines = [
+            l for l in text.splitlines()
+            if l.startswith("tpu_node_checker_slice_complete{")
+        ]
+        assert len(complete_lines) == 3
+        assert len(set(complete_lines)) == 3  # all series distinct
+        assert 'slice="oneh-0"' in text
+
+    def test_mark_error_flips_exit_code_keeps_gauges(self):
+        from tpu_node_checker.metrics import MetricsServer
+
+        server = MetricsServer(0, host="127.0.0.1")
+        try:
+            server.update(self._result(fx.tpu_v5e_256_slice()))
+            server.mark_error(1)
+            body = server._body.decode()
+            assert "tpu_node_checker_exit_code 1" in body
+            assert 'tpu_node_checker_chips{state="ready"} 256' in body  # last known
+            assert "\ntpu_node_checker_last_run_timestamp_seconds " not in body
+        finally:
+            server.close()
 
     def test_label_escaping(self):
         nodes = fx.tpu_v5e_single_host()

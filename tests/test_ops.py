@@ -34,6 +34,29 @@ class TestPallasProbe:
         assert "invalid shape" in r.error  # usage error, not a chip fault
 
 
+class TestDmaProbe:
+    def test_double_buffered_stream_matches(self):
+        from tpu_node_checker.ops import dma_stream_probe
+
+        r = dma_stream_probe(rows=512, cols=128, chunk_rows=128)
+        assert r.ok, r.error
+        assert r.interpreted  # CPU backend → interpreter mode
+        assert r.gbps > 0
+
+    def test_single_chunk_edge(self):
+        from tpu_node_checker.ops import dma_stream_probe
+
+        r = dma_stream_probe(rows=128, cols=128, chunk_rows=128)
+        assert r.ok, r.error
+
+    def test_bad_chunking_rejected(self):
+        from tpu_node_checker.ops import dma_stream_probe
+
+        r = dma_stream_probe(rows=100, chunk_rows=64)
+        assert not r.ok
+        assert "multiple of chunk_rows" in r.error
+
+
 class TestHbmProbe:
     def test_bandwidth_positive(self):
         r = hbm_bandwidth_probe(mib=8, iters=2)

@@ -192,6 +192,42 @@ class TestWatch:
             cli.parse_args(["--watch", "0"])
         assert "must be a positive" in capsys.readouterr().err
 
+    def test_flag_combinations_validated(self, capsys):
+        for argv, fragment in [
+            (["--metrics-port", "9090"], "requires --watch"),
+            (["--slack-on-change"], "requires --watch"),
+            (["--probe-results-required"], "requires --probe-results"),
+        ]:
+            with pytest.raises(SystemExit):
+                cli.parse_args(argv)
+            assert fragment in capsys.readouterr().err
+
+    def test_emitter_loop_survives_bad_round(self, tmp_path, monkeypatch, capsys):
+        # A transient write failure (shared-volume blip) must not kill the
+        # emitter daemon.
+        rounds = []
+        from tpu_node_checker.probe.liveness import ProbeResult
+
+        def flaky_probe(**kw):
+            rounds.append(1)
+            if len(rounds) == 2:
+                raise OSError("Stale file handle")
+            return ProbeResult(ok=True, level="enumerate", hostname="h",
+                               elapsed_ms=1.0, device_count=8)
+
+        monkeypatch.setattr("tpu_node_checker.probe.run_local_probe", flaky_probe)
+
+        def fake_sleep(s):
+            if len(rounds) >= 3:
+                raise KeyboardInterrupt
+
+        monkeypatch.setattr("time.sleep", fake_sleep)
+        out = tmp_path / "h.json"
+        code = cli.main(["--emit-probe", str(out), "--watch", "1"])
+        assert code == 130
+        assert len(rounds) == 3  # the OSError round did not end the loop
+        assert "Probe emission failed" in capsys.readouterr().err
+
     def test_watch_error_round_alerts_and_recovery_transitions(self, monkeypatch, capsys):
         sent = []
         scripted = [fx.tpu_v5e_single_host(), RuntimeError("token expired"),

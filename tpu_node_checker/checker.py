@@ -284,6 +284,8 @@ def watch(args) -> None:
         except Exception as exc:  # noqa: BLE001 — a bad round must not kill the daemon
             code = EXIT_ERROR
             print(f"Check round failed: {exc}", file=sys.stderr)
+            if metrics_server is not None:
+                metrics_server.mark_error(EXIT_ERROR)
             _append_state_log(args, None, error=str(exc))
             changed = last_code is None or code != last_code
             if webhook and ((not on_change) or changed):

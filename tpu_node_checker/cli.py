@@ -105,6 +105,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     args = p.parse_args(argv)
     if args.watch is not None and args.watch <= 0:
         p.error("--watch interval must be a positive number of seconds")
+    if args.metrics_port is not None and args.watch is None:
+        p.error("--metrics-port requires --watch (one-shot runs serve no scrapes)")
+    if args.slack_on_change and args.watch is None:
+        p.error("--slack-on-change requires --watch")
+    if args.probe_results_required and not args.probe_results:
+        p.error("--probe-results-required requires --probe-results DIR")
     return args
 
 
@@ -115,10 +121,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.watch is not None:
                 # Periodic re-emission — the DaemonSet pattern: keep the
                 # shared-volume report fresher than --probe-results-max-age.
+                # One bad round (shared-volume blip) must not kill the
+                # emitter: a crash-looping pod lets the report go stale and
+                # a healthy host would grade as failed under
+                # --probe-results-required.
                 import time as _time
 
                 while True:
-                    checker.emit_probe(args)
+                    try:
+                        checker.emit_probe(args)
+                    except Exception as exc:  # noqa: BLE001
+                        print(f"Probe emission failed: {exc}", file=sys.stderr)
                     _time.sleep(args.watch)
             return checker.emit_probe(args)
         if getattr(args, "watch", None) is not None:

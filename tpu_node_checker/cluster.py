@@ -28,9 +28,10 @@ import os
 import subprocess
 import tempfile
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import TYPE_CHECKING, List, Optional, Tuple
 
-import requests
+if TYPE_CHECKING:  # pragma: no cover — requests is imported lazily at runtime
+    import requests
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 DEFAULT_KUBECONFIG = os.path.join(os.path.expanduser("~"), ".kube", "config")
@@ -226,9 +227,13 @@ class KubeClient:
     ``nodes: get,list`` — README.md:144-159 of the reference).
     """
 
-    def __init__(self, config: ClusterConfig, session: Optional[requests.Session] = None):
+    def __init__(self, config: ClusterConfig, session: Optional["requests.Session"] = None):
         self.config = config
-        self._session = session or requests.Session()
+        if session is None:
+            import requests  # lazy: offline (--nodes-json) runs never pay the import
+
+            session = requests.Session()
+        self._session = session
         self._session.verify = config.verify
         if config.client_cert:
             self._session.cert = config.client_cert

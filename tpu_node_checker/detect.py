@@ -50,20 +50,32 @@ def chips_per_host_from_instance_type(instance_type: Optional[str]) -> Optional[
     used as a fallback when NotReady hosts report no allocatable devices, so
     slice expectations stay correct even with every host down.
     """
-    if not instance_type:
+    if not instance_type or not isinstance(instance_type, str):
         return None
     m = _INSTANCE_CHIPS_RE.search(instance_type)
     return int(m.group(1)) if m else None
+
+
+def _as_dict(x) -> dict:
+    """Defensive coercion: the reference tolerates partially-populated node
+    objects (check-gpu-node.py:173,184,203-211); we go further and tolerate
+    *wrongly-typed* slots too — a checker must never crash on API garbage."""
+    return x if isinstance(x, dict) else {}
+
+
+def _as_list(x) -> list:
+    return x if isinstance(x, list) else []
 
 
 def is_ready(node: dict) -> bool:
     """True iff a NodeCondition has type=="Ready" and status=="True".
 
     Same rule as check-gpu-node.py:172-178, including the defensive defaults:
-    missing ``status``/``conditions`` → not ready.
+    missing (or malformed) ``status``/``conditions`` → not ready.
     """
-    conditions = (node.get("status") or {}).get("conditions") or []
+    conditions = _as_list(_as_dict(_as_dict(node).get("status")).get("conditions"))
     for cond in conditions:
+        cond = _as_dict(cond)
         if cond.get("type") == "Ready":
             return cond.get("status") == "True"
     return False
@@ -87,10 +99,12 @@ def accelerator_allocatable(
       is not effectively Ready.
     """
     registry = registry or default_registry()
-    status = node.get("status") or {}
+    status = _as_dict(_as_dict(node).get("status"))
     allocatable = status.get("allocatable")
     capacity = status.get("capacity")
-    if allocatable is None:
+    if not isinstance(capacity, dict):
+        capacity = None
+    if not isinstance(allocatable, dict):
         return registry.scan(capacity), True
     matches = registry.scan(allocatable)
     if matches:
@@ -169,8 +183,9 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
     Mirrors check-gpu-node.py:199-212 (name, ready, totals, breakdown, labels,
     taints) and additionally interprets the TPU topology labels.
     """
-    metadata = node.get("metadata") or {}
-    labels = metadata.get("labels") or {}
+    node = _as_dict(node)
+    metadata = _as_dict(node.get("metadata"))
+    labels = _as_dict(metadata.get("labels"))
     matches, schedulable = accelerator_allocatable(node, registry)
     breakdown = {m.key: m.count for m in matches}
     families = tuple(sorted({m.family for m in matches}))
@@ -183,10 +198,11 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         schedulable = False
     taints = [
         {"key": t.get("key"), "value": t.get("value"), "effect": t.get("effect")}
-        for t in ((node.get("spec") or {}).get("taints") or [])
+        for t in map(_as_dict, _as_list(_as_dict(node.get("spec")).get("taints")))
     ]
+    name = metadata.get("name")
     return NodeInfo(
-        name=metadata.get("name") or "",
+        name=name if isinstance(name, str) else "",
         ready=is_ready(node),
         accelerators=sum(breakdown.values()),
         breakdown=breakdown,
@@ -223,7 +239,7 @@ def select_accelerator_nodes(
 
 def parse_topology(topology: Optional[str]) -> Optional[Tuple[int, ...]]:
     """Parse a GKE topology label value like ``"2x2x1"`` or ``"16x16"``."""
-    if not topology:
+    if not topology or not isinstance(topology, str):
         return None
     try:
         dims = tuple(int(d) for d in topology.lower().split("x"))
@@ -255,6 +271,10 @@ class SliceInfo:
     topology: Optional[str]
     nodepool: Optional[str]
     hosts: List[NodeInfo] = field(default_factory=list)
+    # True when this is a degenerate one-host slice (topology fits on a single
+    # host); several of these can share a nodepool, so unique identity comes
+    # from the host name (see ``slice_id``).
+    single_host: bool = False
 
     @property
     def ready_hosts(self) -> List[NodeInfo]:
@@ -318,8 +338,17 @@ class SliceInfo:
             return bool(self.hosts) and len(self.ready_hosts) == len(self.hosts)
         return len(self.ready_hosts) >= expected
 
+    @property
+    def slice_id(self) -> str:
+        """Stable unique identifier: host name for single-host slices (many
+        can share one nodepool), nodepool otherwise."""
+        if self.single_host and self.hosts:
+            return self.hosts[0].name
+        return self.nodepool or (self.hosts[0].name if self.hosts else "?")
+
     def to_dict(self) -> dict:
         return {
+            "id": self.slice_id,
             "accelerator": self.accelerator,
             "topology": self.topology,
             "nodepool": self.nodepool,
@@ -360,6 +389,7 @@ def group_slices(infos: Sequence[NodeInfo]) -> List[SliceInfo]:
                 accelerator=info.tpu_accelerator,
                 topology=info.tpu_topology,
                 nodepool=info.nodepool,
+                single_host=key[0] == "__single__",
             )
         s.hosts.append(info)
     # Deterministic order: by nodepool then first host name.

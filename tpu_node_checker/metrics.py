@@ -35,7 +35,7 @@ def _line(name: str, value: float, labels: Optional[dict] = None) -> str:
     return f"{name} {value}"
 
 
-def render_metrics(result) -> str:
+def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
     """CheckResult → Prometheus text exposition (version 0.0.4)."""
     lines: List[str] = []
 
@@ -60,8 +60,12 @@ def render_metrics(result) -> str:
         [({"state": "total"}, payload.get("total_chips", 0)),
          ({"state": "ready"}, payload.get("ready_chips", 0))],
     )
+    # "slice" is the unique series key: several single-host slices can share
+    # one nodepool, and duplicate label sets would invalidate the whole scrape.
     slice_labels = lambda s: {  # noqa: E731
-        "nodepool": s.get("nodepool") or "", "topology": s.get("topology") or ""
+        "slice": s.get("id") or "",
+        "nodepool": s.get("nodepool") or "",
+        "topology": s.get("topology") or "",
     }
     slices = payload.get("slices", [])
     family(
@@ -85,8 +89,9 @@ def render_metrics(result) -> str:
     family(
         "tpu_node_checker_exit_code",
         "gauge",
-        "Exit code the equivalent one-shot run would return (0 ok, 2 none, 3 degraded).",
-        [({}, result.exit_code)],
+        "Exit code the equivalent one-shot run would return "
+        "(0 ok, 1 monitor error, 2 none, 3 degraded).",
+        [({}, result.exit_code if exit_code_override is None else exit_code_override)],
     )
     family(
         "tpu_node_checker_check_duration_ms",
@@ -143,6 +148,33 @@ class MetricsServer:
 
     def update(self, result) -> None:
         body = render_metrics(result).encode()
+        with self._lock:
+            self._body = body
+            self._last_result = result
+
+    def mark_error(self, exit_code: int = 1) -> None:
+        """A check round failed: surface it on the scrape.
+
+        Node/chip gauges keep their last-known values (the cluster state is
+        UNKNOWN, not zero) but ``exit_code`` flips so alerts on it fire, and
+        ``last_run_timestamp_seconds`` deliberately goes stale.
+        """
+        last = getattr(self, "_last_result", None)
+        if last is None:
+            body = (
+                "# HELP tpu_node_checker_exit_code Exit code (1 = monitor error).\n"
+                "# TYPE tpu_node_checker_exit_code gauge\n"
+                f"tpu_node_checker_exit_code {exit_code}\n"
+            ).encode()
+        else:
+            # Re-render WITHOUT refreshing the timestamp: drop that family's
+            # sample line so its staleness mirrors reality.
+            text = render_metrics(last, exit_code_override=exit_code)
+            body = "\n".join(
+                line
+                for line in text.splitlines()
+                if not line.startswith("tpu_node_checker_last_run_timestamp_seconds ")
+            ).encode() + b"\n"
         with self._lock:
             self._body = body
 

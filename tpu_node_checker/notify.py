@@ -22,8 +22,6 @@ import sys
 import time
 from typing import Callable, Optional
 
-import requests
-
 DEFAULT_USERNAME = "tpu-node-checker"
 DEFAULT_ICON = ":robot_face:"
 DEFAULT_TIMEOUT_S = 10.0
@@ -59,6 +57,8 @@ def should_send_slack_message(
 def _is_retryable(exc: Exception) -> bool:
     """Exactly the reference's classification (check-gpu-node.py:86-99):
     ConnectionError/Timeout AND the message names a reset/abort."""
+    import requests
+
     if not isinstance(exc, (requests.exceptions.ConnectionError, requests.exceptions.Timeout)):
         return False
     msg = str(exc)
@@ -79,7 +79,13 @@ def send_slack_message(
 
     ``sleep`` and ``post`` are injectable so tests can drive the retry state
     machine without wall-clock delays or a live webhook.
+
+    ``requests`` is imported lazily: the happy path of a check with no
+    webhook configured never pays its ~120 ms import cost (the <2 s budget
+    includes process startup).
     """
+    import requests
+
     post = post or requests.post
     payload = {"text": message, "username": username, "icon_emoji": DEFAULT_ICON}
     attempts = max_retries + 1

@@ -17,12 +17,15 @@ caller, not here.
 """
 
 from tpu_node_checker.ops.burn import BurnResult, matmul_burn
+from tpu_node_checker.ops.dma_probe import DmaProbeResult, dma_stream_probe
 from tpu_node_checker.ops.hbm import HbmResult, hbm_bandwidth_probe
 from tpu_node_checker.ops.pallas_probe import PallasProbeResult, pallas_matmul_probe
 
 __all__ = [
     "BurnResult",
     "matmul_burn",
+    "DmaProbeResult",
+    "dma_stream_probe",
     "HbmResult",
     "hbm_bandwidth_probe",
     "PallasProbeResult",

@@ -76,7 +76,11 @@ try:
         out["hbm_ok"] = hbm.ok
         pallas = pallas_matmul_probe()
         out["pallas_ok"] = pallas.ok
-        out["ok"] = out["ok"] and burn.ok and hbm.ok and pallas.ok
+        from tpu_node_checker.ops import dma_stream_probe
+        dma = dma_stream_probe()
+        out["dma_ok"] = dma.ok
+        out["dma_gbps"] = round(dma.gbps, 2)
+        out["ok"] = out["ok"] and burn.ok and hbm.ok and pallas.ok and dma.ok
     if level in ("collective", "workload") and out["ok"]:
         from tpu_node_checker.parallel import collective_probe, ring_probe
         coll = collective_probe()
@@ -111,7 +115,9 @@ try:
 except Exception as exc:  # noqa: BLE001 - the whole point is to catch anything
     out["error"] = f"{type(exc).__name__}: {exc}"
 out["elapsed_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
-print(json.dumps(out))
+# default= guards against numpy scalars (np.bool_/np.float32) sneaking into
+# probe sub-results — the report must always serialize.
+print(json.dumps(out, default=lambda o: o.item() if hasattr(o, "item") else str(o)))
 """
 
 
