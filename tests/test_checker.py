@@ -64,6 +64,69 @@ class TestExitCodeMatrix:
         assert captured.out == ""
 
 
+class TestExpectedChips:
+    """--expected-chips: cluster-level capacity assertion (SURVEY §5.6)."""
+
+    def test_met_exits_0(self, capsys):
+        nodes = fx.tpu_v5e_256_slice()
+        args = args_for("--expected-chips", "256", "--json")
+        result = checker.run_check(args, nodes=nodes)
+        assert result.exit_code == 0
+        assert result.payload["expected_chips"] == 256
+        assert result.payload["expected_chips_met"] is True
+
+    def test_short_exits_3(self, capsys):
+        # 63/64 hosts Ready → 252 chips: nodes are Ready, fleet is short.
+        nodes = fx.tpu_v5e_256_slice(not_ready=1)
+        args = args_for("--expected-chips", "256")
+        result = checker.run_check(args, nodes=nodes)
+        assert result.exit_code == 3
+        assert result.payload["expected_chips_met"] is False
+        assert checker.one_shot(args, nodes=nodes) == 3
+        assert "Expected ≥256" in capsys.readouterr().out
+
+    def test_no_accel_nodes_still_exit_2(self, capsys):
+        args = args_for("--expected-chips", "8")
+        assert checker.run_check(args, nodes=fx.cpu_only_cluster()).exit_code == 2
+
+    def test_keyed_form_ignores_other_families(self, capsys):
+        # 8 TPU chips + 4 GPUs: a TPU-keyed assertion must not count GPUs.
+        nodes = fx.tpu_v5e_single_host() + fx.gpu_pool(4)
+        ok = checker.run_check(
+            args_for("--expected-chips", "google.com/tpu=8"), nodes=nodes
+        )
+        assert ok.exit_code == 0
+        short = checker.run_check(
+            args_for("--expected-chips", "google.com/tpu=12"), nodes=nodes
+        )
+        assert short.exit_code == 3
+        assert short.payload["expected_chips_key"] == "google.com/tpu"
+        assert short.payload["expected_chips_have"] == 8
+        # The unkeyed form counts every family (12 here) — documented behavior.
+        assert (
+            checker.run_check(args_for("--expected-chips", "12"), nodes=nodes).exit_code
+            == 0
+        )
+
+    def test_keyed_form_accepts_globs(self, capsys):
+        nodes = fx.tpu_v5e_single_host()
+        r = checker.run_check(
+            args_for("--expected-chips", "*.com/tpu=8"), nodes=nodes
+        )
+        assert r.exit_code == 0
+
+    def test_absent_flag_leaves_payload_clean(self, capsys):
+        result = checker.run_check(args_for(), nodes=fx.gpu_pool(1))
+        assert "expected_chips" not in result.payload
+
+    def test_rejects_bad_values(self, capsys):
+        import pytest
+
+        for bad in ("0", "-3", "google.com/tpu=", "google.com/tpu=x", "four", "=8", "==8"):
+            with pytest.raises(SystemExit):
+                args_for("--expected-chips", bad)
+
+
 class TestJsonOutput:
     def test_payload_shape(self, tmp_path, capsys):
         code = cli.main(

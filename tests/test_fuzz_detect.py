@@ -27,6 +27,28 @@ scalars = st.one_of(
     st.text(max_size=30),
 )
 
+# Bias the fuzzer onto the TPU-specific paths: purely random keys essentially
+# never hit the GKE labels/resource keys, leaving slice grouping, topology
+# parsing, and nodepool handling unexercised by the totality property.
+_KNOWN_LABEL_KEYS = (
+    "cloud.google.com/gke-tpu-accelerator",
+    "cloud.google.com/gke-tpu-topology",
+    "cloud.google.com/gke-nodepool",
+    "node.kubernetes.io/instance-type",
+)
+_KNOWN_RESOURCE_KEYS = (
+    "google.com/tpu",
+    "cloud-tpus.google.com/v5e",
+    "nvidia.com/gpu",
+    "amd.com/gpu",
+)
+label_keys = st.one_of(st.sampled_from(_KNOWN_LABEL_KEYS), st.text(max_size=40))
+resource_keys = st.one_of(st.sampled_from(_KNOWN_RESOURCE_KEYS), st.text(max_size=40))
+# Values biased toward topology-shaped strings so parse_topology runs hot.
+label_values = st.one_of(
+    scalars, st.sampled_from(("2x2x1", "16x16", "8x", "x", "0x4", "tpu-v5e-pool"))
+)
+
 json_values = st.recursive(
     scalars,
     lambda children: st.one_of(
@@ -46,7 +68,7 @@ node_like = st.fixed_dictionaries(
                 {},
                 optional={
                     "name": scalars,
-                    "labels": st.dictionaries(st.text(max_size=40), scalars, max_size=5),
+                    "labels": st.dictionaries(label_keys, label_values, max_size=5),
                 },
             ),
         ),
@@ -59,8 +81,8 @@ node_like = st.fixed_dictionaries(
             st.fixed_dictionaries(
                 {},
                 optional={
-                    "allocatable": st.dictionaries(st.text(max_size=40), scalars, max_size=6),
-                    "capacity": st.dictionaries(st.text(max_size=40), scalars, max_size=6),
+                    "allocatable": st.dictionaries(resource_keys, scalars, max_size=6),
+                    "capacity": st.dictionaries(resource_keys, scalars, max_size=6),
                     "conditions": st.lists(json_values, max_size=3),
                 },
             ),

@@ -23,6 +23,7 @@ their semantics):
 
 from __future__ import annotations
 
+import fnmatch
 import json
 import sys
 import time
@@ -200,11 +201,28 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     effective_ready = [n for n in ready if n.effectively_ready]
     result.ready = effective_ready
 
+    expectation = getattr(args, "expected_chips", None)
+    expected_key, expected_n, have_chips = None, None, None
+    if expectation is not None:
+        expected_key, expected_n = expectation
+        if expected_key is None:
+            have_chips = sum(n.accelerators for n in effective_ready)
+        else:
+            have_chips = sum(
+                v
+                for n in effective_ready
+                for k, v in n.breakdown.items()
+                if fnmatch.fnmatchcase(k, expected_key)
+            )
     if not accel:
         result.exit_code = EXIT_NO_ACCEL_NODES
     elif not effective_ready:
         result.exit_code = EXIT_NONE_READY
     elif getattr(args, "strict_slices", False) and any(not s.complete for s in slices):
+        result.exit_code = EXIT_NONE_READY
+    elif expected_n is not None and have_chips < expected_n:
+        # Cluster-level capacity assertion (SURVEY §5.6): some nodes may be
+        # Ready, but the fleet is short of the chips the caller requires.
         result.exit_code = EXIT_NONE_READY
     else:
         result.exit_code = EXIT_OK
@@ -215,6 +233,12 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
         )
         if result.local_probe is not None:
             payload["local_probe"] = result.local_probe
+        if expected_n is not None:
+            payload["expected_chips"] = expected_n
+            if expected_key is not None:
+                payload["expected_chips_key"] = expected_key
+            payload["expected_chips_have"] = have_chips
+            payload["expected_chips_met"] = have_chips >= expected_n
         payload["exit_code"] = result.exit_code
     payload["timings_ms"] = timer.as_dict()
     result.payload = payload
@@ -379,6 +403,15 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
         print(report.dumps(result.payload))
     else:
         print(report.summary_line(accel, ready))
+        if result.payload.get("expected_chips") is not None and not result.payload.get(
+            "expected_chips_met"
+        ):
+            key = result.payload.get("expected_chips_key")
+            what = f"{key} chips" if key else "Ready chips"
+            print(
+                f"⚠️ Expected ≥{result.payload['expected_chips']} {what}, "
+                f"have {result.payload.get('expected_chips_have')}."
+            )
         print()
         print(report.format_node_table(accel))
         slice_table = report.format_slice_table(slices)

@@ -23,6 +23,25 @@ from tpu_node_checker.probe.liveness import LEVELS as PROBE_LEVELS
 from tpu_node_checker.utils.env import load_dotenv
 
 
+def _expected_chips(raw: str):
+    """``N`` or ``KEY=N`` → (key_or_None, n) for the capacity assertion."""
+    key, sep, count = raw.rpartition("=")
+    if sep and (not key or "=" in key or key != key.strip()):
+        # '=8' / '==8' is a typo (or an empty $KEY interpolation), not the
+        # unkeyed form — silently counting every family would mask the
+        # shortfall the keyed form exists to catch.
+        raise argparse.ArgumentTypeError(f"malformed resource key in {raw!r}")
+    try:
+        n = int(count)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer chip count, got {count!r}"
+        )
+    if n <= 0:
+        raise argparse.ArgumentTypeError("chip count must be positive")
+    return (key or None, n)
+
+
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p = argparse.ArgumentParser(
         prog="tpu-node-checker",
@@ -57,6 +76,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     )
     p.add_argument("--strict-slices", action="store_true",
                    help="exit 3 if any multi-host TPU slice is incomplete")
+    p.add_argument("--expected-chips", type=_expected_chips, metavar="[KEY=]N",
+                   help="exit 3 unless at least N chips are on Ready nodes "
+                   "(cluster-level capacity assertion, e.g. 256 for a "
+                   "v5e-256); KEY restricts the count to one resource key or "
+                   "glob, e.g. 'google.com/tpu=256' — without it every "
+                   "accelerator family counts")
     p.add_argument("--debug", action="store_true", help="print phase timings")
     p.add_argument("--watch", type=float, metavar="SECONDS",
                    help="daemon mode: repeat the check every SECONDS until interrupted")

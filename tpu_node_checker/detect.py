@@ -201,6 +201,13 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         for t in map(_as_dict, _as_list(_as_dict(node.get("spec")).get("taints")))
     ]
     name = metadata.get("name")
+
+    def _label(key: str) -> Optional[str]:
+        # Labels come off the wire; a non-string value (API garbage, offline
+        # fixtures) must not poison slice grouping's sort keys.
+        v = labels.get(key)
+        return v if isinstance(v, str) else None
+
     return NodeInfo(
         name=name if isinstance(name, str) else "",
         ready=is_ready(node),
@@ -210,9 +217,9 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         labels=dict(labels),
         taints=taints,
         schedulable=schedulable,
-        tpu_accelerator=labels.get(LABEL_TPU_ACCELERATOR),
-        tpu_topology=labels.get(LABEL_TPU_TOPOLOGY),
-        nodepool=labels.get(LABEL_NODEPOOL),
+        tpu_accelerator=_label(LABEL_TPU_ACCELERATOR),
+        tpu_topology=_label(LABEL_TPU_TOPOLOGY),
+        nodepool=_label(LABEL_NODEPOOL),
     )
 
 

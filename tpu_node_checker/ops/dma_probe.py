@@ -105,8 +105,8 @@ def _dma_stream(x: jax.Array, chunk_rows: int, interpret: bool) -> jax.Array:
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # stays in HBM
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # stays in HBM
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         interpret=interpret,
     )(x)
 
@@ -121,14 +121,14 @@ def dma_stream_probe(
     """Stream a (rows, cols) f32 array through the double-buffered DMA kernel
     and verify ``2x+1`` exactly."""
     try:
+        device = device or jax.local_devices()[0]
+        if interpret is None:
+            interpret = device.platform != "tpu"
         if rows % chunk_rows:
             return DmaProbeResult(
                 ok=False, gbps=0.0, elapsed_ms=0.0, interpreted=bool(interpret),
                 error=f"rows ({rows}) must be a multiple of chunk_rows ({chunk_rows})",
             )
-        device = device or jax.local_devices()[0]
-        if interpret is None:
-            interpret = device.platform != "tpu"
         x = jax.device_put(
             jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.float32), device
         )

@@ -68,6 +68,8 @@ def make_ring_attention(mesh, axis: str = "sp"):
         # Local shapes: (B, S_l, H, D).
         i = jax.lax.axis_index(axis)
         B, S_l, H, D = q.shape
+        if D <= 0 or S_l <= 0:
+            raise ValueError(f"degenerate attention shape {q.shape}")
         scale = 1.0 / np.sqrt(D)
         q32 = q.astype(jnp.float32)
 
@@ -120,9 +122,9 @@ def make_ring_attention(mesh, axis: str = "sp"):
             # The accumulators become device-varying inside the loop (they mix
             # with axis_index); the initial constants must carry the same
             # varying-manual-axes type or the fori_loop carry check rejects it.
-            if hasattr(jax.lax, "pvary"):
-                return jax.lax.pvary(x, (axis,))
-            return jax.lax.pcast(x, (axis,), to="varying")  # pragma: no cover
+            if hasattr(jax.lax, "pcast"):
+                return jax.lax.pcast(x, (axis,), to="varying")
+            return jax.lax.pvary(x, (axis,))  # pragma: no cover
 
         m0 = _varying(jnp.full((B, H, S_l), neg, jnp.float32))
         l0 = _varying(jnp.zeros((B, H, S_l), jnp.float32))
