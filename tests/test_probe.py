@@ -49,6 +49,20 @@ class TestRunLocalProbe:
         r = run_local_probe(level="enumerate", timeout_s=120)
         assert r.hostname == "gke-tpu-test-node"
 
+    def test_memory_stats_shape(self):
+        # Backends without memory_stats (CPU) must omit the section cleanly;
+        # when present, every entry carries id/bytes_in_use.
+        import json
+
+        r = run_local_probe(level="enumerate", timeout_s=120)
+        assert r.ok, r.error
+        json.dumps(r.to_dict())
+        mem = r.details.get("memory")
+        if mem is not None:
+            for entry in mem:
+                assert isinstance(entry["id"], int)
+                assert isinstance(entry["bytes_in_use"], int)
+
 
 @pytest.mark.slow
 class TestComputeLevels:

@@ -105,8 +105,9 @@ def _dma_stream(x: jax.Array, chunk_rows: int, interpret: bool) -> jax.Array:
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # stays in HBM
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        # pl.ANY since jax 0.7; earlier supported versions spell it pltpu.ANY.
+        in_specs=[pl.BlockSpec(memory_space=getattr(pl, "ANY", None) or pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=getattr(pl, "ANY", None) or pltpu.ANY),
         interpret=interpret,
     )(x)
 

@@ -124,7 +124,9 @@ def make_ring_attention(mesh, axis: str = "sp"):
             # varying-manual-axes type or the fori_loop carry check rejects it.
             if hasattr(jax.lax, "pcast"):
                 return jax.lax.pcast(x, (axis,), to="varying")
-            return jax.lax.pvary(x, (axis,))  # pragma: no cover
+            if hasattr(jax.lax, "pvary"):  # pragma: no cover
+                return jax.lax.pvary(x, (axis,))
+            return x  # pragma: no cover — pre-varying-types jax needs neither
 
         m0 = _varying(jnp.full((B, H, S_l), neg, jnp.float32))
         l0 = _varying(jnp.zeros((B, H, S_l), jnp.float32))

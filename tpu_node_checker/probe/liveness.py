@@ -66,6 +66,23 @@ try:
     out["process_index"] = jax.process_index()
     out["process_count"] = jax.process_count()
     out["ok"] = len(devices) > 0
+    mem = []
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats() or {}
+        except Exception:
+            s = {}
+        in_use, limit = s.get("bytes_in_use"), s.get("bytes_limit")
+        if in_use is not None:
+            mem.append({"id": d.id, "bytes_in_use": int(in_use),
+                        "bytes_limit": int(limit) if limit else None})
+    if mem:
+        # Telemetry only, no verdict: this child is a fresh PJRT client, so
+        # bytes_in_use reflects its OWN allocations — a chip held by another
+        # job surfaces as an init failure above, not as memory pressure.
+        # bytes_limit still confirms each chip exposes the HBM its device
+        # kind should have.
+        out["memory"] = mem
     if level in ("compute", "collective", "workload") and out["ok"]:
         from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn, pallas_matmul_probe
         burn = matmul_burn()
