@@ -153,6 +153,34 @@ class TestJsonOutput:
         assert "Timings (ms):" in capsys.readouterr().out
 
 
+class TestTrace:
+    def test_trace_file_is_valid_chrome_trace(self, tmp_path, capsys):
+        trace = tmp_path / "check.trace.json"
+        nodes_file = write_nodes(tmp_path, fx.tpu_v5e_single_host())
+        code = cli.main(["--nodes-json", nodes_file, "--trace", str(trace)])
+        assert code == 0
+        doc = json.loads(trace.read_text())
+        events = doc["traceEvents"]
+        names = {e["name"] for e in events}
+        assert {"list", "detect", "render", "total"} <= names
+        for e in events:
+            if e["ph"] == "X":
+                assert e["ts"] >= 0 and e["dur"] >= 0
+        # Spans must nest inside the total.
+        total = next(e for e in events if e["name"] == "total")
+        for e in events:
+            if e["ph"] == "X" and e["name"] != "total":
+                assert e["ts"] + e["dur"] <= total["dur"] * 1.05
+
+    def test_unwritable_trace_path_is_not_fatal(self, tmp_path, capsys):
+        nodes_file = write_nodes(tmp_path, fx.tpu_v5e_single_host())
+        code = cli.main(
+            ["--nodes-json", nodes_file, "--trace", str(tmp_path / "no" / "dir" / "t.json")]
+        )
+        assert code == 0
+        assert "Cannot write trace" in capsys.readouterr().err
+
+
 class TestCustomResourceKeys:
     def test_resource_key_flag(self, capsys):
         nodes = [fx.make_node("gaudi-0", allocatable={"habana.ai/gaudi": "8"})]

@@ -1,0 +1,213 @@
+"""Fault injection: misbehaving API server, probe child, and webhook.
+
+The reference has graded failure *detection* but no fault *injection*
+(SURVEY §5.3).  This harness injects failures at both network boundaries
+(k8s API, Slack webhook — check-gpu-node.py:217 and :73 analogs) and at the
+probe subprocess, and asserts the graded contract holds: transport/API
+failures land on exit 1 with a machine-readable error in ``--json`` mode,
+probe misbehavior degrades to a structured probe failure, and Slack delivery
+failure never changes the exit code.
+"""
+
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+from tpu_node_checker.probe import run_local_probe
+
+
+class FaultyApiServer:
+    """HTTP server with a programmable failure mode per instance."""
+
+    def __init__(self, mode, payload=None):
+        self.mode = mode
+        self.payload = payload or json.dumps(fx.node_list(fx.gpu_pool(1))).encode()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if outer.mode == "http_500":
+                    body = b'{"kind":"Status","message":"etcdserver: timeout"}'
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif outer.mode == "garbage_json":
+                    body = b"<html>proxy error</html>"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif outer.mode == "truncated":
+                    # Advertise more bytes than are sent, then slam the socket.
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(outer.payload) + 999))
+                    self.end_headers()
+                    self.wfile.write(outer.payload[: len(outer.payload) // 2])
+                    self.wfile.flush()
+                    self.connection.close()
+                elif outer.mode == "reset":
+                    # RST instead of a response: connection reset by peer.
+                    self.connection.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        b"\x01\x00\x00\x00\x00\x00\x00\x00",
+                    )
+                    self.connection.close()
+                elif outer.mode == "slow":
+                    # Trickle one byte, then stall past the client timeout.
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(outer.payload)))
+                    self.end_headers()
+                    self.wfile.write(outer.payload[:1])
+                    self.wfile.flush()
+                    import time as _t
+
+                    _t.sleep(10)
+                else:  # "ok"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(outer.payload)))
+                    self.end_headers()
+                    self.wfile.write(outer.payload)
+
+            def log_message(self, *args):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def port(self):
+        return self.server.server_address[1]
+
+    def close(self):
+        self.server.shutdown()
+
+
+def kubeconfig_for(tmp_path, port):
+    p = tmp_path / "kubeconfig"
+    p.write_text(
+        f"""
+apiVersion: v1
+kind: Config
+current-context: fault
+contexts: [{{name: fault, context: {{cluster: fault, user: fault}}}}]
+clusters: [{{name: fault, cluster: {{server: "http://127.0.0.1:{port}"}}}}]
+users: [{{name: fault, user: {{token: t}}}}]
+"""
+    )
+    return str(p)
+
+
+class TestApiServerFaults:
+    """Every transport-level fault must land on exit 1 — never a traceback
+    escaping, never a wrong healthy/unhealthy verdict."""
+
+    @pytest.mark.parametrize("mode", ["http_500", "garbage_json", "truncated", "reset"])
+    def test_fault_exits_1_with_json_error(self, tmp_path, capsys, mode):
+        srv = FaultyApiServer(mode)
+        try:
+            code = cli.main(["--json", "--kubeconfig", kubeconfig_for(tmp_path, srv.port)])
+        finally:
+            srv.close()
+        assert code == 1
+        out = json.loads(capsys.readouterr().out)
+        assert "error" in out and out["error"]
+
+    @pytest.mark.parametrize("mode", ["http_500", "reset"])
+    def test_fault_table_mode_stderr(self, tmp_path, capsys, mode):
+        srv = FaultyApiServer(mode)
+        try:
+            code = cli.main(["--kubeconfig", kubeconfig_for(tmp_path, srv.port)])
+        finally:
+            srv.close()
+        assert code == 1
+        captured = capsys.readouterr()
+        assert captured.err  # human mode explains on stderr
+
+    def test_slow_server_times_out_to_exit_1(self, tmp_path, capsys, monkeypatch):
+        # Client-side timeout (DEFAULT_TIMEOUT_S) shrunk so the test is fast.
+        import tpu_node_checker.cluster as cluster
+
+        srv = FaultyApiServer("slow")
+        orig = cluster.KubeClient.list_nodes
+
+        def fast_timeout(self, label_selector=None, timeout=0.5):
+            return orig(self, label_selector=label_selector, timeout=0.5)
+
+        monkeypatch.setattr(cluster.KubeClient, "list_nodes", fast_timeout)
+        try:
+            code = cli.main(["--json", "--kubeconfig", kubeconfig_for(tmp_path, srv.port)])
+        finally:
+            srv.close()
+        assert code == 1
+        assert "error" in json.loads(capsys.readouterr().out)
+
+    def test_healthy_server_control(self, tmp_path, capsys):
+        # The harness itself must not be the reason anything fails.
+        srv = FaultyApiServer("ok")
+        try:
+            code = cli.main(["--json", "--kubeconfig", kubeconfig_for(tmp_path, srv.port)])
+        finally:
+            srv.close()
+        assert code == 0
+
+
+class TestProbeChildFaults:
+    def test_child_emits_garbage_stdout(self):
+        # /bin/echo prints the script text (not JSON) and exits 0.
+        r = run_local_probe(level="enumerate", timeout_s=10, python="/bin/echo")
+        assert not r.ok
+        assert "without a report" in r.error
+
+    def test_child_killed_by_signal(self, tmp_path):
+        die = tmp_path / "die"
+        die.write_text("#!/bin/sh\nkill -9 $$\n")
+        die.chmod(0o755)
+        r = run_local_probe(level="enumerate", timeout_s=10, python=str(die))
+        assert not r.ok
+        assert "without a report" in r.error
+
+    def test_child_oom_like_abort(self, tmp_path):
+        # Emulates libtpu abort()ing after partial stderr output.
+        ab = tmp_path / "abort"
+        ab.write_text("#!/bin/sh\necho 'F0000 check failure' >&2\nexit 134\n")
+        ab.chmod(0o755)
+        r = run_local_probe(level="enumerate", timeout_s=10, python=str(ab))
+        assert not r.ok
+        assert "134" in r.error or "check failure" in r.error
+
+
+class TestSlackFaultIsolation:
+    """Slack delivery failure must never alter the check's exit code
+    (check-gpu-node.py:269-271 contract)."""
+
+    def test_webhook_down_keeps_exit_code(self, capsys):
+        srv = FaultyApiServer("reset")  # reused as a dead webhook endpoint
+        try:
+            args = cli.parse_args(
+                ["--slack-webhook", f"http://127.0.0.1:{srv.port}/hook",
+                 "--slack-retry-count", "0", "--slack-retry-delay", "0"]
+            )
+            code = checker.one_shot(args, nodes=fx.tpu_v5e_single_host())
+        finally:
+            srv.close()
+        assert code == 0
+        assert "Slack notification failed" in capsys.readouterr().err
+
+    def test_webhook_http_500_keeps_exit_code(self, capsys):
+        srv = FaultyApiServer("http_500")
+        try:
+            args = cli.parse_args(
+                ["--slack-webhook", f"http://127.0.0.1:{srv.port}/hook",
+                 "--slack-retry-count", "0", "--slack-retry-delay", "0"]
+            )
+            code = checker.one_shot(args, nodes=fx.gpu_pool(1, ready=False))
+        finally:
+            srv.close()
+        assert code == 3  # the cluster verdict, not the webhook's

@@ -260,6 +260,60 @@ class TestWatch:
         err = capsys.readouterr().err
         assert "State change: exit 0 → 1" in err
         assert "State change: exit 1 → 0" in err
+    def _resume_run(self, monkeypatch, log_path, node_sets):
+        sent = []
+
+        def fake_fetch(args, timer):
+            if not node_sets:
+                raise KeyboardInterrupt
+            return node_sets.pop(0)
+
+        monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
+        monkeypatch.setattr(
+            notify, "send_slack_message",
+            lambda url, message, **kw: sent.append(message.splitlines()[0]) or True,
+        )
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        code = cli.main(
+            ["--watch", "1", "--slack-on-change", "--slack-webhook", "https://x",
+             "--log-jsonl", str(log_path)]
+        )
+        assert code == 130
+        return sent
+
+    def test_restart_with_unchanged_state_does_not_realert(self, tmp_path, monkeypatch, capsys):
+        # Simulated previous run recorded exit 0; pod restarts, state still 0.
+        log = tmp_path / "trend.jsonl"
+        log.write_text(json.dumps({"ts": 1.0, "exit_code": 0}) + "\n")
+        sent = self._resume_run(monkeypatch, log, [fx.tpu_v5e_single_host()])
+        assert sent == []  # no duplicate "all healthy" alert after restart
+        assert "Resuming state-transition alerting from exit 0" in capsys.readouterr().err
+
+    def test_restart_alerts_on_transition_from_recovered_state(self, tmp_path, monkeypatch, capsys):
+        log = tmp_path / "trend.jsonl"
+        log.write_text(json.dumps({"ts": 1.0, "exit_code": 3}) + "\n")
+        sent = self._resume_run(monkeypatch, log, [fx.tpu_v5e_single_host()])
+        assert len(sent) == 1  # 3 → 0 is a real transition
+        assert sent[0].startswith("✅")
+
+    def test_corrupt_or_missing_log_degrades_to_first_round_alert(self, tmp_path, monkeypatch, capsys):
+        log = tmp_path / "trend.jsonl"
+        log.write_text("not json at all\n{\"ts\": 2.0}\n")
+        sent = self._resume_run(monkeypatch, log, [fx.tpu_v5e_single_host()])
+        assert len(sent) == 1  # unknown prior state → alert (safe direction)
+        missing = tmp_path / "absent.jsonl"
+        sent2 = self._resume_run(monkeypatch, missing, [fx.tpu_v5e_single_host()])
+        assert len(sent2) == 1
+
+    def test_recover_reads_only_the_tail_of_a_large_log(self, tmp_path):
+        log = tmp_path / "trend.jsonl"
+        with open(log, "w") as f:
+            for i in range(5000):
+                f.write(json.dumps({"ts": float(i), "exit_code": 2}) + "\n")
+            f.write(json.dumps({"ts": 9e9, "exit_code": 3}) + "\n")
+        args = args_for("--watch", "1", "--slack-on-change", "--log-jsonl", str(log))
+        assert checker._recover_last_code(args) == 3
+
     def test_watch_loops_and_notifies_on_change_only(self, monkeypatch, capsys):
         rounds = []
         sent = []

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import fnmatch
 import json
+import os
 import sys
 import time
 from dataclasses import dataclass, field
@@ -242,6 +243,19 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
         payload["exit_code"] = result.exit_code
     payload["timings_ms"] = timer.as_dict()
     result.payload = payload
+    trace_path = getattr(args, "trace", None)
+    if trace_path:
+        try:
+            # tmp + rename, like emit_probe: a watch-mode round rewrites the
+            # file every interval and a reader must never see torn JSON.
+            tmp = f"{trace_path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(timer.chrome_trace(), f)
+            os.replace(tmp, trace_path)
+            if getattr(args, "watch", None) is None:
+                print(f"Trace written to {trace_path}.", file=sys.stderr)
+        except OSError as exc:
+            print(f"Cannot write trace {trace_path}: {exc}", file=sys.stderr)
     return result
 
 
@@ -296,6 +310,16 @@ def watch(args) -> None:
         metrics_server = MetricsServer(args.metrics_port)
         print(f"Serving /metrics on port {metrics_server.port}", file=sys.stderr)
     last_code: Optional[int] = None
+    if on_change:
+        # Resume across restarts: recover the last recorded outcome from the
+        # trend log so a pod restart doesn't re-alert on an unchanged state.
+        last_code = _recover_last_code(args)
+        if last_code is not None:
+            print(
+                f"Resuming state-transition alerting from exit {last_code} "
+                f"(recovered from {args.log_jsonl})",
+                file=sys.stderr,
+            )
     while True:
         # The try covers ONLY the check itself: a failure here means "the
         # monitor is down" — a state of its own (EXIT_ERROR) so that recovery
@@ -333,6 +357,35 @@ def watch(args) -> None:
             print(f"State change: exit {last_code} → {code}", file=sys.stderr)
         last_code = code
         time.sleep(interval)
+
+
+def _recover_last_code(args) -> Optional[int]:
+    """Last recorded ``exit_code`` from the ``--log-jsonl`` trend log, if any.
+
+    The checkpoint/resume surface of watch mode: the trend log doubles as the
+    durable state record, so ``--slack-on-change`` survives pod restarts
+    without duplicate alerts.  Corrupt/missing logs degrade to ``None``
+    (first round then alerts, the safe direction).
+    """
+    path = getattr(args, "log_jsonl", None)
+    if not path:
+        return None
+    try:
+        with open(path, "rb") as f:
+            # Tail read: the log grows unboundedly; only the end matters.
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - 8192))
+            lines = f.read().decode("utf-8", errors="replace").strip().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            code = json.loads(line).get("exit_code")
+        except (json.JSONDecodeError, AttributeError):
+            continue
+        if isinstance(code, int):
+            return code
+    return None
 
 
 def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] = None) -> None:

@@ -83,6 +83,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    "glob, e.g. 'google.com/tpu=256' — without it every "
                    "accelerator family counts")
     p.add_argument("--debug", action="store_true", help="print phase timings")
+    p.add_argument("--trace", metavar="FILE",
+                   help="write a Chrome-trace-format timeline of the check's "
+                   "phases to FILE (open in Perfetto / chrome://tracing)")
     p.add_argument("--watch", type=float, metavar="SECONDS",
                    help="daemon mode: repeat the check every SECONDS until interrupted")
     p.add_argument("--slack-on-change", action="store_true",

@@ -75,7 +75,7 @@ try:
         in_use, limit = s.get("bytes_in_use"), s.get("bytes_limit")
         if in_use is not None:
             mem.append({"id": d.id, "bytes_in_use": int(in_use),
-                        "bytes_limit": int(limit) if limit else None})
+                        "bytes_limit": int(limit) if limit is not None else None})
     if mem:
         # Telemetry only, no verdict: this child is a fresh PJRT client, so
         # bytes_in_use reflects its OWN allocations — a chip held by another

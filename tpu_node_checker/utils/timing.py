@@ -3,7 +3,9 @@
 The reference has no timing at all (SURVEY §5.1); the build target demands the
 checker exit in <2 s on a v5e-256 slice, so the orchestrator times its phases
 (k8s LIST, detection, probe, notify, render) and surfaces them under
-``--debug`` and in the ``--json`` payload's ``timings_ms`` field.
+``--debug``, in the ``--json`` payload's ``timings_ms`` field, and — via
+``--trace FILE`` — as a Chrome-trace-format timeline loadable in Perfetto /
+``chrome://tracing``.
 """
 
 from __future__ import annotations
@@ -11,7 +13,7 @@ from __future__ import annotations
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, Iterator
+from typing import Dict, Iterator, List, Tuple
 
 
 @dataclass
@@ -25,6 +27,8 @@ class PhaseTimer:
     """Collects named phase durations; cheap enough to always be on."""
 
     phases: Dict[str, float] = field(default_factory=dict)
+    # (name, start_offset_ms, dur_ms) in execution order — the trace surface.
+    spans: List[Tuple[str, float, float]] = field(default_factory=list)
     _start: float = field(default_factory=time.perf_counter)
 
     @contextmanager
@@ -33,7 +37,9 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.phases[name] = self.phases.get(name, 0.0) + (time.perf_counter() - t0) * 1e3
+            t1 = time.perf_counter()
+            self.phases[name] = self.phases.get(name, 0.0) + (t1 - t0) * 1e3
+            self.spans.append((name, (t0 - self._start) * 1e3, (t1 - t0) * 1e3))
 
     def total_ms(self) -> float:
         return (time.perf_counter() - self._start) * 1e3
@@ -42,3 +48,37 @@ class PhaseTimer:
         out = {k: round(v, 2) for k, v in self.phases.items()}
         out["total"] = round(self.total_ms(), 2)
         return out
+
+    def chrome_trace(self, process_name: str = "tpu-node-checker") -> dict:
+        """Trace-event-format document (one complete 'X' event per span)."""
+        events = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": 1,
+                "args": {"name": process_name},
+            }
+        ]
+        for name, start_ms, dur_ms in self.spans:
+            events.append(
+                {
+                    "name": name,
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": 1,
+                    "ts": round(start_ms * 1e3, 1),  # microseconds
+                    "dur": round(dur_ms * 1e3, 1),
+                }
+            )
+        events.append(
+            {
+                "name": "total",
+                "ph": "X",
+                "pid": 1,
+                "tid": 1,
+                "ts": 0.0,
+                "dur": round(self.total_ms() * 1e3, 1),
+            }
+        )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
