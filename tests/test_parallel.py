@@ -49,7 +49,11 @@ class TestCollectiveProbe:
         r = collective_probe(payload=64, timed_iters=2)
         assert r.ok, r.error
         assert r.n_devices == 8
-        assert r.details == {"psum_ok": True, "all_gather_ok": True}
+        assert r.details == {
+            "psum_ok": True,
+            "all_gather_ok": True,
+            "reduce_scatter_ok": True,
+        }
         assert r.latency_us > 0
 
     def test_over_2d_mesh_flattened(self):

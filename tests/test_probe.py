@@ -84,6 +84,8 @@ class TestComputeLevels:
         assert r.ok, r.error
         assert r.details.get("workload_ok") is True
         assert r.details.get("ring_attention_ok") is True
+        assert r.details.get("pipeline_ok") is True
+        assert r.details.get("moe_ok") is True
         assert len(r.details.get("workload_losses", [])) >= 2
 
 

@@ -4,9 +4,14 @@ The reference has no distributed backend at all (SURVEY §2.3 — its only I/O i
 HTTPS REST).  The TPU-native mapping of that role (SURVEY §5.8) is the
 control-plane (k8s labels, handled in :mod:`tpu_node_checker.detect`) plus this
 data-plane: build a ``jax.sharding.Mesh`` over the live chips and push XLA
-collectives (``psum``, ``all_gather``, ``ppermute``) across the ICI links via
-``shard_map``.  A slice whose hosts are all kubelet-Ready but whose ICI is
-broken fails here and nowhere else.
+collectives (``psum``, ``all_gather``, ``reduce_scatter``, ``ppermute``,
+``all_to_all``) across the ICI links via ``shard_map``.  A slice whose hosts
+are all kubelet-Ready but whose ICI is broken fails here and nowhere else.
+
+The module set is the full dp/tp/pp/sp/ep parallelism surface: GSPMD dp+tp in
+:mod:`tpu_node_checker.models.burnin`, sequence parallelism in
+:mod:`.ring_attention`, pipeline parallelism in :mod:`.pipeline`, expert
+parallelism in :mod:`.moe`.
 """
 
 from tpu_node_checker.parallel.mesh import (
@@ -25,6 +30,18 @@ from tpu_node_checker.parallel.ring_attention import (
     reference_causal_attention,
     ring_attention_probe,
 )
+from tpu_node_checker.parallel.pipeline import (
+    PipelineResult,
+    make_pipeline,
+    pipeline_probe,
+    reference_pipeline,
+)
+from tpu_node_checker.parallel.moe import (
+    MoEResult,
+    make_moe_layer,
+    moe_probe,
+    reference_moe,
+)
 
 __all__ = [
     "MeshSpec",
@@ -37,4 +54,12 @@ __all__ = [
     "make_ring_attention",
     "reference_causal_attention",
     "ring_attention_probe",
+    "PipelineResult",
+    "make_pipeline",
+    "pipeline_probe",
+    "reference_pipeline",
+    "MoEResult",
+    "make_moe_layer",
+    "moe_probe",
+    "reference_moe",
 ]

@@ -3,12 +3,18 @@
 Three collectives, three failure surfaces, all via ``shard_map`` over a
 ``jax.sharding.Mesh`` (the XLA-native path — never hand-rolled transports):
 
-* :func:`collective_probe` — ``psum`` all-reduce plus an ``all_gather`` leg,
-  each with a closed-form expected value; a wrong result or a hang localizes
-  to the reduction fabric;
+* :func:`collective_probe` — ``psum`` all-reduce, an ``all_gather`` leg, and a
+  ``psum_scatter`` (reduce-scatter) leg, each with a closed-form expected
+  value; a wrong result or a hang localizes to the reduction fabric.
+  Together the three cover both halves of the all-reduce decomposition
+  (reduce-scatter + all-gather) XLA actually emits on TPU rings;
 * :func:`ring_probe` — ``ppermute`` around the device ring, one hop per scan
   step; this walks every ICI link *individually*, catching single-link faults
   an all-reduce can mask.
+
+(The all-pairs ``all_to_all`` pattern lives in
+:mod:`tpu_node_checker.parallel.moe`; point-to-point pipelining in
+:mod:`tpu_node_checker.parallel.pipeline`.)
 
 Everything is jitted with static shapes; verification compares device results
 against values computable on the host without any collective.
@@ -32,42 +38,29 @@ class CollectiveResult:
     details: Optional[dict] = None
 
 
-def _shard_map():
-    """shard_map moved between jax versions; support both spellings."""
-    import jax
-
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map  # pragma: no cover
-
-    return shard_map
-
-
-def _flat_mesh(mesh):
-    """Collapse a (possibly multi-axis) mesh to one ring axis ``"d"``."""
-    from tpu_node_checker.parallel.mesh import MeshSpec, build_mesh
-
-    devices = list(mesh.devices.flat)
-    return build_mesh(MeshSpec((("d", len(devices)),)), devices)
-
-
 def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> CollectiveResult:
-    """psum + all_gather over every device in ``mesh`` (default: all local).
+    """psum + all_gather + reduce-scatter over ``mesh`` (default: all local).
 
-    Device ``i`` contributes a constant vector of ``i``; psum must yield
-    ``n(n-1)/2`` everywhere and the gather must reproduce ``[0, ..., n-1]``.
+    Device ``i`` contributes a constant vector of ``i``; psum and the
+    reduce-scatter shard must yield ``n(n-1)/2`` everywhere and the gather
+    must reproduce ``[0, ..., n-1]``.
     """
     try:
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from tpu_node_checker.parallel.mesh import MeshSpec, build_mesh
+        from tpu_node_checker.parallel.mesh import (
+            MeshSpec,
+            build_mesh,
+            flat_mesh,
+            shard_map_fn,
+        )
 
-        sm = _shard_map()
+        sm = shard_map_fn()
         if mesh is None:
             mesh = build_mesh(MeshSpec((("d", len(jax.devices())),)))
-        mesh = _flat_mesh(mesh)
+        mesh = flat_mesh(mesh, "d")
         n = int(np.prod(mesh.devices.shape))
 
         x = jnp.arange(n, dtype=jnp.float32)[:, None] * jnp.ones((1, payload), jnp.float32)
@@ -79,15 +72,25 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
             # sharded on the way out (out_spec P("d")) because shard_map's
             # replication checker can't infer all_gather outputs.
             gathered = jax.lax.all_gather(local, "d", tiled=True)
-            return total, gathered
+            # Reduce-scatter: every device contributes the full (n, payload)
+            # matrix (rows = its constant i) and keeps one reduced row.
+            contrib = jnp.broadcast_to(local, (n, local.shape[1]))
+            scattered = jax.lax.psum_scatter(
+                contrib, "d", scatter_dimension=0, tiled=True
+            )
+            return total, gathered, scattered
 
-        probe = jax.jit(sm(_probe, mesh=mesh, in_specs=P("d"), out_specs=(P(), P("d"))))
+        probe = jax.jit(
+            sm(_probe, mesh=mesh, in_specs=P("d"), out_specs=(P(), P("d"), P("d")))
+        )
 
-        total, gathered = probe(x)
+        total, gathered, scattered = probe(x)
         total.block_until_ready()
 
         expected_sum = n * (n - 1) / 2.0
         sum_ok = bool(np.allclose(np.asarray(total), expected_sum))
+        # Global scattered shape is (n, payload); every row is the reduction.
+        scatter_ok = bool(np.allclose(np.asarray(scattered), expected_sum))
         expected_gather = np.arange(n, dtype=np.float32)[:, None] * np.ones(
             (1, payload), np.float32
         )
@@ -101,17 +104,26 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
 
         t0 = time.perf_counter()
         for _ in range(timed_iters):
-            total, _ = probe(x)
+            total, _, _ = probe(x)
         total.block_until_ready()
         latency_us = (time.perf_counter() - t0) / timed_iters * 1e6
 
-        ok = sum_ok and gather_ok
+        ok = sum_ok and gather_ok and scatter_ok
         return CollectiveResult(
             ok=ok,
             n_devices=n,
             latency_us=latency_us,
-            error=None if ok else f"collective mismatch (psum ok={sum_ok}, gather ok={gather_ok})",
-            details={"psum_ok": sum_ok, "all_gather_ok": gather_ok},
+            error=None
+            if ok
+            else (
+                f"collective mismatch (psum ok={sum_ok}, gather ok={gather_ok}, "
+                f"reduce_scatter ok={scatter_ok})"
+            ),
+            details={
+                "psum_ok": sum_ok,
+                "all_gather_ok": gather_ok,
+                "reduce_scatter_ok": scatter_ok,
+            },
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
         return CollectiveResult(
@@ -130,12 +142,17 @@ def ring_probe(mesh=None, payload: int = 256) -> CollectiveResult:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from tpu_node_checker.parallel.mesh import MeshSpec, build_mesh
+        from tpu_node_checker.parallel.mesh import (
+            MeshSpec,
+            build_mesh,
+            flat_mesh,
+            shard_map_fn,
+        )
 
-        sm = _shard_map()
+        sm = shard_map_fn()
         if mesh is None:
             mesh = build_mesh(MeshSpec((("d", len(jax.devices())),)))
-        mesh = _flat_mesh(mesh)
+        mesh = flat_mesh(mesh, "d")
         n = int(np.prod(mesh.devices.shape))
 
         x = jnp.arange(n, dtype=jnp.float32)[:, None] * jnp.ones((1, payload), jnp.float32)

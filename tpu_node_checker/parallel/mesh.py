@@ -60,6 +60,47 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
     return Mesh(arr, spec.axis_names)
 
 
+def shard_map_fn():
+    """``shard_map`` moved between jax versions; support both spellings."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map  # pragma: no cover
+
+    return shard_map
+
+
+def flat_mesh(mesh, axis: str = "d"):
+    """Collapse a (possibly multi-axis) mesh to one ring axis named ``axis``.
+
+    The single-axis probes (collectives, ring attention, pipeline, MoE) accept
+    any mesh shape and re-ring its devices; a mesh already shaped that way
+    passes through untouched.
+    """
+    if tuple(mesh.axis_names) == (axis,):
+        return mesh
+    devices = list(mesh.devices.flat)
+    return build_mesh(MeshSpec(((axis, len(devices)),)), devices)
+
+
+def device_varying(x, axis: str):
+    """Mark ``x`` device-varying over ``axis`` inside ``shard_map``.
+
+    Loop carries that mix with ``axis_index`` become device-varying; initial
+    constants must carry the same varying-manual-axes type or the loop carry
+    check rejects them.  The marker API has moved across jax versions
+    (``pcast`` → ``pvary`` → implicit); support all three.
+    """
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis,), to="varying")
+    if hasattr(jax.lax, "pvary"):  # pragma: no cover
+        return jax.lax.pvary(x, (axis,))
+    return x  # pragma: no cover — pre-varying-types jax needs neither
+
+
 def mesh_from_topology(
     topology: Optional[str], devices: Optional[Sequence] = None, axis_prefix: str = "t"
 ):

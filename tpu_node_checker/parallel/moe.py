@@ -1,0 +1,195 @@
+"""Expert parallelism: an MoE dispatch/combine round trip as a fabric probe.
+
+Completes the framework's parallelism set (dp/tp/pp/sp/**ep**) and covers the
+one collective no other probe touches: ``all_to_all`` — the token-shuffle
+traffic pattern of Mixture-of-Experts layers, and the densest all-pairs load
+an ICI fabric sees in production.  psum and ppermute each exercise a fabric
+subgraph; all_to_all lights up every device pair at once.
+
+Design (one ``shard_map`` + ``jit``, static shapes):
+
+* mesh axis ``ep`` of size ``n``; device ``e`` permanently owns expert ``e``'s
+  FFN weights (distinct per expert, so mis-routed tokens change the answer);
+* each device holds ``T`` local tokens; token ``j`` is assigned to expert
+  ``j mod n``.  The balanced round-robin assignment is deliberate: a health
+  probe needs a closed-form expected value (cf. ``collective_probe``), and
+  data-dependent top-k routing would make capacity overflow — not fabric
+  faults — show up in the verdict.  The *gate* stays data-dependent: each
+  token's expert output is scaled by its router softmax weight, so the math
+  is genuinely MoE-shaped;
+* dispatch is ``lax.all_to_all`` (tokens → owning expert), each expert runs
+  its FFN on the ``n·T/n`` tokens it received, and a second ``all_to_all``
+  combines results back to the tokens' home devices;
+* verification: the same gated expert computation evaluated densely on the
+  host.  Any corruption in either all_to_all pass breaks exact token/expert
+  pairing and shows up as a mismatch.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class MoEResult:
+    ok: bool
+    n_experts: int
+    tokens: int
+    max_abs_err: float
+    latency_ms: float
+    error: Optional[str] = None
+
+
+def make_moe_layer(mesh, axis: str = "ep"):
+    """Build a jitted expert-parallel MoE layer over ``mesh``'s ``axis``.
+
+    Returned fn maps stacked expert weights ``w1`` (n, d, f) / ``w2`` (n, f, d),
+    router matrix ``wr`` (d, n) (replicated), and tokens ``x`` (n·T, d)
+    (sharded over ``axis``) to the gated expert outputs, same sharding as
+    ``x``.  ``T`` must be divisible by ``n``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_node_checker.parallel.mesh import shard_map_fn
+
+    n = int(mesh.shape[axis])
+    sm = shard_map_fn()
+
+    def _local(w1, w2, wr, x):
+        # Local shapes: w1 (1, d, f), w2 (1, f, d), wr (d, n), x (T, d).
+        w1 = w1[0]
+        w2 = w2[0]
+        T, d = x.shape
+        g = T // n  # tokens per (local, expert) group
+
+        # Router: data-dependent gate for the statically-assigned expert.
+        logits = jnp.dot(
+            x, wr, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        probs = jax.nn.softmax(logits, axis=-1)  # (T, n)
+        expert_of = jnp.arange(T) % n
+        gate = jnp.take_along_axis(probs, expert_of[:, None], axis=1)[:, 0]
+
+        # Group tokens by destination expert: token j=k·n+e → group e, slot k.
+        grouped = x.reshape(g, n, d).transpose(1, 0, 2)  # (n, g, d)
+        # Dispatch: group e of every device lands on device e.
+        received = jax.lax.all_to_all(
+            grouped, axis, split_axis=0, concat_axis=0, tiled=True
+        )  # (n, g, d) — row s is the group-for-this-expert from device s
+
+        # This expert's FFN over everything it received.  HIGHEST precision:
+        # TPU f32 matmuls default to bf16 passes, and a numerics *probe* must
+        # not flag that as a fault (cf. ring_attention).
+        hi = jax.lax.Precision.HIGHEST
+        h = jnp.tanh(
+            jnp.dot(received, w1, preferred_element_type=jnp.float32, precision=hi)
+        )
+        y = jnp.dot(h, w2, preferred_element_type=jnp.float32, precision=hi)
+
+        # Combine: the inverse shuffle returns results to the home devices.
+        back = jax.lax.all_to_all(
+            y, axis, split_axis=0, concat_axis=0, tiled=True
+        )  # (n, g, d) — row e is expert e's output for this device's group e
+        ungrouped = back.transpose(1, 0, 2).reshape(T, d)
+        return ungrouped * gate[:, None]
+
+    return jax.jit(
+        sm(
+            _local,
+            mesh=mesh,
+            in_specs=(P(axis, None, None), P(axis, None, None), P(), P(axis, None)),
+            out_specs=P(axis, None),
+        )
+    )
+
+
+def reference_moe(w1, w2, wr, x, n):
+    """Dense single-device evaluation of the same gated MoE — ground truth."""
+    import jax
+    import jax.numpy as jnp
+
+    T = x.shape[0]
+    hi = jax.lax.Precision.HIGHEST
+    probs = jax.nn.softmax(jnp.dot(x, wr, precision=hi), axis=-1)
+    expert_of = np.arange(T) % n
+    gate = jnp.take_along_axis(probs, expert_of[:, None], axis=1)[:, 0]
+    # Evaluate every expert on every token, then select — fine at probe scale.
+    h = jnp.tanh(jnp.einsum("td,edf->etf", x, w1, precision=hi))
+    y = jnp.einsum("etf,efd->etd", h, w2, precision=hi)  # (n_experts, T, d)
+    sel = y[expert_of, np.arange(T)]
+    return sel * gate[:, None]
+
+
+def moe_probe(
+    mesh=None,
+    tokens_per_device: int = 16,
+    d_model: int = 32,
+    d_ff: int = 64,
+    rtol: float = 1e-3,
+) -> MoEResult:
+    """Run the expert-parallel layer across the mesh and verify against the
+    dense reference — a mismatch localizes to the all_to_all shuffle paths."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_node_checker.parallel.mesh import MeshSpec, build_mesh, flat_mesh
+
+        if mesh is None:
+            mesh = build_mesh(MeshSpec((("ep", len(jax.devices())),)))
+        mesh = flat_mesh(mesh, "ep")
+        n = mesh.shape["ep"]
+        T = tokens_per_device
+        if T % n:
+            T = ((T // n) + 1) * n  # per-device tokens must split n ways
+
+        keys = jax.random.split(jax.random.PRNGKey(0), 4)
+        w1 = jax.random.normal(keys[0], (n, d_model, d_ff), jnp.float32) / np.sqrt(
+            d_model
+        )
+        w2 = jax.random.normal(keys[1], (n, d_ff, d_model), jnp.float32) / np.sqrt(
+            d_ff
+        )
+        wr = jax.random.normal(keys[2], (d_model, n), jnp.float32)
+        x = jax.random.normal(keys[3], (n * T, d_model), jnp.float32)
+
+        w1s = jax.device_put(w1, NamedSharding(mesh, P("ep", None, None)))
+        w2s = jax.device_put(w2, NamedSharding(mesh, P("ep", None, None)))
+        wrs = jax.device_put(wr, NamedSharding(mesh, P()))
+        xs = jax.device_put(x, NamedSharding(mesh, P("ep", None)))
+
+        fn = make_moe_layer(mesh)
+        out = fn(w1s, w2s, wrs, xs)  # warmup: compile + first pass
+        out_host = np.asarray(jax.device_get(out))
+        t0 = time.perf_counter()
+        out_host = np.asarray(jax.device_get(fn(w1s, w2s, wrs, xs)))
+        latency_ms = (time.perf_counter() - t0) * 1e3
+
+        ref = np.asarray(jax.device_get(reference_moe(w1, w2, wr, x, n)))
+        max_abs_err = float(np.max(np.abs(out_host - ref)))
+        ok = bool(np.allclose(out_host, ref, rtol=rtol, atol=rtol))
+        return MoEResult(
+            ok=ok,
+            n_experts=n,
+            tokens=n * T,
+            max_abs_err=max_abs_err,
+            latency_ms=latency_ms,
+            error=None if ok else f"moe all_to_all mismatch: max|Δ|={max_abs_err:.3e}",
+        )
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return MoEResult(
+            ok=False,
+            n_experts=0,
+            tokens=0,
+            max_abs_err=float("inf"),
+            latency_ms=0.0,
+            error=f"{type(exc).__name__}: {exc}",
+        )

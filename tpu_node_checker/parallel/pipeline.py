@@ -1,0 +1,193 @@
+"""Pipeline parallelism: a GPipe-style staged forward pass as a fabric probe.
+
+The reference has no parallelism of any kind (SURVEY §2.3); this module gives
+the framework the pipeline-parallel (pp) axis of the standard dp/tp/pp/sp/ep
+set.  As a health probe it is the *neighbor-link* stressor: activations flow
+strictly device ``i`` → ``i+1`` every tick, so a single degraded ICI hop shows
+up as a numerics mismatch (or a hang) that psum-style all-reduces can average
+away.
+
+Design (all inside one ``shard_map`` + ``jit``, static shapes):
+
+* mesh axis ``pp`` of size ``n``; device ``s`` permanently holds the weights
+  of pipeline stage ``s`` (a tanh dense block — enough to make stage order
+  matter, so a mis-routed hop is detectable);
+* the input batch is cut into ``M`` microbatches; the schedule runs
+  ``M + n - 1`` ticks.  At tick ``t`` stage 0 injects microbatch ``t`` (while
+  any remain), every stage applies its block to the activation it holds, and
+  activations rotate one hop with ``ppermute`` — the classic GPipe fill/drain
+  diagram, expressed as a ``lax.fori_loop`` over a static tick count;
+* the last stage accumulates finished microbatches into a zero-initialised
+  buffer; a final ``psum`` over ``pp`` replicates the output (every other
+  stage contributed zeros), giving a closed-form verification target: the
+  sequential composition of all stage blocks on the host.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class PipelineResult:
+    ok: bool
+    n_stages: int
+    n_microbatches: int
+    max_abs_err: float
+    latency_ms: float
+    error: Optional[str] = None
+
+
+def make_pipeline(mesh, axis: str = "pp"):
+    """Build a jitted pipelined forward over ``mesh``'s ``axis``.
+
+    Returned fn maps stacked stage weights ``w`` (n, d, d) / ``b`` (n, d)
+    (sharded over ``axis``) and microbatched input ``x`` (M, B, d)
+    (replicated) to the output (M, B, d) (replicated) equal to applying
+    ``tanh(x @ w_s + b_s)`` for s = 0..n-1 in order.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_node_checker.parallel.mesh import device_varying, shard_map_fn
+
+    n = int(mesh.shape[axis])
+    sm = shard_map_fn()
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    def _local(w, b, x):
+        # Local shapes: w (1, d, d), b (1, d), x (M, B, d) replicated.
+        w = w[0]
+        b = b[0]
+        i = jax.lax.axis_index(axis)
+        M, B, d = x.shape
+        n_ticks = M + n - 1
+
+        state = device_varying(jnp.zeros((B, d), jnp.float32), axis)
+        outbuf = device_varying(jnp.zeros((M, B, d), jnp.float32), axis)
+
+        def tick(t, carry):
+            state, outbuf = carry
+            # Stage 0 injects microbatch t while any remain; other stages
+            # consume whatever the previous hop delivered.
+            inj = jax.lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+            )
+            cur = jnp.where((i == 0) & (t < M), inj, state)
+            # HIGHEST precision: TPU f32 matmuls default to bf16 passes, and a
+            # numerics *probe* must not flag that as a fault (cf. ring_attention).
+            y = jnp.tanh(
+                jnp.dot(
+                    cur,
+                    w,
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                + b
+            )
+            # The last stage finishes microbatch t-(n-1) at tick t.
+            mb = t - (n - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outbuf, y, jnp.clip(mb, 0, M - 1), axis=0
+            )
+            write = (i == n - 1) & (mb >= 0)
+            outbuf = jnp.where(write, upd, outbuf)
+            state = jax.lax.ppermute(y, axis, perm)
+            return state, outbuf
+
+        _, outbuf = jax.lax.fori_loop(0, n_ticks, tick, (state, outbuf))
+        # Only the last stage wrote non-zeros; psum replicates the result.
+        return jax.lax.psum(outbuf, axis)
+
+    return jax.jit(
+        sm(
+            _local,
+            mesh=mesh,
+            in_specs=(P(axis, None, None), P(axis, None), P()),
+            out_specs=P(),
+        )
+    )
+
+
+def reference_pipeline(w, b, x):
+    """Sequential stage composition on one device — ground truth."""
+    import jax
+    import jax.numpy as jnp
+
+    M, B, d = x.shape
+    out = x.reshape(M * B, d)
+    for s in range(w.shape[0]):
+        out = jnp.tanh(
+            jnp.dot(out, w[s], precision=jax.lax.Precision.HIGHEST) + b[s]
+        )
+    return out.reshape(M, B, d)
+
+
+def pipeline_probe(
+    mesh=None,
+    n_microbatches: int = 4,
+    batch: int = 2,
+    d_model: int = 32,
+    rtol: float = 1e-3,
+) -> PipelineResult:
+    """Run the pipelined forward across the mesh and verify against the
+    sequential reference — a wrong result localizes to a stage-to-stage hop."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_node_checker.parallel.mesh import MeshSpec, build_mesh, flat_mesh
+
+        if mesh is None:
+            mesh = build_mesh(MeshSpec((("pp", len(jax.devices())),)))
+        mesh = flat_mesh(mesh, "pp")
+        n = mesh.shape["pp"]
+
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        # Orthogonal-ish init keeps tanh activations away from saturation so
+        # per-stage signal survives n compositions.
+        w = jax.random.normal(keys[0], (n, d_model, d_model), jnp.float32) / np.sqrt(
+            d_model
+        )
+        b = jax.random.normal(keys[1], (n, d_model), jnp.float32) * 0.1
+        x = jax.random.normal(
+            keys[2], (n_microbatches, batch, d_model), jnp.float32
+        )
+
+        ws = jax.device_put(w, NamedSharding(mesh, P("pp", None, None)))
+        bs = jax.device_put(b, NamedSharding(mesh, P("pp", None)))
+        xs = jax.device_put(x, NamedSharding(mesh, P()))
+
+        fn = make_pipeline(mesh)
+        out = fn(ws, bs, xs)  # warmup: compile + first pass
+        out_host = np.asarray(jax.device_get(out))
+        t0 = time.perf_counter()
+        out_host = np.asarray(jax.device_get(fn(ws, bs, xs)))
+        latency_ms = (time.perf_counter() - t0) * 1e3
+
+        ref = np.asarray(jax.device_get(reference_pipeline(w, b, x)))
+        max_abs_err = float(np.max(np.abs(out_host - ref)))
+        ok = bool(np.allclose(out_host, ref, rtol=rtol, atol=rtol))
+        return PipelineResult(
+            ok=ok,
+            n_stages=n,
+            n_microbatches=n_microbatches,
+            max_abs_err=max_abs_err,
+            latency_ms=latency_ms,
+            error=None if ok else f"pipeline mismatch: max|Δ|={max_abs_err:.3e}",
+        )
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return PipelineResult(
+            ok=False,
+            n_stages=0,
+            n_microbatches=0,
+            max_abs_err=float("inf"),
+            latency_ms=0.0,
+            error=f"{type(exc).__name__}: {exc}",
+        )

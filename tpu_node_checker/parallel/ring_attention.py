@@ -41,16 +41,6 @@ class RingAttentionResult:
     error: Optional[str] = None
 
 
-def _shard_map():
-    import jax
-
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map  # pragma: no cover
-
-    return shard_map
-
-
 def make_ring_attention(mesh, axis: str = "sp"):
     """Build a jitted causal ring-attention fn over ``mesh``'s ``axis``.
 
@@ -61,8 +51,10 @@ def make_ring_attention(mesh, axis: str = "sp"):
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from tpu_node_checker.parallel.mesh import device_varying, shard_map_fn
+
     n = int(mesh.shape[axis])
-    sm = _shard_map()
+    sm = shard_map_fn()
 
     def _local(q, k, v):
         # Local shapes: (B, S_l, H, D).
@@ -118,19 +110,9 @@ def make_ring_attention(mesh, axis: str = "sp"):
             v_next = jax.lax.ppermute(v_blk, axis, perm)
             return (k_next, v_next, m_new, l_new, acc_new)
 
-        def _varying(x):
-            # The accumulators become device-varying inside the loop (they mix
-            # with axis_index); the initial constants must carry the same
-            # varying-manual-axes type or the fori_loop carry check rejects it.
-            if hasattr(jax.lax, "pcast"):
-                return jax.lax.pcast(x, (axis,), to="varying")
-            if hasattr(jax.lax, "pvary"):  # pragma: no cover
-                return jax.lax.pvary(x, (axis,))
-            return x  # pragma: no cover — pre-varying-types jax needs neither
-
-        m0 = _varying(jnp.full((B, H, S_l), neg, jnp.float32))
-        l0 = _varying(jnp.zeros((B, H, S_l), jnp.float32))
-        acc0 = _varying(jnp.zeros((B, S_l, H, D), jnp.float32))
+        m0 = device_varying(jnp.full((B, H, S_l), neg, jnp.float32), axis)
+        l0 = device_varying(jnp.zeros((B, H, S_l), jnp.float32), axis)
+        acc0 = device_varying(jnp.zeros((B, S_l, H, D), jnp.float32), axis)
         _, _, m, l, acc = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
         out = acc / jnp.swapaxes(l, 1, 2)[..., None]
         return out.astype(q.dtype)
@@ -178,13 +160,11 @@ def ring_attention_probe(
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from tpu_node_checker.parallel.mesh import MeshSpec, build_mesh
+        from tpu_node_checker.parallel.mesh import MeshSpec, build_mesh, flat_mesh
 
         if mesh is None:
             mesh = build_mesh(MeshSpec((("sp", len(jax.devices())),)))
-        if tuple(mesh.axis_names) != ("sp",):
-            devices = list(mesh.devices.flat)
-            mesh = build_mesh(MeshSpec((("sp", len(devices)),)), devices)
+        mesh = flat_mesh(mesh, "sp")
         n = mesh.shape["sp"]
         S = n * seq_per_device
 

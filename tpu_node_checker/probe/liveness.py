@@ -13,10 +13,13 @@ Probe levels (each includes the previous):
 * ``enumerate``  — backend init + device enumeration (platform, chip count);
 * ``compute``    — MXU matmul burn, HBM bandwidth sample, and a Pallas/Mosaic
                    kernel cross-check on one chip (:mod:`tpu_node_checker.ops`);
-* ``collective`` — psum/all_gather and a ppermute ring walk over all local
-                   chips (:mod:`tpu_node_checker.parallel`), exercising ICI;
-* ``workload``   — a sharded transformer training step and a ring-attention
-                   pass (:mod:`tpu_node_checker.models`): the full stack under
+* ``collective`` — psum/all_gather/reduce-scatter and a ppermute ring walk
+                   over all local chips (:mod:`tpu_node_checker.parallel`),
+                   exercising ICI;
+* ``workload``   — a sharded transformer training step plus ring-attention
+                   (sp), pipeline (pp) and expert-parallel all_to_all (ep)
+                   passes (:mod:`tpu_node_checker.models`,
+                   :mod:`tpu_node_checker.parallel`): the full stack under
                    combined load, the strongest health grade.
 """
 
@@ -129,6 +132,15 @@ try:
         ra = ring_attention_probe(seq_per_device=16)
         out["ring_attention_ok"] = ra.ok
         out["ok"] = out["ok"] and wl.ok and ra.ok
+        if n_dev > 1:
+            # Complete the parallelism surface: pipeline (pp) neighbor hops
+            # and expert-parallel (ep) all_to_all shuffles.
+            from tpu_node_checker.parallel import moe_probe, pipeline_probe
+            pp = pipeline_probe()
+            out["pipeline_ok"] = pp.ok
+            ep = moe_probe()
+            out["moe_ok"] = ep.ok
+            out["ok"] = out["ok"] and pp.ok and ep.ok
 except Exception as exc:  # noqa: BLE001 - the whole point is to catch anything
     out["error"] = f"{type(exc).__name__}: {exc}"
 out["elapsed_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
