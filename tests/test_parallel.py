@@ -9,6 +9,7 @@ from tpu_node_checker.parallel import (
     build_mesh,
     collective_probe,
     mesh_from_topology,
+    per_axis_probe,
     ring_probe,
 )
 
@@ -67,6 +68,38 @@ class TestCollectiveProbe:
         r = collective_probe(mesh=mesh, payload=32, timed_iters=1)
         assert r.ok, r.error
         assert r.n_devices == 4
+
+
+class TestPerAxisProbe:
+    def test_topology_2x4(self):
+        r = per_axis_probe(topology="2x4", payload=16)
+        assert r.ok, r.error
+        assert r.n_devices == 8
+        assert r.details["topology"] == "2x4"
+        assert r.details["axis_ok"] == {"t0": True, "t1": True}
+
+    def test_topology_2x2x2(self):
+        r = per_axis_probe(topology="2x2x2", payload=8)
+        assert r.ok, r.error
+        assert r.details["axis_ok"] == {"t0": True, "t1": True, "t2": True}
+
+    def test_explicit_mesh(self):
+        mesh = build_mesh(MeshSpec((("x", 4), ("y", 2))))
+        r = per_axis_probe(mesh=mesh, payload=8)
+        assert r.ok, r.error
+        assert r.details["axis_ok"] == {"x": True, "y": True}
+
+    def test_mismatched_topology_degrades_flat(self):
+        # Label promises 256 chips, mesh has 8 → flat single-axis fallback.
+        r = per_axis_probe(topology="16x16", payload=8)
+        assert r.ok, r.error
+        assert r.details["topology"] == "8"
+        assert list(r.details["axis_ok"]) == ["d"]
+
+    def test_never_raises(self):
+        r = per_axis_probe(payload=-1)
+        assert not r.ok
+        assert r.error
 
 
 class TestRingProbe:

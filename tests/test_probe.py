@@ -79,6 +79,12 @@ class TestComputeLevels:
         assert r.details.get("collective_ok") is True
         assert r.details.get("ring_ok") is True
 
+    def test_collective_level_with_topology_localizes_axes(self):
+        r = run_local_probe(level="collective", timeout_s=300, topology="2x4")
+        assert r.ok, r.error
+        assert r.details.get("ici_topology") == "2x4"
+        assert r.details.get("ici_axis_ok") == {"t0": True, "t1": True}
+
     def test_workload_level(self):
         r = run_local_probe(level="workload", timeout_s=600)
         assert r.ok, r.error

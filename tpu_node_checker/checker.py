@@ -113,6 +113,12 @@ def _run_probe(
         timeout_s=getattr(args, "probe_timeout", None),  # None → per-level budget
         expected_devices=expected,
         distributed=distributed,
+        # An explicit --probe-topology always wins; otherwise, with global
+        # (distributed) enumeration the mesh spans the slice, so the node's
+        # topology label describes the probed fabric and per-axis ICI
+        # localization applies.  Single-host probes only see local chips.
+        topology=getattr(args, "probe_topology", None)
+        or (local.tpu_topology if local and distributed else None),
     )
     if local is not None:
         local.probe = probed.to_dict()
@@ -275,6 +281,7 @@ def emit_probe(args) -> int:
         level=getattr(args, "probe_level", "enumerate"),
         timeout_s=getattr(args, "probe_timeout", None),
         distributed=getattr(args, "probe_distributed", False),
+        topology=getattr(args, "probe_topology", None),
     )
     doc = probed.to_dict()
     doc["written_at"] = time.time()  # staleness anchor for the aggregator

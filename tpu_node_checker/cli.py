@@ -114,6 +114,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        help="join the jax.distributed rendezvous before enumerating, so "
                        "the probe sees GLOBAL chips of a multi-host slice and its "
                        "collectives cross hosts")
+    probe.add_argument("--probe-topology", metavar="DIMS",
+                       help="torus topology of the probed fabric (e.g. 4x4x4); at "
+                       "collective level and above, runs one psum per dimension so a "
+                       "fault localizes to the sick ICI axis (auto-derived from the "
+                       "node's gke-tpu-topology label with --probe-distributed)")
     probe.add_argument("--probe-results-max-age", type=float, default=900.0,
                        metavar="SECONDS",
                        help="ignore probe reports older than this (default 900s) so a "

@@ -22,6 +22,7 @@ from tpu_node_checker.parallel.mesh import (
 from tpu_node_checker.parallel.collectives import (
     CollectiveResult,
     collective_probe,
+    per_axis_probe,
     ring_probe,
 )
 from tpu_node_checker.parallel.ring_attention import (
@@ -49,6 +50,7 @@ __all__ = [
     "mesh_from_topology",
     "CollectiveResult",
     "collective_probe",
+    "per_axis_probe",
     "ring_probe",
     "RingAttentionResult",
     "make_ring_attention",

@@ -131,6 +131,97 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
         )
 
 
+def per_axis_probe(
+    mesh=None, topology: Optional[str] = None, payload: int = 256
+) -> CollectiveResult:
+    """psum along EACH mesh axis separately — ICI *dimension* localization.
+
+    A TPU slice's ICI is a multi-dimensional torus (a v5p ``4x4x4`` topology
+    label promises three independent link dimensions).  The flat-mesh probes
+    answer "is the fabric healthy?"; this one answers "*which dimension* is
+    sick?": the mesh is shaped like the topology label
+    (:func:`tpu_node_checker.parallel.mesh.mesh_from_topology`), device
+    ``(c0, c1, …)`` contributes its linear index, and one ``psum`` runs per
+    axis.  Each reduction has a closed-form expected value computable on the
+    host, so a wrong sum names the exact torus dimension whose links corrupt
+    traffic — the single most actionable fact for slice triage.
+
+    With neither ``mesh`` nor a multi-dim ``topology`` (e.g. one flat axis),
+    this degrades to the plain psum check over one axis.
+
+    Verification happens **on-device**: every device derives its payload and
+    each axis's expected reduction from its own mesh coordinates
+    (``lax.axis_index``), and per-axis mismatch counts are all-reduced to a
+    replicated scalar.  The host only ever fetches replicated scalars, so the
+    probe works unchanged on multi-host slices where per-device shards are
+    not host-addressable.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_node_checker.parallel.mesh import mesh_from_topology, shard_map_fn
+
+        sm = shard_map_fn()
+        if mesh is None:
+            mesh = mesh_from_topology(topology)
+        axis_names = tuple(mesh.axis_names)
+        shape = tuple(mesh.devices.shape)
+        n = int(np.prod(shape))
+        if payload <= 0:
+            raise ValueError(f"payload must be positive, got {payload}")
+        # Row-major strides: device (c0, c1, …) carries linear index Σ cₖ·strideₖ.
+        strides = [1] * len(shape)
+        for a in range(len(shape) - 2, -1, -1):
+            strides[a] = strides[a + 1] * shape[a + 1]
+
+        def _probe():
+            idxs = [jax.lax.axis_index(nm) for nm in axis_names]
+            lin = sum(
+                (idx * s for idx, s in zip(idxs, strides)), jnp.int32(0)
+            ).astype(jnp.float32)
+            local = lin * jnp.ones((payload,), jnp.float32)
+            bad_counts = []
+            for a, nm in enumerate(axis_names):
+                total = jax.lax.psum(local, nm)
+                # Σ over the axis of (lin with coordinate a set to j):
+                # s_a·(lin − c_a·stride_a) + stride_a·s_a(s_a−1)/2.
+                s_a, st_a = shape[a], strides[a]
+                expected = s_a * (lin - idxs[a].astype(jnp.float32) * st_a) + (
+                    st_a * s_a * (s_a - 1) / 2.0
+                )
+                bad = jnp.sum((jnp.abs(total - expected) > 1e-3).astype(jnp.int32))
+                bad_counts.append(jax.lax.psum(bad, axis_names))
+            return tuple(bad_counts)
+
+        probe = jax.jit(
+            sm(_probe, mesh=mesh, in_specs=(), out_specs=tuple(P() for _ in shape))
+        )
+
+        t0 = time.perf_counter()
+        outs = probe()
+        jax.block_until_ready(outs)
+        latency_us = (time.perf_counter() - t0) * 1e6
+
+        axis_ok = {
+            name: int(outs[a]) == 0 for a, name in enumerate(axis_names)
+        }
+        bad = [f"{name}={shape[a]}" for a, name in enumerate(axis_names) if not axis_ok[name]]
+        ok = not bad
+        return CollectiveResult(
+            ok=ok,
+            n_devices=n,
+            latency_us=latency_us,
+            error=None if ok else f"ICI dimension fault localized to axis {', '.join(bad)}",
+            details={"topology": "x".join(str(s) for s in shape), "axis_ok": axis_ok},
+        )
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return CollectiveResult(
+            ok=False, n_devices=0, latency_us=0.0, error=f"{type(exc).__name__}: {exc}"
+        )
+
+
 def ring_probe(mesh=None, payload: int = 256) -> CollectiveResult:
     """Walk the device ring with ``ppermute``, one hop per ``lax.scan`` step.
 

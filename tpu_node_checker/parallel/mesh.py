@@ -106,11 +106,19 @@ def mesh_from_topology(
 ):
     """Mesh shaped like a GKE topology label (``"2x4"`` → axes t0=2, t1=4).
 
+    Device placement follows physical coordinates where the runtime exposes
+    them (``jax.experimental.mesh_utils.create_device_mesh`` consults TPU
+    ``device.coords``), so mesh axes line up with the physical ICI torus
+    dimensions — required for per-axis fault localization to name the *right*
+    dimension.  A naive row-major reshape over enumeration order is the
+    fallback (CPU meshes, older jax).
+
     Falls back to one flat axis over all devices when the label is absent or
     doesn't match the live device count — enumeration health is reported
     separately, and a flat mesh still lets collectives run.
     """
     import jax
+    from jax.sharding import Mesh
 
     devices = list(devices if devices is not None else jax.devices())
     dims = parse_topology(topology)
@@ -119,6 +127,13 @@ def mesh_from_topology(
         for d in dims:
             total *= d
         if total == len(devices):
-            spec = MeshSpec(tuple((f"{axis_prefix}{i}", d) for i, d in enumerate(dims)))
-            return build_mesh(spec, devices)
+            axis_names = tuple(f"{axis_prefix}{i}" for i in range(len(dims)))
+            try:
+                from jax.experimental import mesh_utils
+
+                arr = mesh_utils.create_device_mesh(dims, devices=devices)
+                return Mesh(arr, axis_names)
+            except Exception:
+                spec = MeshSpec(tuple(zip(axis_names, dims)))
+                return build_mesh(spec, devices)
     return build_mesh(MeshSpec((("d", len(devices)),)), devices)

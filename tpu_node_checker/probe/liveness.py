@@ -109,6 +109,19 @@ try:
         ring = ring_probe()
         out["ring_ok"] = ring.ok
         out["ok"] = out["ok"] and coll.ok and ring.ok
+        topo = os.environ.get("TNC_TOPOLOGY")
+        if topo and "x" in topo:
+            # Multi-dim topology label: probe each ICI torus dimension
+            # separately so a fault names the sick axis.  Runs regardless of
+            # the flat verdict — localization matters MOST when the flat
+            # collectives just failed.
+            from tpu_node_checker.parallel import per_axis_probe
+            ax = per_axis_probe(topology=topo)
+            out["ici_axis_ok"] = (ax.details or {}).get("axis_ok")
+            out["ici_topology"] = (ax.details or {}).get("topology")
+            if not ax.ok:
+                out["ok"] = False
+                out["error"] = ax.error
     if level == "workload" and out["ok"]:
         import jax as _jax
         from tpu_node_checker.models import BurninConfig, workload_probe
@@ -186,13 +199,16 @@ def run_local_probe(
     expected_devices: Optional[int] = None,
     python: Optional[str] = None,
     distributed: bool = False,
+    topology: Optional[str] = None,
 ) -> ProbeResult:
     """Probe this host's chips in a child process; never raises.
 
     ``expected_devices`` (e.g. a node's ``google.com/tpu`` allocatable count)
     turns a *partial* enumeration into a failure: 3 of 4 chips alive is a sick
     host even though ``jax.devices()`` succeeded.  ``timeout_s=None`` picks
-    the per-level budget from :data:`LEVEL_TIMEOUTS_S`.
+    the per-level budget from :data:`LEVEL_TIMEOUTS_S`.  ``topology`` (a GKE
+    label like ``"4x4x4"``) enables per-ICI-dimension fault localization at
+    the collective level and above.
     """
     if level not in LEVELS:
         raise ValueError(f"unknown probe level {level!r}; expected one of {LEVELS}")
@@ -203,6 +219,8 @@ def run_local_probe(
     child_env = {**os.environ, "PYTHONPATH": _pythonpath()}
     if distributed:
         child_env["TNC_PROBE_DISTRIBUTED"] = "1"
+    if topology:
+        child_env["TNC_TOPOLOGY"] = topology
     try:
         proc = subprocess.run(
             [python or sys.executable, "-c", _CHILD_SCRIPT, level],
