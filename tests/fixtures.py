@@ -120,6 +120,38 @@ def tpu_v5e_256_slice(not_ready: int = 0) -> List[dict]:
     ]
 
 
+def big_mixed_cluster(
+    cpu: int = 3000, gpu: int = 1000, tpu_slices: int = 16
+) -> List[dict]:
+    """Scale config: thousands of nodes, many slices — the LIST payload a
+    large production cluster returns.  Each TPU slice is a v5e-256 (64 hosts)
+    in its own node pool."""
+    nodes = cpu_only_cluster(cpu)
+    nodes += [
+        make_node(
+            f"gke-gpu-big-{i:04d}",
+            allocatable={"nvidia.com/gpu": "8"},
+            labels={"cloud.google.com/gke-accelerator": "nvidia-h100-80gb"},
+        )
+        for i in range(gpu)
+    ]
+    for s in range(tpu_slices):
+        nodes += [
+            make_node(
+                f"gke-tpu-big-{s:02d}-{i:03d}",
+                allocatable={"google.com/tpu": "4"},
+                labels={
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                    "cloud.google.com/gke-tpu-topology": "16x16",
+                    "cloud.google.com/gke-nodepool": f"v5e-big-pool-{s:02d}",
+                },
+                taints=[TPU_TAINT],
+            )
+            for i in range(64)
+        ]
+    return nodes
+
+
 def mixed_cluster_one_notready() -> List[dict]:
     """Config 5: GPU pool + v5e slice where one TPU host is NotReady."""
     nodes = gpu_pool(2)

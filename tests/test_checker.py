@@ -280,3 +280,25 @@ class TestSlackIntegration:
         )
         assert code == 0
         assert "failed" in capsys.readouterr().err
+
+
+class TestScale:
+    """Large-cluster robustness: thousands of nodes through the full
+    detect → group → report path, inside the <2 s north-star budget."""
+
+    def test_big_cluster_counts_and_latency(self, capsys):
+        import time
+
+        nodes = fx.big_mixed_cluster(cpu=3000, gpu=1000, tpu_slices=16)
+        args = args_for("--json")
+        t0 = time.perf_counter()
+        result = checker.run_check(args, nodes=nodes)
+        elapsed_s = time.perf_counter() - t0
+        assert result.exit_code == 0
+        assert result.payload["total_nodes"] == 1000 + 16 * 64
+        assert result.payload["total_chips"] == 1000 * 8 + 16 * 256
+        assert len(result.payload["slices"]) == 16
+        assert all(s["complete"] for s in result.payload["slices"])
+        # 5024 nodes parsed, grouped, and reported: the in-process path must
+        # stay well inside the 2 s budget (generous bound for slow CI).
+        assert elapsed_s < 2.0, f"scale check took {elapsed_s:.2f}s"

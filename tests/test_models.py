@@ -70,6 +70,18 @@ class TestWorkloadProbe:
         assert not r.ok
         assert r.error
 
+    def test_remat_matches_no_remat(self):
+        # jax.checkpoint trades FLOPs for HBM; the loss trajectory must be
+        # bit-compatible up to float noise.
+        import dataclasses
+
+        r1 = workload_probe(TINY, steps=2, seed=3)
+        r2 = workload_probe(
+            dataclasses.replace(TINY, remat=True), steps=2, seed=3
+        )
+        assert r1.ok and r2.ok, (r1.error, r2.error)
+        np.testing.assert_allclose(r1.losses, r2.losses, rtol=1e-4)
+
 
 class TestShardedStep:
     def test_params_actually_sharded(self):

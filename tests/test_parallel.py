@@ -50,11 +50,10 @@ class TestCollectiveProbe:
         r = collective_probe(payload=64, timed_iters=2)
         assert r.ok, r.error
         assert r.n_devices == 8
-        assert r.details == {
-            "psum_ok": True,
-            "all_gather_ok": True,
-            "reduce_scatter_ok": True,
-        }
+        assert r.details["psum_ok"] is True
+        assert r.details["all_gather_ok"] is True
+        assert r.details["reduce_scatter_ok"] is True
+        assert r.details["busbw_gbps"] >= 0
         assert r.latency_us > 0
 
     def test_over_2d_mesh_flattened(self):
@@ -107,7 +106,8 @@ class TestRingProbe:
         r = ring_probe(payload=32)
         assert r.ok, r.error
         assert r.n_devices == 8
-        assert r.details == {"hops": 8}
+        assert r.details["hops"] == 8
+        assert r.details["link_gbps"] >= 0
 
     def test_ring_over_2d_mesh(self):
         mesh = build_mesh(MeshSpec((("x", 4), ("y", 2))))

@@ -108,6 +108,17 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
         total.block_until_ready()
         latency_us = (time.perf_counter() - t0) / timed_iters * 1e6
 
+        # Ring all-reduce bus bandwidth: each device moves 2(n−1)/n of its
+        # local shard across ICI per reduction (the NCCL/XLA busbw convention,
+        # so numbers compare against published per-link specs).  The timed
+        # program runs all three collectives but the full wall time is charged
+        # to the psum alone, so the figure is a LOWER bound — a health probe
+        # must under-report bandwidth, never flatter a degraded fabric.
+        local_bytes = payload * 4
+        busbw_gbps = 0.0
+        if n > 1 and latency_us > 0:
+            busbw_gbps = (2 * (n - 1) / n * local_bytes) / (latency_us * 1e-6) / 1e9
+
         ok = sum_ok and gather_ok and scatter_ok
         return CollectiveResult(
             ok=ok,
@@ -123,6 +134,7 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
                 "psum_ok": sum_ok,
                 "all_gather_ok": gather_ok,
                 "reduce_scatter_ok": scatter_ok,
+                "busbw_gbps": round(busbw_gbps, 3),
             },
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
@@ -260,18 +272,24 @@ def ring_probe(mesh=None, payload: int = 256) -> CollectiveResult:
 
         full_ring = jax.jit(sm(_full_ring, mesh=mesh, in_specs=P("d"), out_specs=P("d")))
 
+        full_ring(x).block_until_ready()  # warmup: compile outside the timing
         t0 = time.perf_counter()
         out = full_ring(x)
         out.block_until_ready()
         latency_us = (time.perf_counter() - t0) * 1e6
 
         ok = bool(np.allclose(np.asarray(out), np.asarray(x)))
+        # Every device pushes its payload one hop per step, n steps total:
+        # per-hop link bandwidth ≈ payload bytes / (wall time / hops).
+        link_gbps = 0.0
+        if n > 1 and latency_us > 0:
+            link_gbps = (payload * 4) / (latency_us / n * 1e-6) / 1e9
         return CollectiveResult(
             ok=ok,
             n_devices=n,
             latency_us=latency_us,
             error=None if ok else "ring ppermute did not return payloads to origin",
-            details={"hops": n},
+            details={"hops": n, "link_gbps": round(link_gbps, 3)},
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
         return CollectiveResult(

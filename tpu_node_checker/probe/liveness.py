@@ -106,8 +106,10 @@ try:
         coll = collective_probe()
         out["collective_ok"] = coll.ok
         out["collective_latency_us"] = round(coll.latency_us, 1)
+        out["collective_busbw_gbps"] = (coll.details or {}).get("busbw_gbps")
         ring = ring_probe()
         out["ring_ok"] = ring.ok
+        out["ring_link_gbps"] = (ring.details or {}).get("link_gbps")
         out["ok"] = out["ok"] and coll.ok and ring.ok
         topo = os.environ.get("TNC_TOPOLOGY")
         if topo and "x" in topo:
