@@ -33,6 +33,30 @@ class TestRenderMetrics:
         assert ('tpu_node_checker_slice_ready_chips{nodepool="v5p-pool",'
                 'slice="v5p-pool",topology="4x4x4"} 56') in text
 
+    def test_probe_telemetry_exported(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["local_probe"] = {
+            "ok": True,
+            "level": "collective",
+            "device_count": 4,
+            "matmul_tflops": 3.9,
+            "hbm_gbps": 2.2,
+            "collective_busbw_gbps": 12.5,
+            "ring_link_gbps": 40.0,
+            "ici_axis_ok": {"t0": True},  # non-numeric: must not be exported
+        }
+        text = render_metrics(result)
+        assert 'tpu_node_checker_probe_ok{level="collective"} 1.0' in text
+        assert "tpu_node_checker_probe_devices 4" in text
+        assert "tpu_node_checker_probe_matmul_tflops 3.9" in text
+        assert "tpu_node_checker_probe_collective_busbw_gbps 12.5" in text
+        assert "tpu_node_checker_probe_ring_link_gbps 40.0" in text
+        assert "ici_axis_ok" not in text
+
+    def test_no_probe_no_probe_families(self):
+        text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
+        assert "tpu_node_checker_probe_ok" not in text
+
     def test_single_host_slice_pool_unique_series(self):
         # N single-host slices in one pool share nodepool+topology; the
         # "slice" label must keep every series unique or Prometheus drops

@@ -13,7 +13,10 @@ Exported metric families:
 * ``tpu_node_checker_slice_ready_chips{nodepool,topology}`` / ``..._expected_chips``;
 * ``tpu_node_checker_exit_code`` — the would-be CLI exit code (0/2/3);
 * ``tpu_node_checker_check_duration_ms`` — end-to-end phase total;
-* ``tpu_node_checker_last_run_timestamp_seconds`` — staleness detector.
+* ``tpu_node_checker_last_run_timestamp_seconds`` — staleness detector;
+* ``tpu_node_checker_probe_*`` — when ``--probe`` ran: pass/fail by level and
+  numeric chip telemetry (device count, MXU TFLOP/s, HBM/DMA GB/s, collective
+  bus and per-link ICI bandwidth, workload step time).
 """
 
 from __future__ import annotations
@@ -86,6 +89,31 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
         "Chips the slice topology label promises.",
         [(slice_labels(s), s.get("expected_chips") or 0) for s in slices],
     )
+    probe = payload.get("local_probe")
+    if probe:
+        family(
+            "tpu_node_checker_probe_ok",
+            "gauge",
+            "1 when the local chip probe passed at its level.",
+            [({"level": probe.get("level", "")}, 1.0 if probe.get("ok") else 0.0)],
+        )
+        telemetry = [
+            # (payload key, metric suffix, help)
+            ("device_count", "probe_devices", "Chips the probe enumerated."),
+            ("matmul_tflops", "probe_matmul_tflops", "MXU burn throughput."),
+            ("hbm_gbps", "probe_hbm_gbps", "HBM streaming bandwidth sample."),
+            ("dma_gbps", "probe_dma_gbps", "DMA-engine stream bandwidth."),
+            ("collective_busbw_gbps", "probe_collective_busbw_gbps",
+             "Ring all-reduce bus bandwidth lower bound."),
+            ("ring_link_gbps", "probe_ring_link_gbps",
+             "Per-hop ICI link bandwidth from the ppermute ring walk."),
+            ("workload_step_ms", "probe_workload_step_ms",
+             "Sharded train-step time at the workload level."),
+        ]
+        for key, suffix, help_text in telemetry:
+            value = probe.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                family(f"tpu_node_checker_{suffix}", "gauge", help_text, [({}, value)])
     family(
         "tpu_node_checker_exit_code",
         "gauge",

@@ -114,10 +114,14 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
         # program runs all three collectives but the full wall time is charged
         # to the psum alone, so the figure is a LOWER bound — a health probe
         # must under-report bandwidth, never flatter a degraded fabric.
+        # None (not 0.0) when there is no fabric to measure: a zero would be
+        # indistinguishable from a dead interconnect on a metrics scrape.
         local_bytes = payload * 4
-        busbw_gbps = 0.0
+        busbw_gbps = None
         if n > 1 and latency_us > 0:
-            busbw_gbps = (2 * (n - 1) / n * local_bytes) / (latency_us * 1e-6) / 1e9
+            busbw_gbps = round(
+                (2 * (n - 1) / n * local_bytes) / (latency_us * 1e-6) / 1e9, 3
+            )
 
         ok = sum_ok and gather_ok and scatter_ok
         return CollectiveResult(
@@ -134,7 +138,7 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
                 "psum_ok": sum_ok,
                 "all_gather_ok": gather_ok,
                 "reduce_scatter_ok": scatter_ok,
-                "busbw_gbps": round(busbw_gbps, 3),
+                "busbw_gbps": busbw_gbps,
             },
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
@@ -281,15 +285,16 @@ def ring_probe(mesh=None, payload: int = 256) -> CollectiveResult:
         ok = bool(np.allclose(np.asarray(out), np.asarray(x)))
         # Every device pushes its payload one hop per step, n steps total:
         # per-hop link bandwidth ≈ payload bytes / (wall time / hops).
-        link_gbps = 0.0
+        # None when n == 1 — no links exist, and 0.0 would read as a dead one.
+        link_gbps = None
         if n > 1 and latency_us > 0:
-            link_gbps = (payload * 4) / (latency_us / n * 1e-6) / 1e9
+            link_gbps = round((payload * 4) / (latency_us / n * 1e-6) / 1e9, 3)
         return CollectiveResult(
             ok=ok,
             n_devices=n,
             latency_us=latency_us,
             error=None if ok else "ring ppermute did not return payloads to origin",
-            details={"hops": n, "link_gbps": round(link_gbps, 3)},
+            details={"hops": n, "link_gbps": link_gbps},
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
         return CollectiveResult(
