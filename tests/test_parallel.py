@@ -100,6 +100,23 @@ class TestPerAxisProbe:
         assert not r.ok
         assert r.error
 
+    def test_injected_fault_localizes_to_its_axis_only(self):
+        # The localization CONTRACT: a fault on t1 is reported as t1 and
+        # nothing else — exercised via the chaos hook since real CPU "ICI"
+        # cannot be corrupted.
+        r = per_axis_probe(topology="2x4", payload=8, inject_fault_axis="t1")
+        assert not r.ok
+        assert r.details["axis_ok"] == {"t0": True, "t1": False}
+        assert "t1=4" in r.error
+        assert "t0" not in r.error
+
+    def test_injecting_into_unknown_axis_fails_loudly(self):
+        # Topology 16x16 mismatches 8 devices → flat fallback axis "d";
+        # injecting into the now-nonexistent t1 must NOT silently pass.
+        r = per_axis_probe(topology="16x16", payload=8, inject_fault_axis="t1")
+        assert not r.ok
+        assert "not in mesh axes" in r.error
+
 
 class TestRingProbe:
     def test_full_ring(self):

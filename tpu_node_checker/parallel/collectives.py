@@ -148,7 +148,10 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
 
 
 def per_axis_probe(
-    mesh=None, topology: Optional[str] = None, payload: int = 256
+    mesh=None,
+    topology: Optional[str] = None,
+    payload: int = 256,
+    inject_fault_axis: Optional[str] = None,
 ) -> CollectiveResult:
     """psum along EACH mesh axis separately — ICI *dimension* localization.
 
@@ -171,6 +174,10 @@ def per_axis_probe(
     replicated scalar.  The host only ever fetches replicated scalars, so the
     probe works unchanged on multi-host slices where per-device shards are
     not host-addressable.
+
+    ``inject_fault_axis`` perturbs the reduction on the named axis — a chaos
+    hook so the localization contract ("a fault on axis X is reported as axis
+    X, and only X") is testable on healthy hardware.
     """
     try:
         import jax
@@ -187,6 +194,12 @@ def per_axis_probe(
         n = int(np.prod(shape))
         if payload <= 0:
             raise ValueError(f"payload must be positive, got {payload}")
+        if inject_fault_axis is not None and inject_fault_axis not in axis_names:
+            # A chaos run that silently injects nothing would "validate" the
+            # harness without testing it (e.g. after a flat-mesh fallback).
+            raise ValueError(
+                f"inject_fault_axis {inject_fault_axis!r} not in mesh axes {axis_names}"
+            )
         # Row-major strides: device (c0, c1, …) carries linear index Σ cₖ·strideₖ.
         strides = [1] * len(shape)
         for a in range(len(shape) - 2, -1, -1):
@@ -201,6 +214,8 @@ def per_axis_probe(
             bad_counts = []
             for a, nm in enumerate(axis_names):
                 total = jax.lax.psum(local, nm)
+                if nm == inject_fault_axis:
+                    total = total + 1.0  # simulated link corruption
                 # Σ over the axis of (lin with coordinate a set to j):
                 # s_a·(lin − c_a·stride_a) + stride_a·s_a(s_a−1)/2.
                 s_a, st_a = shape[a], strides[a]
