@@ -1,0 +1,66 @@
+"""Pallas flash-attention kernel tests (interpret mode on the CPU mesh).
+
+The Mosaic compile path itself only exists on real TPU hardware; these tests
+pin the kernel's algorithm — online softmax, block scheduling, causal
+structure — which is identical in interpret mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_node_checker.ops import flash_attention, flash_attention_probe
+from tpu_node_checker.ops.flash_attention import BLOCK, _xla_causal_attention
+
+
+class TestFlashAttentionProbe:
+    def test_matches_xla(self):
+        r = flash_attention_probe(seq=256)
+        assert r.ok, r.error
+        assert r.interpreted is True  # CPU mesh → interpret mode
+        assert r.max_abs_err < 2e-2
+
+    def test_invalid_seq_is_usage_error(self):
+        r = flash_attention_probe(seq=100)
+        assert not r.ok
+        assert "multiple of" in r.error
+
+    def test_probe_never_raises(self):
+        r = flash_attention_probe(seq=256, head_dim=0)
+        assert not r.ok
+        assert r.error
+
+
+class TestFlashAttentionKernel:
+    def _qkv(self, seed=0, B=1, H=2, S=256, D=64, dtype=jnp.float32):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        return tuple(jax.random.normal(k, (B, H, S, D), dtype) for k in ks)
+
+    def test_f32_tight_match(self):
+        q, k, v = self._qkv()
+        out = flash_attention(q, k, v, interpret=True)
+        ref = _xla_causal_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+
+    def test_causality(self):
+        # Query block 0 must be blind to K/V beyond the first block.
+        q, k, v = self._qkv(seed=1)
+        out_a = flash_attention(q, k, v, interpret=True)
+        k2 = k.at[:, :, BLOCK:].set(0.0)
+        v2 = v.at[:, :, BLOCK:].set(0.0)
+        out_b = flash_attention(q, k2, v2, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out_a)[:, :, :BLOCK],
+            np.asarray(out_b)[:, :, :BLOCK],
+            rtol=1e-5,
+        )
+        # ...and later blocks must NOT be blind to earlier K/V.
+        assert not np.allclose(
+            np.asarray(out_a)[:, :, BLOCK:], np.asarray(out_b)[:, :, BLOCK:]
+        )
+
+    def test_bf16_dtype_preserved(self):
+        q, k, v = self._qkv(seed=2, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, interpret=True)
+        assert out.dtype == jnp.bfloat16

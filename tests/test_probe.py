@@ -72,6 +72,7 @@ class TestComputeLevels:
         assert r.details.get("matmul_ok") is True
         assert r.details.get("matmul_tflops", 0) > 0
         assert r.details.get("hbm_gbps", 0) > 0
+        assert r.details.get("flash_attention_ok") is True
 
     def test_collective_level(self):
         r = run_local_probe(level="collective", timeout_s=300)

@@ -18,6 +18,11 @@ caller, not here.
 
 from tpu_node_checker.ops.burn import BurnResult, matmul_burn
 from tpu_node_checker.ops.dma_probe import DmaProbeResult, dma_stream_probe
+from tpu_node_checker.ops.flash_attention import (
+    FlashAttentionProbeResult,
+    flash_attention,
+    flash_attention_probe,
+)
 from tpu_node_checker.ops.hbm import HbmResult, hbm_bandwidth_probe
 from tpu_node_checker.ops.pallas_probe import PallasProbeResult, pallas_matmul_probe
 
@@ -26,6 +31,9 @@ __all__ = [
     "matmul_burn",
     "DmaProbeResult",
     "dma_stream_probe",
+    "FlashAttentionProbeResult",
+    "flash_attention",
+    "flash_attention_probe",
     "HbmResult",
     "hbm_bandwidth_probe",
     "PallasProbeResult",

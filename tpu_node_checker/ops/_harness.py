@@ -1,0 +1,46 @@
+"""Shared scaffold for Pallas kernel cross-check probes.
+
+Both Mosaic probes (tiled matmul, flash attention) follow one shape: resolve
+the target device and whether to run the kernel in interpreter mode, then
+warm up (compile), then time a steady-state run with a checksum fetch as the
+completion barrier.  Kept here so the two probes can't drift apart on the
+backend-resolution or timing rules.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def resolve_backend(
+    device: Optional[jax.Device] = None, interpret: Optional[bool] = None
+) -> Tuple[jax.Device, bool]:
+    """Pick the probe device and the Pallas interpret flag.
+
+    ``interpret=None`` means "Mosaic on TPU, interpreter elsewhere" — the CPU
+    test mesh exercises the same kernel code path without a Mosaic backend.
+    """
+    device = device or jax.local_devices()[0]
+    if interpret is None:
+        interpret = device.platform != "tpu"
+    return device, bool(interpret)
+
+
+def timed_run(fn, *args) -> Tuple[jax.Array, float, float]:
+    """(output, checksum, steady-state ms) for a jitted ``fn``.
+
+    First call compiles; the timed second call fetches a scalar checksum as
+    the completion barrier (see ops.burn — through the axon tunnel,
+    ``block_until_ready`` can return before work is observable).
+    """
+    out = fn(*args)
+    checksum = float(jnp.sum(out.astype(jnp.float32)))
+    t0 = time.perf_counter()
+    out = fn(*args)
+    checksum = float(jnp.sum(out.astype(jnp.float32)))
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    return out, checksum, elapsed_ms

@@ -1,0 +1,197 @@
+"""Pallas flash-attention kernel — the framework's hot op, Mosaic-compiled.
+
+Attention dominates the workload-level probes (burn-in transformer, ring
+attention), and on serving/training stacks it is the op most often replaced
+by a custom kernel.  This module provides that kernel for the probe suite: a
+blockwise causal flash-attention forward written in Pallas, so the chip
+executes Mosaic-emitted MXU matmuls, VPU online-softmax arithmetic, and VMEM
+block staging on the exact memory-access pattern production kernels use —
+then cross-checks the result against XLA's attention.
+
+Kernel design (per the TPU tiling rules in the Pallas guide):
+
+* grid ``(B, H, S/BLOCK_Q)``; each program owns one 128-row query block —
+  128 matches both the MXU systolic dimension and the f32/bf16 lane tiling;
+* K/V stream through the kernel in 128-row blocks via ``pl.ds`` slices of a
+  VMEM-resident (S, D) ref; the causal structure makes the loop trip count
+  ``qi + 1``, so later query blocks do strictly more work (flash-style work
+  skipping, not masking-only);
+* online softmax (running max ``m``, denominator ``l``, accumulator ``acc``)
+  carried as ``fori_loop`` state in f32; only the diagonal block applies the
+  triangular mask, off-diagonal blocks are fully visible;
+* bf16 inputs, f32 accumulation via ``preferred_element_type`` — the MXU's
+  native regime.
+
+On non-TPU backends the kernel runs in interpreter mode (same code path
+shape, no Mosaic), keeping the probe testable on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_node_checker.ops._harness import resolve_backend, timed_run
+
+BLOCK = 128  # query/key block rows: MXU-native, and the bf16 lane tile
+
+
+@dataclass
+class FlashAttentionProbeResult:
+    ok: bool
+    max_abs_err: float
+    elapsed_ms: float
+    interpreted: bool
+    error: Optional[str] = None
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Causal flash attention over (B, H, S, D); S must divide into 128-blocks.
+
+    Returns the same shape/dtype as ``q``; accumulation is f32 throughout.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    if S % BLOCK:
+        raise ValueError(f"seq len {S} must be a multiple of {BLOCK}")
+    n_q = S // BLOCK
+    scale = 1.0 / np.sqrt(D)
+
+    def kernel(q_ref, k_ref, v_ref, out_ref):
+        qi = pl.program_id(2)
+        q_blk = q_ref[0, 0].astype(jnp.float32) * scale  # (BLOCK, D)
+
+        neg = jnp.float32(-1e30)
+        # Causal mask from iota comparisons: Mosaic lowers these natively,
+        # where a materialized boolean constant would need an unsupported
+        # i8→i1 truncation.
+        row = jax.lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 1)
+        tril = row >= col
+
+        def body(kj, carry):
+            m, l, acc = carry
+            k_blk = k_ref[0, 0, pl.ds(kj * BLOCK, BLOCK), :].astype(jnp.float32)
+            v_blk = v_ref[0, 0, pl.ds(kj * BLOCK, BLOCK), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q_blk,
+                k_blk,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (BLOCK, BLOCK)
+            # Only the diagonal block is partially visible under causality.
+            s = jnp.where(jnp.logical_or(kj < qi, tril), s, neg)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            corr = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_new = l * corr + p.sum(axis=-1)
+            pv = jax.lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_new = acc * corr[:, None] + pv
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((BLOCK,), neg, jnp.float32)
+        l0 = jnp.zeros((BLOCK,), jnp.float32)
+        acc0 = jnp.zeros((BLOCK, D), jnp.float32)
+        # Causal work skipping: query block qi only ever sees K/V blocks 0..qi.
+        m, l, acc = jax.lax.fori_loop(0, qi + 1, body, (m0, l0, acc0))
+        out_ref[0, 0] = (acc / l[:, None]).astype(out_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(B, H, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, BLOCK, D), lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _xla_causal_attention(q, k, v):
+    """XLA ground truth, f32, same (B, H, S, D) layout."""
+    B, H, S, D = q.shape
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ) / np.sqrt(D)
+    mask = jnp.where(jnp.tril(jnp.ones((S, S), jnp.bool_)), 0.0, -1e30)
+    p = jax.nn.softmax(s + mask[None, None], axis=-1)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return out.astype(q.dtype)
+
+
+def flash_attention_probe(
+    batch: int = 1,
+    heads: int = 2,
+    seq: int = 512,
+    head_dim: int = 128,
+    tol: float = 2e-2,
+    interpret: Optional[bool] = None,
+    device: Optional[jax.Device] = None,
+) -> FlashAttentionProbeResult:
+    """Run the Mosaic flash-attention kernel and cross-check against XLA.
+
+    A mismatch means the Mosaic path (VMEM staging, in-kernel loop, MXU
+    blocks) disagrees with HLO on this chip — invisible to every jnp-only
+    probe.  Tolerance accommodates bf16 inputs; accumulation is f32 on both
+    sides.
+    """
+    try:
+        if seq % BLOCK:
+            return FlashAttentionProbeResult(
+                ok=False, max_abs_err=float("inf"), elapsed_ms=0.0,
+                interpreted=bool(interpret),
+                error=f"invalid seq {seq}: must be a multiple of {BLOCK}",
+            )
+        device, interpret = resolve_backend(device, interpret)
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        shape = (batch, heads, seq, head_dim)
+        q, k, v = (
+            jax.device_put(jax.random.normal(kk, shape, jnp.bfloat16), device)
+            for kk in keys
+        )
+
+        run = jax.jit(partial(flash_attention, interpret=interpret))
+        out, checksum, elapsed_ms = timed_run(run, q, k, v)
+
+        ref = _xla_causal_attention(q, k, v)
+        max_abs_err = float(
+            jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+        )
+        ok = max_abs_err < tol and np.isfinite(checksum)
+        return FlashAttentionProbeResult(
+            ok=bool(ok),
+            max_abs_err=max_abs_err,
+            elapsed_ms=elapsed_ms,
+            interpreted=bool(interpret),
+            error=None if ok else f"flash/XLA mismatch: max|Δ|={max_abs_err:.3e}",
+        )
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return FlashAttentionProbeResult(
+            ok=False, max_abs_err=float("inf"), elapsed_ms=0.0,
+            interpreted=bool(interpret), error=f"{type(exc).__name__}: {exc}",
+        )

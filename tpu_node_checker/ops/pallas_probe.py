@@ -17,7 +17,6 @@ Mosaic — which keeps the probe testable on the CPU mesh.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional
@@ -25,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from tpu_node_checker.ops._harness import resolve_backend, timed_run
 
 
 @dataclass
@@ -80,9 +81,7 @@ def pallas_matmul_probe(
                 interpreted=bool(interpret),
                 error=f"invalid shape ({m},{k},{n}): dims must be multiples of 128",
             )
-        device = device or jax.local_devices()[0]
-        if interpret is None:
-            interpret = device.platform != "tpu"
+        device, interpret = resolve_backend(device, interpret)
         key = jax.random.PRNGKey(0)
         ka, kb = jax.random.split(key)
         a = jax.device_put(jax.random.normal(ka, (m, k), jnp.bfloat16), device)
@@ -93,12 +92,7 @@ def pallas_matmul_probe(
         ref_fn = jax.jit(
             lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32) * scale
         )
-        out = run(a, b)
-        checksum = float(jnp.sum(out))  # completion barrier (see ops.burn)
-        t0 = time.perf_counter()
-        out = run(a, b)
-        checksum = float(jnp.sum(out))
-        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        out, checksum, elapsed_ms = timed_run(run, a, b)
 
         ref = ref_fn(a, b)
         denom = jnp.maximum(jnp.abs(ref), 1.0)

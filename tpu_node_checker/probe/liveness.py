@@ -11,8 +11,9 @@ import error — degrades to a structured failure, never an exception.
 Probe levels (each includes the previous):
 
 * ``enumerate``  — backend init + device enumeration (platform, chip count);
-* ``compute``    — MXU matmul burn, HBM bandwidth sample, and a Pallas/Mosaic
-                   kernel cross-check on one chip (:mod:`tpu_node_checker.ops`);
+* ``compute``    — MXU matmul burn, HBM bandwidth sample, and Pallas/Mosaic
+                   kernel cross-checks (tiled matmul + flash attention) on one
+                   chip (:mod:`tpu_node_checker.ops`);
 * ``collective`` — psum/all_gather/reduce-scatter and a ppermute ring walk
                    over all local chips (:mod:`tpu_node_checker.parallel`),
                    exercising ICI;
@@ -96,11 +97,19 @@ try:
         out["hbm_ok"] = hbm.ok
         pallas = pallas_matmul_probe()
         out["pallas_ok"] = pallas.ok
+        from tpu_node_checker.ops import flash_attention_probe
+        fa = flash_attention_probe(seq=256)
+        out["flash_attention_ok"] = fa.ok
+        if not fa.ok:
+            # Triage needs the magnitude: near-tolerance drift vs inf blowup
+            # vs a Mosaic compile crash are different repairs.
+            out["flash_attention_err"] = fa.error
+            out["flash_attention_max_abs_err"] = fa.max_abs_err
         from tpu_node_checker.ops import dma_stream_probe
         dma = dma_stream_probe()
         out["dma_ok"] = dma.ok
         out["dma_gbps"] = round(dma.gbps, 2)
-        out["ok"] = out["ok"] and burn.ok and hbm.ok and pallas.ok and dma.ok
+        out["ok"] = out["ok"] and burn.ok and hbm.ok and pallas.ok and fa.ok and dma.ok
     if level in ("collective", "workload") and out["ok"]:
         from tpu_node_checker.parallel import collective_probe, ring_probe
         coll = collective_probe()
