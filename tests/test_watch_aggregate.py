@@ -77,6 +77,45 @@ class TestProbeResultsAggregation:
         assert code == 0
         payload = json.loads(capsys.readouterr().out)
         assert all(n["probe"]["ok"] for n in payload["nodes"])
+        assert payload["probe_summary"] == {
+            "hosts_reported": 16,
+            "hosts_ok": 16,
+            "hosts_failed": [],
+        }
+
+    def test_probe_summary_names_failed_hosts(self, tmp_path, capsys):
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        for i in range(16):
+            self._write_report(reports, f"gke-tpu-v5p-{i}", ok=i not in (2, 5))
+        result = checker.run_check(
+            args_for("--probe-results", str(reports), "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert result.payload["probe_summary"] == {
+            "hosts_reported": 16,
+            "hosts_ok": 14,
+            "hosts_failed": ["gke-tpu-v5p-2", "gke-tpu-v5p-5"],
+        }
+
+    def test_no_reports_no_summary(self):
+        result = checker.run_check(args_for("--json"), nodes=fx.tpu_v5p_64_slice())
+        assert "probe_summary" not in result.payload
+
+    def test_local_probe_alone_produces_no_fleet_summary(self, monkeypatch):
+        # A single-host --probe run covers one host; a fleet-looking
+        # "hosts_failed: []" would misread as fleet-wide health.
+        monkeypatch.setattr(
+            checker,
+            "_run_probe",
+            lambda args, accel, result, slices=(): accel[0].__setattr__(
+                "probe", {"ok": True, "level": "enumerate"}
+            ),
+        )
+        result = checker.run_check(
+            args_for("--probe", "--json"), nodes=fx.tpu_v5p_64_slice()
+        )
+        assert "probe_summary" not in result.payload
 
     def test_malformed_report_skipped(self, tmp_path, capsys):
         reports = tmp_path / "reports"

@@ -240,6 +240,19 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
         )
         if result.local_probe is not None:
             payload["local_probe"] = result.local_probe
+        probed = [n for n in accel if n.probe is not None]
+        if probed and getattr(args, "probe_results", None):
+            # Fleet roll-up of per-host data-plane verdicts — only under the
+            # DaemonSet aggregation pattern (--probe-results), where reports
+            # plausibly cover the fleet.  A single-host --probe run must not
+            # produce a fleet-looking "hosts_failed: []".
+            payload["probe_summary"] = {
+                "hosts_reported": len(probed),
+                "hosts_ok": sum(1 for n in probed if n.probe.get("ok")),
+                "hosts_failed": sorted(
+                    n.name for n in probed if not n.probe.get("ok")
+                ),
+            }
         if expected_n is not None:
             payload["expected_chips"] = expected_n
             if expected_key is not None:
