@@ -84,3 +84,20 @@ class TestSlackMessage:
         accel, ready, slices = _analyzed(fx.tpu_v5p_64_slice(not_ready=2))
         msg = report.format_slack_message(accel, ready, slices)
         assert "56/64 chips, DEGRADED" in msg
+
+    def test_large_fleet_lists_only_problem_nodes(self):
+        # 64 hosts, 2 NotReady: exhaustive bullets would bury the signal
+        # (and push Slack's limits); only the sick hosts are listed.
+        accel, ready, slices = _analyzed(fx.tpu_v5e_256_slice(not_ready=2))
+        msg = report.format_slack_message(accel, ready, slices)
+        assert "`gke-tpu-v5e256-000`" in msg  # NotReady host listed
+        assert "`gke-tpu-v5e256-001`" in msg
+        assert "`gke-tpu-v5e256-002`" not in msg  # healthy host omitted
+        assert "… 62 healthy nodes omitted" in msg
+
+    def test_small_cluster_keeps_exhaustive_bullets(self):
+        # ≤20 nodes: reference behavior — every node listed, no omission line.
+        accel, ready, slices = _analyzed(fx.gpu_pool(3))
+        msg = report.format_slack_message(accel, ready, slices)
+        assert msg.count("• `gke-gpu-pool-") == 3
+        assert "omitted" not in msg

@@ -81,6 +81,7 @@ class TestProbeResultsAggregation:
             "hosts_reported": 16,
             "hosts_ok": 16,
             "hosts_failed": [],
+            "hosts_missing": [],
         }
 
     def test_probe_summary_names_failed_hosts(self, tmp_path, capsys):
@@ -96,11 +97,48 @@ class TestProbeResultsAggregation:
             "hosts_reported": 16,
             "hosts_ok": 14,
             "hosts_failed": ["gke-tpu-v5p-2", "gke-tpu-v5p-5"],
+            "hosts_missing": [],
         }
 
     def test_no_reports_no_summary(self):
         result = checker.run_check(args_for("--json"), nodes=fx.tpu_v5p_64_slice())
         assert "probe_summary" not in result.payload
+
+    def test_dead_daemonset_reports_zero_not_vanished_key(self, tmp_path):
+        # Every report stale/absent: the summary must say hosts_reported=0 —
+        # a wholly wedged emitter fleet must be visible, not a missing key.
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        result = checker.run_check(
+            args_for("--probe-results", str(reports), "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert result.payload["probe_summary"] == {
+            "hosts_reported": 0,
+            "hosts_ok": 0,
+            "hosts_failed": [],
+            "hosts_missing": [],
+        }
+
+    def test_required_missing_hosts_counted_separately(self, tmp_path):
+        # --probe-results-required synthesizes probe entries for absent
+        # hosts; those never REPORTED and must not inflate hosts_reported.
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        for i in range(2):
+            self._write_report(reports, f"gke-tpu-v5p-{i}", ok=True)
+        result = checker.run_check(
+            args_for(
+                "--probe-results", str(reports), "--probe-results-required", "--json"
+            ),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        summary = result.payload["probe_summary"]
+        assert summary["hosts_reported"] == 2
+        assert summary["hosts_ok"] == 2
+        assert summary["hosts_failed"] == []
+        assert len(summary["hosts_missing"]) == 14
+        assert "gke-tpu-v5p-5" in summary["hosts_missing"]
 
     def test_local_probe_alone_produces_no_fleet_summary(self, monkeypatch):
         # A single-host --probe run covers one host; a fleet-looking

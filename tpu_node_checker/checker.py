@@ -240,17 +240,30 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
         )
         if result.local_probe is not None:
             payload["local_probe"] = result.local_probe
-        probed = [n for n in accel if n.probe is not None]
-        if probed and getattr(args, "probe_results", None):
+        if getattr(args, "probe_results", None):
             # Fleet roll-up of per-host data-plane verdicts — only under the
             # DaemonSet aggregation pattern (--probe-results), where reports
             # plausibly cover the fleet.  A single-host --probe run must not
-            # produce a fleet-looking "hosts_failed: []".
+            # produce a fleet-looking "hosts_failed: []".  Emitted even when
+            # zero reports were usable: a wholly wedged emitter DaemonSet
+            # must surface as hosts_reported=0, not as a vanished key.
+            # Synthesized level="missing" entries (--probe-results-required)
+            # are hosts that did NOT report — counted separately.
+            probed = [
+                n
+                for n in accel
+                if n.probe is not None and n.probe.get("level") != "missing"
+            ]
             payload["probe_summary"] = {
                 "hosts_reported": len(probed),
                 "hosts_ok": sum(1 for n in probed if n.probe.get("ok")),
                 "hosts_failed": sorted(
                     n.name for n in probed if not n.probe.get("ok")
+                ),
+                "hosts_missing": sorted(
+                    n.name
+                    for n in accel
+                    if n.probe is not None and n.probe.get("level") == "missing"
                 ),
             }
         if expected_n is not None:

@@ -172,12 +172,25 @@ def format_slack_message(
     else:
         header = "❌ *Accelerator node check: no accelerator nodes*"
     lines: List[str] = [header, summary_line(accel, ready)]
-    for n in accel:
+    # Small clusters keep the reference's exhaustive per-node bullets
+    # (check-gpu-node.py:128-137).  Large fleets (a v5e-256 slice is 64 node
+    # objects) would bury the signal and hit Slack's message limits, so
+    # above the threshold only problem nodes are listed.
+    listed = list(accel)
+    omitted_healthy = 0
+    if len(accel) > 20:
+        # effectively_ready already folds in probe failures (detect.py).
+        problems = [n for n in accel if not n.effectively_ready]
+        omitted_healthy = len(accel) - len(problems)
+        listed = problems
+    for n in listed:
         keys = ", ".join(f"{k}:{v}" for k, v in sorted(n.breakdown.items()))
         line = f"• `{n.name}`: {_status(n)}, devices: {n.accelerators} ({keys})"
         if n.probe is not None and not n.probe.get("ok"):
             line += " — chip probe FAILED"
         lines.append(line)
+    if omitted_healthy:
+        lines.append(f"• … {omitted_healthy} healthy nodes omitted")
     for s in slices:
         expected = s.expected_chips or s.chips
         state = "complete" if s.complete else "DEGRADED"
