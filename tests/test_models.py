@@ -70,6 +70,35 @@ class TestWorkloadProbe:
         assert not r.ok
         assert r.error
 
+    def test_flash_attention_matches_xla_attention(self):
+        # Same seed, same data: the Pallas-forward/XLA-backward step must
+        # track the pure-XLA step's loss trajectory.
+        import dataclasses
+
+        cfg = dataclasses.replace(TINY, seq=128)
+        r_xla = workload_probe(cfg, steps=2, seed=5)
+        r_flash = workload_probe(
+            dataclasses.replace(cfg, attention="flash"), steps=2, seed=5
+        )
+        assert r_xla.ok and r_flash.ok, (r_xla.error, r_flash.error)
+        np.testing.assert_allclose(r_xla.losses, r_flash.losses, rtol=1e-3)
+
+    def test_flash_attention_rejects_mesh(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(TINY, seq=128, attention="flash")
+        mesh = build_mesh(MeshSpec((("data", 2), ("model", 4))))
+        r = workload_probe(cfg, mesh=mesh, steps=1)
+        assert not r.ok
+        assert "single-device" in r.error
+
+    def test_flash_attention_rejects_unaligned_seq(self):
+        import dataclasses
+
+        r = workload_probe(dataclasses.replace(TINY, attention="flash"), steps=1)
+        assert not r.ok
+        assert "seq % 128" in r.error
+
     def test_remat_matches_no_remat(self):
         # jax.checkpoint trades FLOPs for HBM; the loss trajectory must be
         # bit-compatible up to float noise.

@@ -52,6 +52,13 @@ class BurninConfig:
     # activations at the cost of one extra forward — the standard TPU trade
     # when probing close to the HBM limit.  Numerics are unchanged.
     remat: bool = False
+    # Attention implementation: "xla" (einsum + softmax, GSPMD-shardable) or
+    # "flash" (the Pallas blockwise kernel from ops.flash_attention — runs
+    # the Mosaic path inside a real training step).  "flash" requires seq to
+    # be a multiple of the kernel's 128-row block and is single-device only
+    # (the kernel is written per-chip; the sharded step keeps "xla" so GSPMD
+    # owns the layout).
+    attention: str = "xla"
 
     @property
     def head_dim(self) -> int:
@@ -131,10 +138,25 @@ def _attention(x: jax.Array, lp: dict, cfg: BurninConfig, mask: jax.Array) -> ja
     q = proj(lp["wq"]).reshape(B, S, H, Hd).astype(dt)
     k = proj(lp["wk"]).reshape(B, S, H, Hd).astype(dt)
     v = proj(lp["wv"]).reshape(B, S, H, Hd).astype(dt)
-    scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
-    scores = scores / np.sqrt(Hd) + mask
-    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-    ctx = jnp.einsum("bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32)
+    if cfg.attention == "flash":
+        from tpu_node_checker.ops._harness import resolve_backend
+        from tpu_node_checker.ops.flash_attention import flash_attention
+
+        # Kernel layout is (B, H, S, D); causality is built in, so the mask
+        # is unused on this path.  interpret resolves at trace time, by the
+        # same rule as the standalone Mosaic probes.
+        _, interpret = resolve_backend()
+        ctx = flash_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            interpret=interpret,
+        ).transpose(0, 2, 1, 3)
+    else:
+        scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
+        scores = scores / np.sqrt(Hd) + mask
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        ctx = jnp.einsum("bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32)
     ctx = ctx.reshape(B, S, D).astype(dt)
     return jnp.dot(ctx, lp["wo"].astype(dt), preferred_element_type=jnp.float32).astype(dt)
 
@@ -190,6 +212,18 @@ def make_train_step(
     "model").  Without a mesh everything stays single-device (probe level for
     one chip).
     """
+    if cfg.attention == "flash":
+        if mesh is not None:
+            raise ValueError(
+                'attention="flash" is single-device only; the sharded step '
+                'keeps "xla" attention so GSPMD owns the layout'
+            )
+        from tpu_node_checker.ops.flash_attention import BLOCK
+
+        if cfg.seq % BLOCK:
+            raise ValueError(
+                f'attention="flash" needs seq % {BLOCK} == 0, got seq={cfg.seq}'
+            )
     tx = optax.adam(learning_rate)
 
     def init_fn(key: jax.Array):

@@ -50,13 +50,10 @@ class FlashAttentionProbeResult:
     error: Optional[str] = None
 
 
-def flash_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, interpret: bool = False
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, interpret: bool
 ) -> jax.Array:
-    """Causal flash attention over (B, H, S, D); S must divide into 128-blocks.
-
-    Returns the same shape/dtype as ``q``; accumulation is f32 throughout.
-    """
+    """The Pallas forward pass (no AD rule of its own)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -124,6 +121,41 @@ def flash_attention(
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(q, k, v)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_with_vjp(q, k, v, interpret):
+    return _flash_forward(q, k, v, interpret)
+
+
+def _flash_fwd(q, k, v, interpret):
+    return _flash_forward(q, k, v, interpret), (q, k, v)
+
+
+def _flash_bwd(interpret, residuals, g):
+    # Backward via differentiating the XLA reference on recomputed
+    # activations (flash-style: nothing but q/k/v saved).  ``pallas_call``
+    # has no AD rule; forward=Mosaic / backward=XLA-of-the-same-function is
+    # mathematically consistent and lets the kernel sit inside a real
+    # ``value_and_grad`` training step (models.burnin attention="flash").
+    q, k, v = residuals
+    _, vjp = jax.vjp(_xla_causal_attention, q, k, v)
+    return vjp(g)
+
+
+_flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Causal flash attention over (B, H, S, D); S must divide into 128-blocks.
+
+    Returns the same shape/dtype as ``q``; accumulation is f32 throughout.
+    Differentiable: the forward runs the Pallas kernel, the backward
+    differentiates the XLA reference over recomputed activations.
+    """
+    return _flash_with_vjp(q, k, v, interpret)
 
 
 def _xla_causal_attention(q, k, v):

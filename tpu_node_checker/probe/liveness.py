@@ -148,6 +148,14 @@ try:
             data = n_dev // model
             if cfg.batch % data == 0:
                 mesh = build_mesh(MeshSpec((("data", data), ("model", model))))
+        from tpu_node_checker.ops.flash_attention import BLOCK as _FA_BLOCK
+        if mesh is None and cfg.seq % _FA_BLOCK == 0:
+            # Single-chip: run the Pallas flash-attention kernel inside the
+            # training step, so the workload grade covers the Mosaic path
+            # under real forward+backward load (sharded runs keep "xla"
+            # attention — GSPMD owns that layout).
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, attention="flash")
         wl = workload_probe(cfg, mesh=mesh)
         out["workload_ok"] = wl.ok
         out["workload_devices"] = n_dev if mesh is not None else 1
