@@ -1,7 +1,7 @@
 """Single-chip compute probe tests (runs on the CPU backend; same jitted code
 paths as TPU — shapes kept small so the suite stays fast)."""
 
-from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn
+from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn, soak_burn
 
 
 class TestMatmulBurn:
@@ -15,6 +15,36 @@ class TestMatmulBurn:
         r = matmul_burn(n=128, iters=1)
         assert r.n == 128 and r.iters == 1
         assert r.elapsed_ms > 0
+
+
+class TestSoakBurn:
+    def test_soak_runs_to_budget(self):
+        # min_sustained_ratio=0: sub-ms CPU rounds make min/median pure OS
+        # jitter; the throughput criterion is for seconds-scale TPU rounds.
+        r = soak_burn(0.5, n=128, iters=2, min_sustained_ratio=0.0)
+        assert r.ok, r.error
+        assert r.rounds >= 1
+        assert r.seconds >= 0.5
+        assert 0 < r.tflops_min <= r.tflops_median <= r.tflops_max
+        assert r.sustained_ratio > 0
+
+    def test_throughput_collapse_fails(self):
+        r = soak_burn(0.2, n=128, iters=1, min_sustained_ratio=1.01)
+        # min is by definition ≤ median, so a >1 floor must always trip.
+        assert not r.ok
+        assert "sustained load" in r.error
+
+    def test_zero_budget_still_runs_one_round(self):
+        r = soak_burn(0.0, n=128, iters=1)
+        assert r.rounds == 1
+
+    def test_to_dict_serializes(self):
+        import json
+
+        r = soak_burn(0.1, n=128, iters=1)
+        doc = json.loads(json.dumps(r.to_dict()))
+        assert doc["rounds"] == r.rounds
+        assert "tflops_median" in doc
 
 
 class TestPallasProbe:

@@ -80,6 +80,15 @@ class TestComputeLevels:
         assert r.details.get("collective_ok") is True
         assert r.details.get("ring_ok") is True
 
+    def test_compute_level_with_soak(self):
+        r = run_local_probe(level="compute", timeout_s=300, soak_s=1.0)
+        assert r.ok, r.error
+        soak = r.details.get("soak")
+        assert soak is not None
+        assert soak["ok"] is True
+        assert soak["rounds"] >= 1
+        assert soak["sustained_ratio"] > 0
+
     def test_collective_level_with_topology_localizes_axes(self):
         r = run_local_probe(level="collective", timeout_s=300, topology="2x4")
         assert r.ok, r.error

@@ -274,6 +274,7 @@ class TestWatch:
             (["--metrics-port", "9090"], "requires --watch"),
             (["--slack-on-change"], "requires --watch"),
             (["--probe-results-required"], "requires --probe-results"),
+            (["--probe", "--probe-soak", "60"], "requires --probe-level compute"),
         ]:
             with pytest.raises(SystemExit):
                 cli.parse_args(argv)

@@ -119,6 +119,7 @@ def _run_probe(
         # localization applies.  Single-host probes only see local chips.
         topology=getattr(args, "probe_topology", None)
         or (local.tpu_topology if local and distributed else None),
+        soak_s=getattr(args, "probe_soak", 0.0) or 0.0,
     )
     if local is not None:
         local.probe = probed.to_dict()
@@ -308,6 +309,7 @@ def emit_probe(args) -> int:
         timeout_s=getattr(args, "probe_timeout", None),
         distributed=getattr(args, "probe_distributed", False),
         topology=getattr(args, "probe_topology", None),
+        soak_s=getattr(args, "probe_soak", 0.0) or 0.0,
     )
     doc = probed.to_dict()
     doc["written_at"] = time.time()  # staleness anchor for the aggregator

@@ -114,6 +114,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        help="join the jax.distributed rendezvous before enumerating, so "
                        "the probe sees GLOBAL chips of a multi-host slice and its "
                        "collectives cross hosts")
+    probe.add_argument("--probe-soak", type=float, default=0.0, metavar="SECONDS",
+                       help="node-acceptance soak: at compute level and above, loop the "
+                       "MXU burn under sustained load for this long; fails on numerics "
+                       "errors or throughput collapse (probe timeout extends to fit)")
     probe.add_argument("--probe-topology", metavar="DIMS",
                        help="torus topology of the probed fabric (e.g. 4x4x4); at "
                        "collective level and above, runs one psum per dimension so a "
@@ -144,6 +148,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
         p.error("--probe-results-required requires --probe-results DIR")
+    if args.probe_soak and args.probe_level == "enumerate":
+        # Silently not soaking would grade a node healthy without ever
+        # applying the sustained load the flag exists to apply.
+        p.error("--probe-soak requires --probe-level compute (or higher)")
     return args
 
 

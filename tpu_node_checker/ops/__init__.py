@@ -16,7 +16,7 @@ and run anywhere (TPU, CPU test mesh) — device-kind thresholds live in the
 caller, not here.
 """
 
-from tpu_node_checker.ops.burn import BurnResult, matmul_burn
+from tpu_node_checker.ops.burn import BurnResult, SoakResult, matmul_burn, soak_burn
 from tpu_node_checker.ops.dma_probe import DmaProbeResult, dma_stream_probe
 from tpu_node_checker.ops.flash_attention import (
     FlashAttentionProbeResult,
@@ -28,7 +28,9 @@ from tpu_node_checker.ops.pallas_probe import PallasProbeResult, pallas_matmul_p
 
 __all__ = [
     "BurnResult",
+    "SoakResult",
     "matmul_burn",
+    "soak_burn",
     "DmaProbeResult",
     "dma_stream_probe",
     "FlashAttentionProbeResult",

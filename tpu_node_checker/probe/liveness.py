@@ -110,6 +110,14 @@ try:
         out["dma_ok"] = dma.ok
         out["dma_gbps"] = round(dma.gbps, 2)
         out["ok"] = out["ok"] and burn.ok and hbm.ok and pallas.ok and fa.ok and dma.ok
+        soak_s = float(os.environ.get("TNC_SOAK_S") or 0)
+        if soak_s > 0 and out["ok"]:
+            # Node-acceptance soak: sustained MXU load for the requested
+            # wall-clock, catching thermal/power faults one-shot misses.
+            from tpu_node_checker.ops import soak_burn
+            soak = soak_burn(soak_s)
+            out["soak"] = soak.to_dict()
+            out["ok"] = out["ok"] and soak.ok
     if level in ("collective", "workload") and out["ok"]:
         from tpu_node_checker.parallel import collective_probe, ring_probe
         coll = collective_probe()
@@ -219,6 +227,7 @@ def run_local_probe(
     python: Optional[str] = None,
     distributed: bool = False,
     topology: Optional[str] = None,
+    soak_s: float = 0.0,
 ) -> ProbeResult:
     """Probe this host's chips in a child process; never raises.
 
@@ -233,6 +242,10 @@ def run_local_probe(
         raise ValueError(f"unknown probe level {level!r}; expected one of {LEVELS}")
     if timeout_s is None:
         timeout_s = LEVEL_TIMEOUTS_S[level]
+    if soak_s > 0:
+        # The soak loop spends its budget inside the child by design; the
+        # kill-timer must leave room for it on top of the level's own work.
+        timeout_s += soak_s
     hostname = os.environ.get("NODE_NAME") or os.uname().nodename
     t0 = time.perf_counter()
     child_env = {**os.environ, "PYTHONPATH": _pythonpath()}
@@ -240,6 +253,8 @@ def run_local_probe(
         child_env["TNC_PROBE_DISTRIBUTED"] = "1"
     if topology:
         child_env["TNC_TOPOLOGY"] = topology
+    if soak_s > 0:
+        child_env["TNC_SOAK_S"] = str(soak_s)
     try:
         proc = subprocess.run(
             [python or sys.executable, "-c", _CHILD_SCRIPT, level],
