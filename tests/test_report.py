@@ -95,6 +95,14 @@ class TestSlackMessage:
         assert "`gke-tpu-v5e256-002`" not in msg  # healthy host omitted
         assert "… 62 healthy nodes omitted" in msg
 
+    def test_mass_outage_caps_problem_list(self):
+        # All 64 hosts down: the message lists 30 and summarizes the rest.
+        accel, ready, slices = _analyzed(fx.tpu_v5e_256_slice(not_ready=64))
+        msg = report.format_slack_message(accel, ready, slices)
+        assert msg.count("• `gke-tpu-v5e256-") == 30
+        assert "… 34 more problem nodes omitted" in msg
+        assert "healthy nodes omitted" not in msg
+
     def test_small_cluster_keeps_exhaustive_bullets(self):
         # ≤20 nodes: reference behavior — every node listed, no omission line.
         accel, ready, slices = _analyzed(fx.gpu_pool(3))

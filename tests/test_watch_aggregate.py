@@ -275,6 +275,8 @@ class TestWatch:
             (["--slack-on-change"], "requires --watch"),
             (["--probe-results-required"], "requires --probe-results"),
             (["--probe", "--probe-soak", "60"], "requires --probe-level compute"),
+            (["--probe-soak", "60", "--probe-level", "compute"],
+             "requires --probe or --emit-probe"),
         ]:
             with pytest.raises(SystemExit):
                 cli.parse_args(argv)

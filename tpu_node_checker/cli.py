@@ -148,10 +148,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
         p.error("--probe-results-required requires --probe-results DIR")
-    if args.probe_soak and args.probe_level == "enumerate":
+    if args.probe_soak:
         # Silently not soaking would grade a node healthy without ever
         # applying the sustained load the flag exists to apply.
-        p.error("--probe-soak requires --probe-level compute (or higher)")
+        if not (args.probe or args.emit_probe):
+            p.error("--probe-soak requires --probe or --emit-probe")
+        if args.probe_level == "enumerate":
+            p.error("--probe-soak requires --probe-level compute (or higher)")
     return args
 
 

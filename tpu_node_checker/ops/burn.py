@@ -162,11 +162,14 @@ def soak_burn(
             r = matmul_burn(n=n, iters=iters, device=device)
             rounds += 1
             if not r.ok:
+                import statistics
+
                 return SoakResult(
                     ok=False, rounds=rounds,
                     seconds=time.perf_counter() - t_start,
-                    tflops_min=min(tflops, default=r.tflops),
-                    tflops_median=0.0, tflops_max=max(tflops, default=r.tflops),
+                    tflops_min=min(tflops, default=0.0),
+                    tflops_median=statistics.median(tflops) if tflops else 0.0,
+                    tflops_max=max(tflops, default=0.0),
                     sustained_ratio=0.0,
                     error=f"round {rounds} failed: {r.error}",
                 )

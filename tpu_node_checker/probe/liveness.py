@@ -115,7 +115,14 @@ try:
             # Node-acceptance soak: sustained MXU load for the requested
             # wall-clock, catching thermal/power faults one-shot misses.
             from tpu_node_checker.ops import soak_burn
-            soak = soak_burn(soak_s)
+            soak = soak_burn(
+                soak_s,
+                # Relaxable for CPU-mesh tests, where sub-second round times
+                # make min/median pure scheduler jitter.
+                min_sustained_ratio=float(
+                    os.environ.get("TNC_SOAK_MIN_RATIO") or 0.5
+                ),
+            )
             out["soak"] = soak.to_dict()
             out["ok"] = out["ok"] and soak.ok
     if level in ("collective", "workload") and out["ok"]:

@@ -177,18 +177,22 @@ def format_slack_message(
     # objects) would bury the signal and hit Slack's message limits, so
     # above the threshold only problem nodes are listed.
     listed = list(accel)
-    omitted_healthy = 0
+    omitted_healthy = omitted_problems = 0
     if len(accel) > 20:
         # effectively_ready already folds in probe failures (detect.py).
         problems = [n for n in accel if not n.effectively_ready]
         omitted_healthy = len(accel) - len(problems)
-        listed = problems
+        # A mass outage must not overflow Slack's message limits either.
+        listed = problems[:30]
+        omitted_problems = len(problems) - len(listed)
     for n in listed:
         keys = ", ".join(f"{k}:{v}" for k, v in sorted(n.breakdown.items()))
         line = f"• `{n.name}`: {_status(n)}, devices: {n.accelerators} ({keys})"
         if n.probe is not None and not n.probe.get("ok"):
             line += " — chip probe FAILED"
         lines.append(line)
+    if omitted_problems:
+        lines.append(f"• … {omitted_problems} more problem nodes omitted")
     if omitted_healthy:
         lines.append(f"• … {omitted_healthy} healthy nodes omitted")
     for s in slices:
