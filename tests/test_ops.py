@@ -21,12 +21,13 @@ class TestSoakBurn:
     def test_soak_runs_to_budget(self):
         # min_sustained_ratio=0: sub-ms CPU rounds make min/median pure OS
         # jitter; the throughput criterion is for seconds-scale TPU rounds.
-        r = soak_burn(0.5, n=128, iters=2, min_sustained_ratio=0.0)
+        r = soak_burn(0.5, n=128, iters=2, min_sustained_ratio=0.0, hbm_mib=8)
         assert r.ok, r.error
         assert r.rounds >= 1
         assert r.seconds >= 0.5
         assert 0 < r.tflops_min <= r.tflops_median <= r.tflops_max
         assert r.sustained_ratio > 0
+        assert 0 < r.hbm_gbps_min <= r.hbm_gbps_median  # memory leg ran too
 
     def test_throughput_collapse_fails(self):
         r = soak_burn(0.2, n=128, iters=1, min_sustained_ratio=1.01)

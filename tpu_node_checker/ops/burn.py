@@ -121,6 +121,8 @@ class SoakResult:
     tflops_median: float
     tflops_max: float
     sustained_ratio: float  # min/median — collapse under heat shows here
+    hbm_gbps_min: float = 0.0
+    hbm_gbps_median: float = 0.0
     error: Optional[str] = None
 
     def to_dict(self) -> dict:
@@ -132,6 +134,8 @@ class SoakResult:
             "tflops_median": round(self.tflops_median, 3),
             "tflops_max": round(self.tflops_max, 3),
             "sustained_ratio": round(self.sustained_ratio, 3),
+            "hbm_gbps_min": round(self.hbm_gbps_min, 3),
+            "hbm_gbps_median": round(self.hbm_gbps_median, 3),
             **({"error": self.error} if self.error else {}),
         }
 
@@ -142,57 +146,73 @@ def soak_burn(
     iters: int = 16,
     device: Optional[jax.Device] = None,
     min_sustained_ratio: float = 0.5,
+    hbm_mib: int = 128,
 ) -> SoakResult:
-    """Node-acceptance soak: run the MXU burn repeatedly for ``seconds``.
+    """Node-acceptance soak: alternate MXU burn and HBM stream for ``seconds``.
 
     One-shot probes miss thermal and power faults that only appear under
-    sustained load (the gpu-burn use case).  Each round re-checks numerics;
-    the throughput trajectory is summarized as min/median/max TFLOP/s.
-    Verdict: every round numerically clean AND the slowest round kept at
-    least ``min_sustained_ratio`` of median throughput — a chip that
-    throttles to half speed under sustained load is not production-ready,
-    while normal transport jitter stays well above the default 0.5.
+    sustained load (the gpu-burn / memtest use case).  Every round runs the
+    matmul burn (numerics re-checked) followed by a ``hbm_mib``-MiB streaming
+    pass, so both the compute engines and the memory channels stay loaded for
+    the whole budget; trajectories are summarized as min/median(/max).
+    Verdict: every round clean AND the slowest burn round kept at least
+    ``min_sustained_ratio`` of median throughput — a chip that throttles to
+    half speed under sustained load is not production-ready, while normal
+    transport jitter stays well above the default 0.5.  ``hbm_mib=0``
+    disables the memory leg.
     """
     try:
+        import statistics
+
         t_start = time.perf_counter()
         deadline = t_start + seconds
         tflops: list[float] = []
+        hbm_gbps: list[float] = []
         rounds = 0
+
+        def _stats(ok, ratio, error):
+            # Both failure and success carry everything collected so far —
+            # the trend up to a failure is exactly the triage data.
+            return SoakResult(
+                ok=ok,
+                rounds=rounds,
+                seconds=time.perf_counter() - t_start,
+                tflops_min=min(tflops, default=0.0),
+                tflops_median=statistics.median(tflops) if tflops else 0.0,
+                tflops_max=max(tflops, default=0.0),
+                sustained_ratio=ratio,
+                hbm_gbps_min=min(hbm_gbps, default=0.0),
+                hbm_gbps_median=statistics.median(hbm_gbps) if hbm_gbps else 0.0,
+                error=error,
+            )
+
         while time.perf_counter() < deadline or rounds == 0:
             r = matmul_burn(n=n, iters=iters, device=device)
             rounds += 1
             if not r.ok:
-                import statistics
-
-                return SoakResult(
-                    ok=False, rounds=rounds,
-                    seconds=time.perf_counter() - t_start,
-                    tflops_min=min(tflops, default=0.0),
-                    tflops_median=statistics.median(tflops) if tflops else 0.0,
-                    tflops_max=max(tflops, default=0.0),
-                    sustained_ratio=0.0,
-                    error=f"round {rounds} failed: {r.error}",
-                )
+                return _stats(False, 0.0, f"round {rounds} burn failed: {r.error}")
             tflops.append(r.tflops)
-        import statistics
+            if hbm_mib > 0:
+                from tpu_node_checker.ops.hbm import hbm_bandwidth_probe
+
+                h = hbm_bandwidth_probe(mib=hbm_mib, iters=2, device=device)
+                if not h.ok:
+                    return _stats(
+                        False, 0.0, f"round {rounds} hbm stream failed: {h.error}"
+                    )
+                hbm_gbps.append(h.gbps)
 
         median = statistics.median(tflops)
-        lo, hi = min(tflops), max(tflops)
-        ratio = lo / median if median > 0 else 0.0
+        ratio = min(tflops) / median if median > 0 else 0.0
         ok = ratio >= min_sustained_ratio
-        return SoakResult(
-            ok=ok,
-            rounds=rounds,
-            seconds=time.perf_counter() - t_start,
-            tflops_min=lo,
-            tflops_median=median,
-            tflops_max=hi,
-            sustained_ratio=ratio,
-            error=None
+        return _stats(
+            ok,
+            ratio,
+            None
             if ok
             else (
                 f"throughput collapsed under sustained load: min "
-                f"{lo:.2f} TFLOP/s is {ratio:.0%} of median {median:.2f}"
+                f"{min(tflops):.2f} TFLOP/s is {ratio:.0%} of median {median:.2f}"
             ),
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise

@@ -122,6 +122,8 @@ try:
                 min_sustained_ratio=float(
                     os.environ.get("TNC_SOAK_MIN_RATIO") or 0.5
                 ),
+                # Memory-leg size; 0 disables (memory-constrained hosts).
+                hbm_mib=int(os.environ.get("TNC_SOAK_HBM_MIB") or 128),
             )
             out["soak"] = soak.to_dict()
             out["ok"] = out["ok"] and soak.ok
