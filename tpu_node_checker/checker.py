@@ -120,6 +120,10 @@ def _run_probe(
         topology=getattr(args, "probe_topology", None)
         or (local.tpu_topology if local and distributed else None),
         soak_s=getattr(args, "probe_soak", 0.0) or 0.0,
+        coordinator=getattr(args, "probe_coordinator", None),
+        num_processes=getattr(args, "probe_num_processes", None),
+        process_id=getattr(args, "probe_process_id", None),
+        dist_init_timeout_s=getattr(args, "probe_rendezvous_timeout", None),
     )
     if local is not None:
         local.probe = probed.to_dict()
@@ -310,6 +314,10 @@ def emit_probe(args) -> int:
         distributed=getattr(args, "probe_distributed", False),
         topology=getattr(args, "probe_topology", None),
         soak_s=getattr(args, "probe_soak", 0.0) or 0.0,
+        coordinator=getattr(args, "probe_coordinator", None),
+        num_processes=getattr(args, "probe_num_processes", None),
+        process_id=getattr(args, "probe_process_id", None),
+        dist_init_timeout_s=getattr(args, "probe_rendezvous_timeout", None),
     )
     doc = probed.to_dict()
     doc["written_at"] = time.time()  # staleness anchor for the aggregator

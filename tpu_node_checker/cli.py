@@ -112,8 +112,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        "each host) from DIR to the matching nodes")
     probe.add_argument("--probe-distributed", action="store_true",
                        help="join the jax.distributed rendezvous before enumerating, so "
-                       "the probe sees GLOBAL chips of a multi-host slice and its "
-                       "collectives cross hosts")
+                       "the probe sees GLOBAL chips of a multi-host slice, verifies a "
+                       "cross-process psum, and its collectives cross hosts")
+    probe.add_argument("--probe-coordinator", metavar="HOST:PORT",
+                       help="with --probe-distributed: explicit rendezvous coordinator "
+                       "(default: autodetected from the TPU pod environment)")
+    probe.add_argument("--probe-num-processes", type=int, metavar="N",
+                       help="with --probe-distributed: total process count in the "
+                       "rendezvous (default: autodetected)")
+    probe.add_argument("--probe-process-id", type=int, metavar="I",
+                       help="with --probe-distributed: this process's rank "
+                       "(default: autodetected)")
+    probe.add_argument("--probe-rendezvous-timeout", type=float, default=None,
+                       metavar="SECONDS",
+                       help="with --probe-distributed: bound the rendezvous itself so an "
+                       "unreachable coordinator reports a structured error instead of "
+                       "waiting out jax's 300s default")
     probe.add_argument("--probe-soak", type=float, default=0.0, metavar="SECONDS",
                        help="node-acceptance soak: at compute level and above, loop the "
                        "MXU burn under sustained load for this long; fails on numerics "
@@ -148,6 +162,19 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
         p.error("--probe-results-required requires --probe-results DIR")
+    if args.probe_distributed and not (args.probe or args.emit_probe):
+        # Same rule as --probe-soak: a probe modifier that silently does
+        # nothing would let an operator believe a distributed probe ran.
+        p.error("--probe-distributed requires --probe or --emit-probe")
+    if not args.probe_distributed:
+        for flag, val in (
+            ("--probe-coordinator", args.probe_coordinator),
+            ("--probe-num-processes", args.probe_num_processes),
+            ("--probe-process-id", args.probe_process_id),
+            ("--probe-rendezvous-timeout", args.probe_rendezvous_timeout),
+        ):
+            if val is not None:
+                p.error(f"{flag} requires --probe-distributed")
     if args.probe_soak:
         # Silently not soaking would grade a node healthy without ever
         # applying the sustained load the flag exists to apply.

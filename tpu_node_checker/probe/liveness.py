@@ -44,6 +44,9 @@ LEVEL_TIMEOUTS_S = {
     "workload": 600.0,
 }
 DEFAULT_TIMEOUT_S = LEVEL_TIMEOUTS_S["enumerate"]
+# Extra kill-timer headroom for --probe-distributed: rendezvous handshake plus
+# the cross-process psum's first XLA compile.
+DISTRIBUTED_EXTRA_TIMEOUT_S = 90.0
 
 # The child script is spelled as a standalone -c program (not a fork) so the
 # parent process never imports jax and a wedged libtpu cannot leak into it.
@@ -56,11 +59,30 @@ try:
     import os
     import jax
     if os.environ.get("TNC_PROBE_DISTRIBUTED") == "1":
-        # Multi-host slice: join the jax.distributed rendezvous (TPU pods
-        # autodetect coordinator/process ids from the environment) so
+        # Multi-host slice: join the jax.distributed rendezvous so
         # jax.devices() enumerates GLOBAL chips and collectives cross hosts
         # over ICI/DCN.  Failure to rendezvous is itself a health failure.
-        jax.distributed.initialize()
+        # TPU pods autodetect coordinator/process ids from the environment;
+        # explicit TNC_COORDINATOR/TNC_NUM_PROCESSES/TNC_PROCESS_ID override
+        # (non-GKE deployments, and the multi-process CPU tests).
+        kw = {}
+        if os.environ.get("TNC_COORDINATOR"):
+            kw["coordinator_address"] = os.environ["TNC_COORDINATOR"]
+        if os.environ.get("TNC_NUM_PROCESSES"):
+            kw["num_processes"] = int(os.environ["TNC_NUM_PROCESSES"])
+        if os.environ.get("TNC_PROCESS_ID"):
+            kw["process_id"] = int(os.environ["TNC_PROCESS_ID"])
+        if os.environ.get("TNC_DIST_INIT_TIMEOUT_S"):
+            # Bounded rendezvous: an unreachable coordinator must surface as
+            # a structured child-side error, not only as the parent's
+            # kill-timer firing (jax's own default is 300 s).  jax takes an
+            # int; round sub-second requests UP so they stay a real bound
+            # instead of truncating to 0.
+            import math
+            kw["initialization_timeout"] = max(
+                1, math.ceil(float(os.environ["TNC_DIST_INIT_TIMEOUT_S"]))
+            )
+        jax.distributed.initialize(**kw)
         out["distributed"] = True
     devices = jax.devices()
     out["local_device_count"] = len(jax.local_devices())
@@ -87,6 +109,24 @@ try:
         # bytes_limit still confirms each chip exposes the HBM its device
         # kind should have.
         out["memory"] = mem
+    slice_ids = sorted({getattr(d, "slice_index", None) for d in devices} - {None})
+    if slice_ids:
+        # Multislice (DCN-joined) job: PJRT tags each device with its slice.
+        out["num_slices"] = len(slice_ids)
+        out["slice_indices"] = slice_ids
+    if out.get("distributed") and out["ok"] and jax.process_count() > 1:
+        # Prove the rendezvous carries *data*, not just control-plane gRPC:
+        # one psum over the global device axis, weighted by process index so
+        # the right answer cannot be produced from local devices alone.  On a
+        # TPU pod this is the first cross-host ICI/DCN traffic of the probe.
+        import jax.numpy as jnp
+        n_local = len(jax.local_devices())
+        x = jnp.full((n_local,), float(jax.process_index() + 1), dtype=jnp.float32)
+        total = float(jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)[0])
+        expected = float(sum(d.process_index + 1 for d in devices))
+        out["distributed_psum"] = total
+        out["distributed_psum_ok"] = abs(total - expected) < 1e-6
+        out["ok"] = out["ok"] and out["distributed_psum_ok"]
     if level in ("compute", "collective", "workload") and out["ok"]:
         from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn, pallas_matmul_probe
         burn = matmul_burn()
@@ -191,6 +231,9 @@ try:
             out["moe_ok"] = ep.ok
             out["ok"] = out["ok"] and pp.ok and ep.ok
 except Exception as exc:  # noqa: BLE001 - the whole point is to catch anything
+    # ok may already be True from a completed earlier stage (enumeration
+    # succeeds, then a collective raises); a crash anywhere is a failed probe.
+    out["ok"] = False
     out["error"] = f"{type(exc).__name__}: {exc}"
 out["elapsed_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
 # default= guards against numpy scalars (np.bool_/np.float32) sneaking into
@@ -237,6 +280,10 @@ def run_local_probe(
     distributed: bool = False,
     topology: Optional[str] = None,
     soak_s: float = 0.0,
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    dist_init_timeout_s: Optional[float] = None,
 ) -> ProbeResult:
     """Probe this host's chips in a child process; never raises.
 
@@ -246,6 +293,15 @@ def run_local_probe(
     the per-level budget from :data:`LEVEL_TIMEOUTS_S`.  ``topology`` (a GKE
     label like ``"4x4x4"``) enables per-ICI-dimension fault localization at
     the collective level and above.
+
+    With ``distributed=True`` the child joins the ``jax.distributed``
+    rendezvous before enumerating, so the probe sees GLOBAL chips and runs a
+    cross-process psum.  On GKE TPU pods the coordinator/process identity is
+    autodetected from the pod environment; ``coordinator`` (``host:port``),
+    ``num_processes`` and ``process_id`` override for non-GKE deployments.
+    ``dist_init_timeout_s`` bounds the rendezvous itself so an unreachable
+    coordinator yields a structured child-side error before the parent's
+    kill-timer has to fire.
     """
     if level not in LEVELS:
         raise ValueError(f"unknown probe level {level!r}; expected one of {LEVELS}")
@@ -255,11 +311,27 @@ def run_local_probe(
         # The soak loop spends its budget inside the child by design; the
         # kill-timer must leave room for it on top of the level's own work.
         timeout_s += soak_s
+    if distributed:
+        # The rendezvous + the cross-process psum (one extra XLA compile,
+        # ~20-40s first time on TPU) happen on top of the level's own work;
+        # without headroom the parent kill-timer preempts the structured
+        # child-side error the rendezvous timeout exists to produce.
+        timeout_s += DISTRIBUTED_EXTRA_TIMEOUT_S
+        if dist_init_timeout_s is not None:
+            timeout_s += dist_init_timeout_s
     hostname = os.environ.get("NODE_NAME") or os.uname().nodename
     t0 = time.perf_counter()
     child_env = {**os.environ, "PYTHONPATH": _pythonpath()}
     if distributed:
         child_env["TNC_PROBE_DISTRIBUTED"] = "1"
+        if coordinator:
+            child_env["TNC_COORDINATOR"] = coordinator
+        if num_processes is not None:
+            child_env["TNC_NUM_PROCESSES"] = str(num_processes)
+        if process_id is not None:
+            child_env["TNC_PROCESS_ID"] = str(process_id)
+        if dist_init_timeout_s is not None:
+            child_env["TNC_DIST_INIT_TIMEOUT_S"] = str(dist_init_timeout_s)
     if topology:
         child_env["TNC_TOPOLOGY"] = topology
     if soak_s > 0:
