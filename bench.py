@@ -11,18 +11,30 @@ builds the JSON payload, and decides the exit code.  p50 over repeated runs
 is reported; correctness (256/256 chips detected, exit 0) is asserted before
 any number is printed.
 
+Two latencies are measured (VERDICT r01 item #2 — the honest number):
+
+* ``internal_p50_ms`` — ``run_check``'s own phase clock (config + LIST +
+  detect + render), the number a long-lived watch round pays;
+* ``cold_e2e_p50_ms`` — wall-clock of a cold ``python -m tpu_node_checker``
+  subprocess, interpreter start + imports + argparse included: what a CI job
+  or cron actually waits for.  This is the headline value, asserted < 2 s.
+
 Prints ONE JSON line:
-  {"metric": "check_latency_p50_ms", "value": <p50 ms>, "unit": "ms",
-   "vs_baseline": <2000 / p50>}   # >1.0 ⇔ faster than the 2 s target
+  {"metric": "check_latency_p50_ms", "value": <cold e2e p50 ms>, "unit": "ms",
+   "vs_baseline": <2000 / p50>,      # >1.0 ⇔ faster than the 2 s target
+   "internal_p50_ms": ..., "cold_e2e_p50_ms": ...}
 """
 
 from __future__ import annotations
 
 import json
+import os
 import statistics
+import subprocess
 import sys
 import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 
@@ -86,17 +98,47 @@ users: [{{name: bench, user: {{token: bench-token}}}}]
     for _ in range(41):
         result = checker.run_check(args)
         latencies.append(result.payload["timings_ms"]["total"])
-    p50 = statistics.median(latencies)
+    internal_p50 = statistics.median(latencies)
+
+    # Cold end-to-end: a fresh interpreter per run, measured from the outside.
+    # The dev image's sitecustomize imports jax at interpreter start when
+    # PALLAS_AXON_POOL_IPS is set — no operator machine does that, so the
+    # child runs without it (the checker itself never imports jax; only the
+    # probe subprocess does).
+    child_env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    cmd = [
+        sys.executable,
+        "-m",
+        "tpu_node_checker",
+        "--kubeconfig",
+        kubeconfig.name,
+        "--json",
+    ]
+    cold = []
+    for i in range(9):
+        t0 = time.perf_counter()
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=child_env)
+        cold.append((time.perf_counter() - t0) * 1e3)
+        # Gate EVERY run (outside the clock): a fast-failing subprocess must
+        # not contribute a flattering latency sample.
+        assert proc.returncode == 0, (i, proc.returncode, proc.stderr[-500:])
+        if i == 0:
+            payload = json.loads(proc.stdout)
+            assert payload["ready_chips"] == 256, payload["ready_chips"]
+    cold_p50 = statistics.median(cold)
 
     server.shutdown()
     baseline_ms = 2000.0  # the <2 s north-star budget
+    assert cold_p50 < baseline_ms, f"cold e2e p50 {cold_p50:.0f}ms breaches the 2s budget"
     print(
         json.dumps(
             {
                 "metric": "check_latency_p50_ms",
-                "value": round(p50, 2),
+                "value": round(cold_p50, 2),
                 "unit": "ms",
-                "vs_baseline": round(baseline_ms / p50, 1),
+                "vs_baseline": round(baseline_ms / cold_p50, 1),
+                "internal_p50_ms": round(internal_p50, 2),
+                "cold_e2e_p50_ms": round(cold_p50, 2),
             }
         )
     )
