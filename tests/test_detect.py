@@ -110,6 +110,70 @@ class TestSelect:
         assert accel[0].schedulable is False
         assert ready == []
 
+    def test_fully_dead_gpu_plugin_rescued_by_gke_label(self):
+        # GPU mirror of the TPU label rescue (VERDICT r01 item #4): device
+        # plugin completely dead — no allocatable NOR capacity entry — but
+        # the GKE GPU pool label identifies the hardware.  The node must stay
+        # visible and unschedulable (exit 3 shape), not vanish (exit 2).
+        node = fx.make_node(
+            "sick-gpu",
+            ready=True,
+            allocatable={"cpu": "8"},
+            capacity={"cpu": "8"},
+            labels={"cloud.google.com/gke-accelerator": "nvidia-tesla-t4"},
+        )
+        accel, ready = select_accelerator_nodes([node])
+        assert len(accel) == 1
+        assert accel[0].families == ("gpu",)
+        assert accel[0].accelerators == 0
+        assert accel[0].schedulable is False
+        assert ready == []
+
+    def test_fully_dead_gpu_plugin_rescued_by_nvidia_present_label(self):
+        # Same rescue via the NVIDIA GPU-operator / feature-discovery label.
+        node = fx.make_node(
+            "sick-gpu-gfd",
+            ready=True,
+            allocatable={"cpu": "8"},
+            capacity={"cpu": "8"},
+            labels={"nvidia.com/gpu.present": "true"},
+        )
+        accel, ready = select_accelerator_nodes([node])
+        assert len(accel) == 1
+        assert accel[0].families == ("gpu",)
+        assert accel[0].schedulable is False
+        assert ready == []
+
+    def test_nvidia_present_false_is_not_rescued(self):
+        # gpu.present="false" (or garbage) must NOT manufacture an
+        # accelerator node out of a plain CPU host.
+        node = fx.make_node(
+            "plain-cpu",
+            ready=True,
+            allocatable={"cpu": "8"},
+            capacity={"cpu": "8"},
+            labels={"nvidia.com/gpu.present": "false"},
+        )
+        accel, ready = select_accelerator_nodes([node])
+        assert accel == [] and ready == []
+
+    def test_tpu_label_wins_over_gpu_label_on_dead_node(self):
+        # Mixed labels (should not happen on GKE, but the wire is the wire):
+        # the TPU identity takes precedence so slice grouping still sees it.
+        node = fx.make_node(
+            "weird",
+            ready=True,
+            allocatable={},
+            capacity={},
+            labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-accelerator": "nvidia-tesla-t4",
+            },
+        )
+        accel, _ = select_accelerator_nodes([node])
+        assert accel[0].families == ("tpu",)
+        assert accel[0].is_tpu
+
 
 class TestTopology:
     def test_parse(self):

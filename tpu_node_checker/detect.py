@@ -39,6 +39,11 @@ LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
 LABEL_NODEPOOL = "cloud.google.com/gke-nodepool"
 LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+# GPU-node identity labels, used for the same dead-device-plugin rescue as the
+# TPU label above: GKE GPU pools carry gke-accelerator (e.g. "nvidia-tesla-t4");
+# the NVIDIA GPU operator / feature-discovery stamps gpu.present="true".
+LABEL_GPU_ACCELERATOR = "cloud.google.com/gke-accelerator"
+LABEL_NVIDIA_GPU_PRESENT = "nvidia.com/gpu.present"
 
 _INSTANCE_CHIPS_RE = re.compile(r"-(\d+)t$")
 
@@ -189,13 +194,23 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
     matches, schedulable = accelerator_allocatable(node, registry)
     breakdown = {m.key: m.count for m in matches}
     families = tuple(sorted({m.family for m in matches}))
-    if not matches and LABEL_TPU_ACCELERATOR in labels:
-        # The GKE label says this is a TPU host even though the device plugin
-        # advertises nothing (fully dead plugin): keep the node visible as an
-        # unschedulable TPU node so the cluster grades exit 3 ("nodes exist,
-        # none usable"), not exit 2 ("no accelerator nodes").
-        families = ("tpu",)
-        schedulable = False
+    if not matches:
+        # Label rescue: hardware-identity labels say this is an accelerator
+        # host even though the device plugin advertises nothing (fully dead
+        # plugin — no allocatable AND no capacity entry).  Keep the node
+        # visible as an unschedulable accelerator node so the cluster grades
+        # exit 3 ("nodes exist, none usable"), not exit 2 ("no accelerator
+        # nodes").  Symmetric across families (VERDICT r01 item #4): GKE TPU
+        # label, GKE GPU pool label, NVIDIA feature-discovery label.
+        if LABEL_TPU_ACCELERATOR in labels:
+            families = ("tpu",)
+            schedulable = False
+        elif (
+            LABEL_GPU_ACCELERATOR in labels
+            or labels.get(LABEL_NVIDIA_GPU_PRESENT) == "true"
+        ):
+            families = ("gpu",)
+            schedulable = False
     taints = [
         {"key": t.get("key"), "value": t.get("value"), "effect": t.get("effect")}
         for t in map(_as_dict, _as_list(_as_dict(node.get("spec")).get("taints")))
@@ -232,9 +247,10 @@ def select_accelerator_nodes(
     API call — the transport layer hands raw dicts in.
     """
     infos = [extract_node_info(n, registry) for n in nodes]
-    # TPU-labeled nodes stay visible even with zero advertised devices (dead
-    # device plugin) — they are accelerator nodes that cannot serve.
-    accel = [i for i in infos if i.accelerators > 0 or i.is_tpu]
+    # Label-rescued nodes (non-empty families, zero advertised devices — dead
+    # device plugin, TPU or GPU) stay visible: they are accelerator nodes
+    # that cannot serve.
+    accel = [i for i in infos if i.accelerators > 0 or i.families]
     ready = [i for i in accel if i.ready and i.schedulable]
     return accel, ready
 
