@@ -264,6 +264,51 @@ class TestEmitWatch:
 
 
 class TestWatch:
+    def test_watch_cadence_subtracts_round_cost(self, monkeypatch, capsys):
+        # Fixed cadence (VERDICT r01 item #7): a round that takes 3s of a 10s
+        # interval sleeps only 7s, so real cadence is the interval — not
+        # interval + probe time — and probe-report freshness math stays honest.
+        sleeps = []
+        clock = {"t": 100.0}
+
+        def fake_run_check(args):
+            clock["t"] += 3.0  # the check itself costs 3 virtual seconds
+            return checker.CheckResult(exit_code=0)
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            if len(sleeps) >= 2:
+                raise KeyboardInterrupt
+
+        monkeypatch.setattr(checker.time, "monotonic", lambda: clock["t"])
+        monkeypatch.setattr(checker.time, "sleep", fake_sleep)
+        monkeypatch.setattr(checker, "run_check", fake_run_check)
+        with pytest.raises(KeyboardInterrupt):
+            checker.watch(cli.parse_args(["--watch", "10"]))
+        assert sleeps == [7.0, 7.0]
+
+    def test_watch_round_slower_than_interval_never_sleeps_negative(
+        self, monkeypatch, capsys
+    ):
+        sleeps = []
+        clock = {"t": 0.0}
+
+        def fake_run_check(args):
+            clock["t"] += 25.0  # slower than the 10s interval
+            return checker.CheckResult(exit_code=0)
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            if len(sleeps) >= 2:
+                raise KeyboardInterrupt
+
+        monkeypatch.setattr(checker.time, "monotonic", lambda: clock["t"])
+        monkeypatch.setattr(checker.time, "sleep", fake_sleep)
+        monkeypatch.setattr(checker, "run_check", fake_run_check)
+        with pytest.raises(KeyboardInterrupt):
+            checker.watch(cli.parse_args(["--watch", "10"]))
+        assert sleeps == [0.0, 0.0]  # back-to-back, no drift and no crash
+
     def test_watch_zero_rejected(self, capsys):
         with pytest.raises(SystemExit):
             cli.parse_args(["--watch", "0"])

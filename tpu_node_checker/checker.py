@@ -364,6 +364,7 @@ def watch(args) -> None:
                 file=sys.stderr,
             )
     while True:
+        round_start = time.monotonic()
         # The try covers ONLY the check itself: a failure here means "the
         # monitor is down" — a state of its own (EXIT_ERROR) so that recovery
         # also registers as a transition.  Render/notify problems afterwards
@@ -399,7 +400,12 @@ def watch(args) -> None:
         if last_code is not None and code != last_code:
             print(f"State change: exit {last_code} → {code}", file=sys.stderr)
         last_code = code
-        time.sleep(interval)
+        # Fixed cadence, not fixed gap: the round's own cost (a workload-level
+        # probe can take minutes) comes out of the interval, so round N starts
+        # ~N*interval after the first and --probe-results-max-age freshness
+        # math stays honest.  A round slower than the interval runs back to
+        # back rather than drifting further.
+        time.sleep(max(0.0, interval - (time.monotonic() - round_start)))
 
 
 def _recover_last_code(args) -> Optional[int]:

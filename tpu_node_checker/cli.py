@@ -103,7 +103,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        "collectives; or run a full sharded training step (workload)")
     probe.add_argument("--probe-timeout", type=float, default=None,
                        help="hard wall-clock timeout for the probe subprocess (s); "
-                       "default scales with --probe-level (30s enumerate … 600s workload)")
+                       "default scales with --probe-level (30s enumerate … 600s "
+                       "workload); extended automatically to fit --probe-soak and "
+                       "the --probe-distributed rendezvous")
     probe.add_argument("--emit-probe", metavar="FILE",
                        help="run ONLY the local probe and write its JSON report to FILE "
                        "('-' = stdout); the DaemonSet half of multi-host probing")
@@ -150,9 +152,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     slack.add_argument("--slack-webhook", help="Slack incoming-webhook URL (or $SLACK_WEBHOOK_URL)")
     slack.add_argument("--slack-username", default="tpu-node-checker")
     slack.add_argument("--slack-only-on-error", action="store_true",
-                       help="notify only when zero accelerator nodes are Ready")
-    slack.add_argument("--slack-retry-count", type=int, default=3)
-    slack.add_argument("--slack-retry-delay", type=float, default=30.0)
+                       help="notify only when the check outcome is non-zero: no "
+                       "accelerator nodes, none effectively Ready, a failed chip "
+                       "probe, an incomplete slice under --strict-slices, or an "
+                       "--expected-chips shortfall")
+    slack.add_argument("--slack-retry-count", type=int, default=3,
+                       help="delivery retries on connection-reset errors (default 3)")
+    slack.add_argument("--slack-retry-delay", type=float, default=30.0,
+                       help="seconds between Slack delivery retries (default 30)")
     args = p.parse_args(argv)
     if args.watch is not None and args.watch <= 0:
         p.error("--watch interval must be a positive number of seconds")
@@ -199,11 +206,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                 import time as _time
 
                 while True:
+                    round_start = _time.monotonic()
                     try:
                         checker.emit_probe(args)
                     except Exception as exc:  # noqa: BLE001
                         print(f"Probe emission failed: {exc}", file=sys.stderr)
-                    _time.sleep(args.watch)
+                    # Fixed cadence: probe time comes out of the interval so
+                    # report freshness keeps the margin the aggregator's
+                    # --probe-results-max-age math assumes.
+                    _time.sleep(
+                        max(0.0, args.watch - (_time.monotonic() - round_start))
+                    )
             return checker.emit_probe(args)
         if getattr(args, "watch", None) is not None:
             checker.watch(args)  # returns only via signals/exceptions
