@@ -1,0 +1,39 @@
+# tpu-node-checker container images (VERDICT r01 item #3).
+#
+# Two targets from one file:
+#
+#   control-plane  — slim image for the CronJob / aggregator Deployment:
+#                    the checker CLI and its two runtime deps, no jax.
+#   probe          — control-plane + the '.[probe]' extra (jax).  On GKE TPU
+#                    node pools, libtpu and the TPU driver surface come from
+#                    the node; jax picks them up via the device plugin's
+#                    injected environment.  This is the image for the
+#                    DaemonSet emitter and the acceptance Job.
+#
+# Build (from the repo root; constraints.txt pins every wheel):
+#
+#   docker build --target control-plane -t $REGISTRY/tpu-node-checker:control .
+#   docker build --target probe         -t $REGISTRY/tpu-node-checker:probe .
+#   docker push $REGISTRY/tpu-node-checker:control
+#   docker push $REGISTRY/tpu-node-checker:probe
+#
+# Then: kubectl apply -f deploy/  (manifests reference the :control and
+# :probe tags; set REGISTRY via your kustomize/sed of choice).
+
+FROM python:3.12-slim AS base
+WORKDIR /app
+COPY pyproject.toml constraints.txt README.md ./
+COPY tpu_node_checker/ tpu_node_checker/
+
+FROM base AS control-plane
+RUN pip install --no-cache-dir . -c constraints.txt
+# Non-root: the checker only talks HTTPS and reads mounted volumes.
+RUN useradd --uid 65532 --no-create-home checker
+USER 65532
+ENTRYPOINT ["tpu-node-checker"]
+
+FROM base AS probe
+RUN pip install --no-cache-dir '.[probe]' -c constraints.txt
+RUN useradd --uid 65532 --no-create-home checker
+USER 65532
+ENTRYPOINT ["tpu-node-checker"]
