@@ -64,6 +64,15 @@ class TestPallasProbe:
         assert not r.ok
         assert "invalid shape" in r.error  # usage error, not a chip fault
 
+    def test_zero_and_negative_dims_rejected(self):
+        # 0 IS a multiple of 128 — the positivity check must catch it.
+        from tpu_node_checker.ops import pallas_matmul_probe
+
+        for kwargs in ({"m": 0}, {"k": -128}, {"n": 0}):
+            r = pallas_matmul_probe(**{"m": 256, "k": 256, "n": 256, **kwargs})
+            assert not r.ok
+            assert "invalid shape" in r.error
+
 
 class TestDmaProbe:
     def test_double_buffered_stream_matches(self):
@@ -87,6 +96,15 @@ class TestDmaProbe:
         assert not r.ok
         assert "multiple of chunk_rows" in r.error
 
+    def test_zero_dims_rejected(self):
+        from tpu_node_checker.ops import dma_stream_probe
+
+        for kwargs in ({"rows": 0}, {"cols": 0}, {"chunk_rows": 0}):
+            r = dma_stream_probe(**{"rows": 128, "cols": 128, "chunk_rows": 128,
+                                    **kwargs})
+            assert not r.ok
+            assert "invalid shape" in r.error
+
 
 class TestHbmProbe:
     def test_bandwidth_positive(self):
@@ -94,3 +112,9 @@ class TestHbmProbe:
         assert r.ok, r.error
         assert r.gbps > 0
         assert r.bytes_moved == 2 * 8 * 1024 * 1024 * 2
+
+    def test_invalid_args_rejected(self):
+        for kwargs in ({"mib": 0}, {"mib": -1}, {"iters": 0}):
+            r = hbm_bandwidth_probe(**{"mib": 8, "iters": 2, **kwargs})
+            assert not r.ok
+            assert "invalid args" in r.error

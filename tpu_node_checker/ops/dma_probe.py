@@ -125,10 +125,12 @@ def dma_stream_probe(
         device = device or jax.local_devices()[0]
         if interpret is None:
             interpret = device.platform != "tpu"
-        if rows % chunk_rows:
+        if min(rows, cols, chunk_rows) <= 0 or rows % chunk_rows:
             return DmaProbeResult(
                 ok=False, gbps=0.0, elapsed_ms=0.0, interpreted=bool(interpret),
-                error=f"rows ({rows}) must be a multiple of chunk_rows ({chunk_rows})",
+                error=f"invalid shape rows={rows} cols={cols} "
+                f"chunk_rows={chunk_rows}: dims must be positive and rows a "
+                "multiple of chunk_rows",
             )
         x = jax.device_put(
             jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.float32), device

@@ -193,11 +193,23 @@ def flash_attention_probe(
     sides.
     """
     try:
-        if seq % BLOCK:
+        if seq <= 0 or seq % BLOCK:
             return FlashAttentionProbeResult(
                 ok=False, max_abs_err=float("inf"), elapsed_ms=0.0,
                 interpreted=bool(interpret),
-                error=f"invalid seq {seq}: must be a multiple of {BLOCK}",
+                error=f"invalid seq {seq}: must be a positive multiple of {BLOCK}",
+            )
+        if batch <= 0 or heads <= 0 or head_dim <= 0:
+            # Validated up front (like seq) so bad dims degrade cleanly
+            # instead of leaking a numpy divide-by-zero RuntimeWarning from
+            # the 1/sqrt(head_dim) scale before failing.
+            return FlashAttentionProbeResult(
+                ok=False, max_abs_err=float("inf"), elapsed_ms=0.0,
+                interpreted=bool(interpret),
+                error=(
+                    f"invalid dims batch={batch} heads={heads} "
+                    f"head_dim={head_dim}: all must be positive"
+                ),
             )
         device, interpret = resolve_backend(device, interpret)
         keys = jax.random.split(jax.random.PRNGKey(0), 3)

@@ -39,6 +39,11 @@ def hbm_bandwidth_probe(
 ) -> HbmResult:
     """Time ``iters`` streaming passes over a ``mib``-MiB float32 buffer."""
     try:
+        if mib <= 0 or iters <= 0:
+            return HbmResult(
+                ok=False, gbps=0.0, elapsed_ms=0.0, bytes_moved=0,
+                error=f"invalid args mib={mib} iters={iters}: must be positive",
+            )
         device = device or jax.local_devices()[0]
         n = (mib * 1024 * 1024) // 4
         x = jax.device_put(jnp.zeros((n,), dtype=jnp.float32), device)

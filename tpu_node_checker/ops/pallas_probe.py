@@ -74,12 +74,14 @@ def pallas_matmul_probe(
 ) -> PallasProbeResult:
     """Run the Mosaic tiled matmul and cross-check against XLA's jnp.dot."""
     try:
-        if m % 128 or k % 128 or n % 128:
+        if min(m, k, n) <= 0 or m % 128 or k % 128 or n % 128:
             # A usage error must not read as a Mosaic/chip fault downstream.
+            # (<=0 checked explicitly: 0 is a multiple of 128.)
             return PallasProbeResult(
                 ok=False, max_rel_err=float("inf"), elapsed_ms=0.0,
                 interpreted=bool(interpret),
-                error=f"invalid shape ({m},{k},{n}): dims must be multiples of 128",
+                error=f"invalid shape ({m},{k},{n}): dims must be positive "
+                "multiples of 128",
             )
         device, interpret = resolve_backend(device, interpret)
         key = jax.random.PRNGKey(0)
