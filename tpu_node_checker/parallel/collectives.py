@@ -38,14 +38,30 @@ class CollectiveResult:
     details: Optional[dict] = None
 
 
-def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> CollectiveResult:
+_COLLECTIVE_LEGS = ("psum", "all_gather", "reduce_scatter")
+
+
+def collective_probe(
+    mesh=None,
+    payload: int = 1024,
+    timed_iters: int = 10,
+    inject_fault_leg: Optional[str] = None,
+) -> CollectiveResult:
     """psum + all_gather + reduce-scatter over ``mesh`` (default: all local).
 
     Device ``i`` contributes a constant vector of ``i``; psum and the
     reduce-scatter shard must yield ``n(n-1)/2`` everywhere and the gather
     must reproduce ``[0, ..., n-1]``.
+
+    ``inject_fault_leg`` perturbs ONE named leg's device-side result — a
+    chaos hook proving the per-leg verdict contract ("a corrupted leg is
+    reported as that leg, and only that leg") on healthy hardware.
     """
     try:
+        if inject_fault_leg is not None and inject_fault_leg not in _COLLECTIVE_LEGS:
+            raise ValueError(
+                f"inject_fault_leg {inject_fault_leg!r} not one of {_COLLECTIVE_LEGS}"
+            )
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -68,16 +84,22 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
 
         def _probe(local):
             total = jax.lax.psum(local, "d")  # replication statically inferred
+            if inject_fault_leg == "psum":
+                total = total + 1.0  # simulated reduction corruption
             # Every device ends up holding the full (n, payload) gather; kept
             # sharded on the way out (out_spec P("d")) because shard_map's
             # replication checker can't infer all_gather outputs.
             gathered = jax.lax.all_gather(local, "d", tiled=True)
+            if inject_fault_leg == "all_gather":
+                gathered = gathered + 1.0
             # Reduce-scatter: every device contributes the full (n, payload)
             # matrix (rows = its constant i) and keeps one reduced row.
             contrib = jnp.broadcast_to(local, (n, local.shape[1]))
             scattered = jax.lax.psum_scatter(
                 contrib, "d", scatter_dimension=0, tiled=True
             )
+            if inject_fault_leg == "reduce_scatter":
+                scattered = scattered + 1.0
             return total, gathered, scattered
 
         probe = jax.jit(
@@ -131,7 +153,7 @@ def collective_probe(mesh=None, payload: int = 1024, timed_iters: int = 10) -> C
             error=None
             if ok
             else (
-                f"collective mismatch (psum ok={sum_ok}, gather ok={gather_ok}, "
+                f"collective mismatch (psum ok={sum_ok}, all_gather ok={gather_ok}, "
                 f"reduce_scatter ok={scatter_ok})"
             ),
             details={
@@ -253,11 +275,20 @@ def per_axis_probe(
         )
 
 
-def ring_probe(mesh=None, payload: int = 256) -> CollectiveResult:
+def ring_probe(
+    mesh=None, payload: int = 256, inject_fault_link: Optional[int] = None
+) -> CollectiveResult:
     """Walk the device ring with ``ppermute``, one hop per ``lax.scan`` step.
 
     After n single-step rotations every payload is back at its origin; any
     dead or corrupting link breaks the round trip at the hop that crosses it.
+    When the round trip fails, a **single-hop diagnostic** runs: one
+    ``ppermute`` step, verified per receiver on the host, names the exact
+    link(s) ``i→i+1`` whose delivered payload is wrong — for a real corrupting
+    link and for the chaos hook alike.
+
+    ``inject_fault_link`` corrupts everything delivered over the named link
+    (receiver side), proving the localization contract on healthy hardware.
     """
     try:
         import jax
@@ -276,18 +307,34 @@ def ring_probe(mesh=None, payload: int = 256) -> CollectiveResult:
             mesh = build_mesh(MeshSpec((("d", len(jax.devices())),)))
         mesh = flat_mesh(mesh, "d")
         n = int(np.prod(mesh.devices.shape))
+        if inject_fault_link is not None and not 0 <= inject_fault_link < n:
+            raise ValueError(
+                f"inject_fault_link {inject_fault_link} out of range for {n} links"
+            )
+        recv = None if inject_fault_link is None else (inject_fault_link + 1) % n
 
         x = jnp.arange(n, dtype=jnp.float32)[:, None] * jnp.ones((1, payload), jnp.float32)
         x = jax.device_put(x, NamedSharding(mesh, P("d")))
 
         perm = [(i, (i + 1) % n) for i in range(n)]
 
+        def _deliver(carry):
+            """One ppermute hop, with the chaos corruption on the receiver."""
+            out = jax.lax.ppermute(carry, "d", perm)
+            if recv is not None:
+                i = jax.lax.axis_index("d")
+                out = jnp.where(i == recv, out + 1.0, out)
+            return out
+
         def _full_ring(local):
             def step(carry, _):
-                return jax.lax.ppermute(carry, "d", perm), None
+                return _deliver(carry), None
 
             out, _ = jax.lax.scan(step, local, None, length=n)
             return out
+
+        def _one_hop(local):
+            return _deliver(local)
 
         full_ring = jax.jit(sm(_full_ring, mesh=mesh, in_specs=P("d"), out_specs=P("d")))
 
@@ -304,12 +351,35 @@ def ring_probe(mesh=None, payload: int = 256) -> CollectiveResult:
         link_gbps = None
         if n > 1 and latency_us > 0:
             link_gbps = round((payload * 4) / (latency_us / n * 1e-6) / 1e9, 3)
+        details = {"hops": n, "link_gbps": link_gbps}
+        error = None
+        if not ok:
+            # Localization pass: after ONE hop, receiver r must hold origin
+            # r-1's constant payload; a wrong row names link (r-1)→r.  The
+            # full-ring walk detects (every payload crosses every link); the
+            # single hop attributes.
+            one_hop = jax.jit(
+                sm(_one_hop, mesh=mesh, in_specs=P("d"), out_specs=P("d"))
+            )
+            hop = np.asarray(one_hop(x))
+            bad_links = [
+                f"{(r - 1) % n}->{r}"
+                for r in range(n)
+                if not np.allclose(hop[r], float((r - 1) % n))
+            ]
+            details["bad_links"] = bad_links
+            where = (
+                f"single-hop diagnostic names link(s) {', '.join(bad_links)}"
+                if bad_links
+                else "single-hop diagnostic clean (multi-hop-only fault)"
+            )
+            error = f"ring ppermute did not return payloads to origin; {where}"
         return CollectiveResult(
             ok=ok,
             n_devices=n,
             latency_us=latency_us,
-            error=None if ok else "ring ppermute did not return payloads to origin",
-            details={"hops": n, "link_gbps": link_gbps},
+            error=error,
+            details=details,
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
         return CollectiveResult(

@@ -42,15 +42,31 @@ class MoEResult:
     max_abs_err: float
     latency_ms: float
     error: Optional[str] = None
+    details: Optional[dict] = None
 
 
-def make_moe_layer(mesh, axis: str = "ep"):
+def make_moe_layer(
+    mesh,
+    axis: str = "ep",
+    inject_fault_expert: Optional[int] = None,
+    with_ungated: bool = False,
+):
     """Build a jitted expert-parallel MoE layer over ``mesh``'s ``axis``.
 
     Returned fn maps stacked expert weights ``w1`` (n, d, f) / ``w2`` (n, f, d),
     router matrix ``wr`` (d, n) (replicated), and tokens ``x`` (n·T, d)
     (sharded over ``axis``) to the gated expert outputs, same sharding as
     ``x``.  ``T`` must be divisible by ``n``.
+
+    ``inject_fault_expert`` corrupts ONE received token on the named expert's
+    device after the dispatch ``all_to_all`` (a mis-routed/mangled token) —
+    the chaos hook for the per-expert attribution contract.
+
+    ``with_ungated=True`` additionally returns the combined expert outputs
+    BEFORE gate scaling.  The gate is a softmax weight that can be arbitrarily
+    small, and ``gate · corruption`` can vanish below any absolute tolerance —
+    a real mis-route on a low-gate token would hide from the gated check, so
+    the probe verifies the ungated surface.
     """
     import jax
     import jax.numpy as jnp
@@ -59,6 +75,10 @@ def make_moe_layer(mesh, axis: str = "ep"):
     from tpu_node_checker.parallel.mesh import shard_map_fn
 
     n = int(mesh.shape[axis])
+    if inject_fault_expert is not None and not 0 <= inject_fault_expert < n:
+        raise ValueError(
+            f"inject_fault_expert {inject_fault_expert} out of range for {n} experts"
+        )
     sm = shard_map_fn()
 
     def _local(w1, w2, wr, x):
@@ -83,6 +103,15 @@ def make_moe_layer(mesh, axis: str = "ep"):
         received = jax.lax.all_to_all(
             grouped, axis, split_axis=0, concat_axis=0, tiled=True
         )  # (n, g, d) — row s is the group-for-this-expert from device s
+        if inject_fault_expert is not None:
+            # Corrupt one token (home device 0, slot 0) in the named expert's
+            # inbox: the error must surface ONLY on tokens this expert serves.
+            i = jax.lax.axis_index(axis)
+            received = jnp.where(
+                i == inject_fault_expert,
+                received.at[0, 0, :].add(1.0),
+                received,
+            )
 
         # This expert's FFN over everything it received.  HIGHEST precision:
         # TPU f32 matmuls default to bf16 passes, and a numerics *probe* must
@@ -98,19 +127,22 @@ def make_moe_layer(mesh, axis: str = "ep"):
             y, axis, split_axis=0, concat_axis=0, tiled=True
         )  # (n, g, d) — row e is expert e's output for this device's group e
         ungrouped = back.transpose(1, 0, 2).reshape(T, d)
-        return ungrouped * gate[:, None]
+        gated = ungrouped * gate[:, None]
+        if with_ungated:
+            return gated, ungrouped
+        return gated
 
     return jax.jit(
         sm(
             _local,
             mesh=mesh,
             in_specs=(P(axis, None, None), P(axis, None, None), P(), P(axis, None)),
-            out_specs=P(axis, None),
+            out_specs=(P(axis, None), P(axis, None)) if with_ungated else P(axis, None),
         )
     )
 
 
-def reference_moe(w1, w2, wr, x, n):
+def reference_moe(w1, w2, wr, x, n, with_ungated: bool = False):
     """Dense single-device evaluation of the same gated MoE — ground truth."""
     import jax
     import jax.numpy as jnp
@@ -124,7 +156,10 @@ def reference_moe(w1, w2, wr, x, n):
     h = jnp.tanh(jnp.einsum("td,edf->etf", x, w1, precision=hi))
     y = jnp.einsum("etf,efd->etd", h, w2, precision=hi)  # (n_experts, T, d)
     sel = y[expert_of, np.arange(T)]
-    return sel * gate[:, None]
+    gated = sel * gate[:, None]
+    if with_ungated:
+        return gated, sel
+    return gated
 
 
 def moe_probe(
@@ -133,9 +168,17 @@ def moe_probe(
     d_model: int = 32,
     d_ff: int = 64,
     rtol: float = 1e-3,
+    inject_fault_expert: Optional[int] = None,
 ) -> MoEResult:
     """Run the expert-parallel layer across the mesh and verify against the
-    dense reference — a mismatch localizes to the all_to_all shuffle paths."""
+    dense reference.
+
+    Attribution: token ``j`` is statically assigned expert ``j mod n``, so
+    host-side errors group by expert — the verdict names the expert(s) whose
+    tokens came back wrong, i.e. the sick device or its all_to_all legs.
+    ``inject_fault_expert`` mangles one token in that expert's inbox — the
+    chaos hook proving attribution is exact (that expert, and only it).
+    """
     try:
         import jax
         import jax.numpy as jnp
@@ -166,23 +209,55 @@ def moe_probe(
         wrs = jax.device_put(wr, NamedSharding(mesh, P()))
         xs = jax.device_put(x, NamedSharding(mesh, P("ep", None)))
 
-        fn = make_moe_layer(mesh)
-        out = fn(w1s, w2s, wrs, xs)  # warmup: compile + first pass
-        out_host = np.asarray(jax.device_get(out))
+        fn = make_moe_layer(
+            mesh, inject_fault_expert=inject_fault_expert, with_ungated=True
+        )
+        fn(w1s, w2s, wrs, xs)  # warmup: compile + first pass
         t0 = time.perf_counter()
-        out_host = np.asarray(jax.device_get(fn(w1s, w2s, wrs, xs)))
+        gated_dev, ungated_dev = jax.device_get(fn(w1s, w2s, wrs, xs))
         latency_ms = (time.perf_counter() - t0) * 1e3
+        out_host, raw_host = np.asarray(gated_dev), np.asarray(ungated_dev)
 
-        ref = np.asarray(jax.device_get(reference_moe(w1, w2, wr, x, n)))
+        ref, raw_ref = jax.device_get(reference_moe(w1, w2, wr, x, n, with_ungated=True))
+        ref, raw_ref = np.asarray(ref), np.asarray(raw_ref)
         max_abs_err = float(np.max(np.abs(out_host - ref)))
-        ok = bool(np.allclose(out_host, ref, rtol=rtol, atol=rtol))
+        # Verdict on the UNGATED surface: the gate can scale a corrupted
+        # token below any absolute tolerance (see make_moe_layer docstring).
+        ok = bool(np.allclose(raw_host, raw_ref, rtol=rtol, atol=rtol)) and bool(
+            np.allclose(out_host, ref, rtol=rtol, atol=rtol)
+        )
+        details = None
+        error = None
+        if not ok:
+            # Per-expert attribution: global token j serves expert j mod n
+            # (T divides by n, so the local round-robin IS the global one).
+            err = np.abs(raw_host - raw_ref).max(axis=1)  # (n*T,)
+            tol = rtol * np.maximum(np.abs(raw_ref).max(axis=1), 1.0)
+            expert_of = np.arange(n * T) % n
+            bad_experts = sorted(
+                int(e) for e in np.unique(expert_of[err > tol])
+            )
+            raw_max_err = float(np.max(np.abs(raw_host - raw_ref)))
+            details = {"bad_experts": bad_experts, "ungated_max_abs_err": raw_max_err}
+            # Report the UNGATED magnitude the verdict was based on — the
+            # gated delta can read as float noise on a low-gate token.
+            where = (
+                f"errors attribute to expert(s) {bad_experts}"
+                if bad_experts
+                else "attribution clean (gate-path or sub-threshold fault)"
+            )
+            error = (
+                f"moe all_to_all mismatch: ungated max|Δ|={raw_max_err:.3e} "
+                f"(gated {max_abs_err:.3e}); {where}"
+            )
         return MoEResult(
             ok=ok,
             n_experts=n,
             tokens=n * T,
             max_abs_err=max_abs_err,
             latency_ms=latency_ms,
-            error=None if ok else f"moe all_to_all mismatch: max|Δ|={max_abs_err:.3e}",
+            error=error,
+            details=details,
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
         return MoEResult(

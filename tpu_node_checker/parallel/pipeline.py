@@ -40,15 +40,28 @@ class PipelineResult:
     max_abs_err: float
     latency_ms: float
     error: Optional[str] = None
+    details: Optional[dict] = None
 
 
-def make_pipeline(mesh, axis: str = "pp"):
+def make_pipeline(
+    mesh,
+    axis: str = "pp",
+    inject_fault_stage: Optional[int] = None,
+    with_checksums: bool = False,
+):
     """Build a jitted pipelined forward over ``mesh``'s ``axis``.
 
     Returned fn maps stacked stage weights ``w`` (n, d, d) / ``b`` (n, d)
     (sharded over ``axis``) and microbatched input ``x`` (M, B, d)
     (replicated) to the output (M, B, d) (replicated) equal to applying
     ``tanh(x @ w_s + b_s)`` for s = 0..n-1 in order.
+
+    ``with_checksums=True`` additionally returns a replicated ``(n,)`` vector
+    of per-stage activation checksums (Σ|y| over each stage's *valid* ticks):
+    the first stage whose checksum disagrees with the sequential reference
+    names where a corruption entered the pipe — fill/drain garbage is
+    excluded, so the checksums are deterministic.  ``inject_fault_stage``
+    perturbs one stage's output (chaos hook for that contract).
     """
     import jax
     import jax.numpy as jnp
@@ -57,6 +70,10 @@ def make_pipeline(mesh, axis: str = "pp"):
     from tpu_node_checker.parallel.mesh import device_varying, shard_map_fn
 
     n = int(mesh.shape[axis])
+    if inject_fault_stage is not None and not 0 <= inject_fault_stage < n:
+        raise ValueError(
+            f"inject_fault_stage {inject_fault_stage} out of range for {n} stages"
+        )
     sm = shard_map_fn()
     perm = [(r, (r + 1) % n) for r in range(n)]
 
@@ -70,9 +87,10 @@ def make_pipeline(mesh, axis: str = "pp"):
 
         state = device_varying(jnp.zeros((B, d), jnp.float32), axis)
         outbuf = device_varying(jnp.zeros((M, B, d), jnp.float32), axis)
+        chk = device_varying(jnp.float32(0.0), axis)
 
         def tick(t, carry):
-            state, outbuf = carry
+            state, outbuf, chk = carry
             # Stage 0 injects microbatch t while any remain; other stages
             # consume whatever the previous hop delivered.
             inj = jax.lax.dynamic_index_in_dim(
@@ -90,6 +108,15 @@ def make_pipeline(mesh, axis: str = "pp"):
                 )
                 + b
             )
+            if inject_fault_stage is not None:
+                # Simulated stage corruption (sick matmul, bad VMEM): the
+                # perturbation rides the normal dataflow into later stages.
+                y = jnp.where(i == inject_fault_stage, y + 1.0, y)
+            # Stage i processes microbatch t-i; outside [0, M) it is chewing
+            # fill/drain garbage that never reaches the output — exclude it
+            # from the checksum too.
+            valid = (t >= i) & (t - i < M)
+            chk = chk + jnp.where(valid, jnp.sum(jnp.abs(y)), 0.0)
             # The last stage finishes microbatch t-(n-1) at tick t.
             mb = t - (n - 1)
             upd = jax.lax.dynamic_update_index_in_dim(
@@ -98,34 +125,50 @@ def make_pipeline(mesh, axis: str = "pp"):
             write = (i == n - 1) & (mb >= 0)
             outbuf = jnp.where(write, upd, outbuf)
             state = jax.lax.ppermute(y, axis, perm)
-            return state, outbuf
+            return state, outbuf, chk
 
-        _, outbuf = jax.lax.fori_loop(0, n_ticks, tick, (state, outbuf))
+        _, outbuf, chk = jax.lax.fori_loop(0, n_ticks, tick, (state, outbuf, chk))
         # Only the last stage wrote non-zeros; psum replicates the result.
-        return jax.lax.psum(outbuf, axis)
+        out = jax.lax.psum(outbuf, axis)
+        if not with_checksums:
+            return out
+        # One-hot scatter + psum → replicated (n,) per-stage checksum vector.
+        stage_chk = jax.lax.psum(
+            jax.nn.one_hot(i, n, dtype=jnp.float32) * chk, axis
+        )
+        return out, stage_chk
 
     return jax.jit(
         sm(
             _local,
             mesh=mesh,
             in_specs=(P(axis, None, None), P(axis, None), P()),
-            out_specs=P(),
+            out_specs=(P(), P()) if with_checksums else P(),
         )
     )
 
 
-def reference_pipeline(w, b, x):
-    """Sequential stage composition on one device — ground truth."""
+def reference_pipeline(w, b, x, with_checksums: bool = False):
+    """Sequential stage composition on one device — ground truth.
+
+    With ``with_checksums`` also returns the per-stage Σ|activation| vector
+    matching :func:`make_pipeline`'s checksum contract.
+    """
     import jax
     import jax.numpy as jnp
 
     M, B, d = x.shape
     out = x.reshape(M * B, d)
+    chks = []
     for s in range(w.shape[0]):
         out = jnp.tanh(
             jnp.dot(out, w[s], precision=jax.lax.Precision.HIGHEST) + b[s]
         )
-    return out.reshape(M, B, d)
+        chks.append(jnp.sum(jnp.abs(out)))
+    out = out.reshape(M, B, d)
+    if with_checksums:
+        return out, jnp.stack(chks)
+    return out
 
 
 def pipeline_probe(
@@ -134,9 +177,18 @@ def pipeline_probe(
     batch: int = 2,
     d_model: int = 32,
     rtol: float = 1e-3,
+    inject_fault_stage: Optional[int] = None,
 ) -> PipelineResult:
     """Run the pipelined forward across the mesh and verify against the
-    sequential reference — a wrong result localizes to a stage-to-stage hop."""
+    sequential reference.
+
+    Localization: per-stage activation checksums are compared against the
+    reference's — the FIRST stage whose checksum disagrees is where the
+    corruption entered the pipe (everything downstream is poisoned by
+    propagation), so the verdict names a stage, hence a device and its
+    incoming hop.  ``inject_fault_stage`` perturbs one stage's output — the
+    chaos hook proving that contract on healthy hardware.
+    """
     try:
         import jax
         import jax.numpy as jnp
@@ -164,23 +216,46 @@ def pipeline_probe(
         bs = jax.device_put(b, NamedSharding(mesh, P("pp", None)))
         xs = jax.device_put(x, NamedSharding(mesh, P()))
 
-        fn = make_pipeline(mesh)
-        out = fn(ws, bs, xs)  # warmup: compile + first pass
-        out_host = np.asarray(jax.device_get(out))
+        fn = make_pipeline(
+            mesh, inject_fault_stage=inject_fault_stage, with_checksums=True
+        )
+        fn(ws, bs, xs)  # warmup: compile + first pass
         t0 = time.perf_counter()
-        out_host = np.asarray(jax.device_get(fn(ws, bs, xs)))
+        out, stage_chk = jax.device_get(fn(ws, bs, xs))
         latency_ms = (time.perf_counter() - t0) * 1e3
+        out_host = np.asarray(out)
 
-        ref = np.asarray(jax.device_get(reference_pipeline(w, b, x)))
+        ref, ref_chk = jax.device_get(reference_pipeline(w, b, x, with_checksums=True))
+        ref = np.asarray(ref)
         max_abs_err = float(np.max(np.abs(out_host - ref)))
         ok = bool(np.allclose(out_host, ref, rtol=rtol, atol=rtol))
+        details = None
+        error = None
+        if not ok:
+            # Checksum tolerance scales with magnitude: Σ|y| over M·B·d terms.
+            scale = np.maximum(np.abs(np.asarray(ref_chk)), 1.0)
+            bad = np.flatnonzero(
+                np.abs(np.asarray(stage_chk) - np.asarray(ref_chk)) > rtol * scale
+            )
+            first_bad = int(bad[0]) if bad.size else None
+            details = {
+                "stage_checksums": [round(float(c), 4) for c in np.asarray(stage_chk)],
+                "first_bad_stage": first_bad,
+            }
+            where = (
+                f"corruption entered at stage {first_bad}"
+                if first_bad is not None
+                else "stage checksums clean (output-combine fault)"
+            )
+            error = f"pipeline mismatch: max|Δ|={max_abs_err:.3e}; {where}"
         return PipelineResult(
             ok=ok,
             n_stages=n,
             n_microbatches=n_microbatches,
             max_abs_err=max_abs_err,
             latency_ms=latency_ms,
-            error=None if ok else f"pipeline mismatch: max|Δ|={max_abs_err:.3e}",
+            error=error,
+            details=details,
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
         return PipelineResult(
