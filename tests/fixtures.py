@@ -120,6 +120,34 @@ def tpu_v5e_256_slice(not_ready: int = 0) -> List[dict]:
     ]
 
 
+def tpu_multislice(
+    n_slices: int = 2,
+    not_ready: int = 0,
+    group: str = "ms-train-1",
+    group_label: str = "cloud.google.com/gke-multislice-group",
+) -> List[dict]:
+    """DCN-joined multislice: ``n_slices`` v5e 4x4 slices (4 hosts × 4 chips
+    each) sharing one grouping label; ``not_ready`` hosts of slice 0 are down."""
+    nodes = []
+    for s in range(n_slices):
+        for i in range(4):
+            nodes.append(
+                make_node(
+                    f"gke-tpu-ms{s}-{i}",
+                    ready=not (s == 0 and i < not_ready),
+                    allocatable={"google.com/tpu": "4"},
+                    labels={
+                        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                        "cloud.google.com/gke-tpu-topology": "4x4",
+                        "cloud.google.com/gke-nodepool": f"ms-pool-{s}",
+                        group_label: group,
+                    },
+                    taints=[TPU_TAINT],
+                )
+            )
+    return nodes
+
+
 def big_mixed_cluster(
     cpu: int = 3000, gpu: int = 1000, tpu_slices: int = 16
 ) -> List[dict]:

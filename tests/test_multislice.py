@@ -1,0 +1,132 @@
+"""Multislice (DCN) awareness tests (VERDICT r01 item #8).
+
+A multislice job spans several slices joined over DCN; each slice is judged
+individually by the slice logic, and the labeled grouping rolls them up into
+one logical unit in the payload, table, and Slack surfaces.
+"""
+
+import json
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, report
+from tpu_node_checker.detect import (
+    group_multislices,
+    group_slices,
+    select_accelerator_nodes,
+)
+
+
+def _slices(nodes):
+    accel, _ = select_accelerator_nodes(nodes)
+    return group_slices(accel)
+
+
+class TestGroupMultislices:
+    def test_two_slices_one_group(self):
+        ms = group_multislices(_slices(fx.tpu_multislice(n_slices=2)))
+        assert len(ms) == 1
+        m = ms[0]
+        assert m.group == "ms-train-1"
+        assert len(m.slices) == 2
+        assert m.hosts == 8
+        assert m.chips == 32 and m.ready_chips == 32
+        assert m.expected_chips == 32  # 2 × (4x4 topology)
+        assert m.complete
+
+    def test_degraded_member_degrades_the_group(self):
+        ms = group_multislices(_slices(fx.tpu_multislice(n_slices=2, not_ready=1)))
+        m = ms[0]
+        assert m.ready_chips == 28
+        assert not m.complete
+
+    def test_unlabeled_slices_form_no_group(self):
+        assert group_multislices(_slices(fx.tpu_v5e_256_slice())) == []
+
+    def test_custom_label_key_checked_first(self):
+        nodes = fx.tpu_multislice(group_label="acme.io/ms-group", group="job-7")
+        slices = _slices(nodes)
+        assert group_multislices(slices) == []  # unknown key: no grouping
+        ms = group_multislices(slices, extra_label_keys=("acme.io/ms-group",))
+        assert len(ms) == 1 and ms[0].group == "job-7"
+
+    def test_partial_labeling_is_deterministic_and_flagged(self):
+        # One host of slice 0 lost its label (node recreate mid-rollout):
+        # grouping must not depend on API order, and the state is flagged.
+        nodes = fx.tpu_multislice(n_slices=2)
+        del nodes[0]["metadata"]["labels"]["cloud.google.com/gke-multislice-group"]
+        for order in (nodes, list(reversed(nodes))):
+            ms = group_multislices(_slices(order))
+            assert len(ms) == 1
+            assert ms[0].group == "ms-train-1"
+            assert len(ms[0].slices) == 2  # majority keeps the slice in
+            assert ms[0].partial_labeling is True
+            assert ms[0].to_dict()["partial_labeling"] is True
+
+    def test_fully_labeled_group_not_flagged(self):
+        ms = group_multislices(_slices(fx.tpu_multislice()))
+        assert ms[0].partial_labeling is False
+
+    def test_distinct_groups_stay_separate(self):
+        nodes = fx.tpu_multislice(group="a") + [
+            n
+            for n in fx.tpu_multislice(group="b")
+            # Rename to avoid node-name collisions between the two fixtures.
+        ]
+        for i, n in enumerate(nodes[8:], start=8):
+            n["metadata"]["name"] = f"gke-tpu-msb-{i}"
+            n["metadata"]["labels"]["cloud.google.com/gke-nodepool"] = f"b-pool-{i // 4}"
+        ms = group_multislices(_slices(nodes))
+        assert [m.group for m in ms] == ["a", "b"]
+
+
+class TestMultisliceSurfaces:
+    def test_json_payload_carries_rollup(self, capsys):
+        args = cli.parse_args(["--json"])
+        code = checker.one_shot(args, nodes=fx.tpu_multislice(n_slices=2, not_ready=1))
+        assert code == 0  # some hosts Ready; strictness is opt-in
+        payload = json.loads(capsys.readouterr().out)
+        ms = payload["multislices"]
+        assert len(ms) == 1
+        assert ms[0]["group"] == "ms-train-1"
+        assert ms[0]["num_slices"] == 2
+        assert ms[0]["ready_chips"] == 28
+        assert ms[0]["complete"] is False
+
+    def test_no_multislice_key_when_ungrouped(self, capsys):
+        args = cli.parse_args(["--json"])
+        checker.one_shot(args, nodes=fx.tpu_v5e_256_slice())
+        payload = json.loads(capsys.readouterr().out)
+        assert "multislices" not in payload
+
+    def test_table_rendered_in_human_mode(self, capsys):
+        args = cli.parse_args([])
+        checker.one_shot(args, nodes=fx.tpu_multislice())
+        out = capsys.readouterr().out
+        assert "MULTISLICE(GROUP)" in out
+        assert "ms-train-1" in out
+
+    def test_strict_slices_exits_3_on_degraded_member(self):
+        args = cli.parse_args(["--strict-slices", "--json"])
+        code = checker.one_shot(
+            args, nodes=fx.tpu_multislice(n_slices=2, not_ready=1)
+        )
+        assert code == 3
+
+    def test_custom_label_flag_plumbed(self, capsys):
+        args = cli.parse_args(["--json", "--multislice-label", "acme.io/ms-group"])
+        checker.one_shot(
+            args,
+            nodes=fx.tpu_multislice(group_label="acme.io/ms-group", group="job-9"),
+        )
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["multislices"][0]["group"] == "job-9"
+
+    def test_slack_message_includes_multislice_line(self):
+        nodes = fx.tpu_multislice(n_slices=2, not_ready=1)
+        accel, ready = select_accelerator_nodes(nodes)
+        slices = group_slices(accel)
+        ms = group_multislices(slices)
+        msg = report.format_slack_message(
+            accel, ready, slices, healthy=False, multislices=ms
+        )
+        assert "multislice `ms-train-1`: 2 slice(s), 28/32 chips, DEGRADED" in msg

@@ -32,7 +32,13 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 from tpu_node_checker import notify, report
-from tpu_node_checker.detect import NodeInfo, SliceInfo, group_slices, select_accelerator_nodes
+from tpu_node_checker.detect import (
+    NodeInfo,
+    SliceInfo,
+    group_multislices,
+    group_slices,
+    select_accelerator_nodes,
+)
 from tpu_node_checker.resources import ResourceRegistry, default_registry
 from tpu_node_checker.utils.timing import PhaseTimer
 
@@ -48,6 +54,7 @@ class CheckResult:
     accel: List[NodeInfo] = field(default_factory=list)
     ready: List[NodeInfo] = field(default_factory=list)  # effective (probe-adjusted)
     slices: List[SliceInfo] = field(default_factory=list)
+    multislices: List = field(default_factory=list)
     payload: dict = field(default_factory=dict)
     local_probe: Optional[dict] = None
 
@@ -243,6 +250,15 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
         payload = report.build_json_payload(
             accel, effective_ready, slices, timings_ms=None
         )
+        multislices = group_multislices(
+            slices, getattr(args, "multislice_label", None) or ()
+        )
+        if multislices:
+            # DCN-joined multislice roll-up (VERDICT r01 item #8): readiness
+            # across every slice of the group; completeness covers present
+            # slices only (see MultisliceInfo docstring).
+            payload["multislices"] = [m.to_dict() for m in multislices]
+            result.multislices = multislices
         if result.local_probe is not None:
             payload["local_probe"] = result.local_probe
         if getattr(args, "probe_results", None):
@@ -486,7 +502,9 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
     if notify_enabled and notify.should_send_slack_message(
         webhook, getattr(args, "slack_only_on_error", False), healthy
     ):
-        message = report.format_slack_message(accel, ready, slices, healthy=healthy)
+        message = report.format_slack_message(
+            accel, ready, slices, healthy=healthy, multislices=result.multislices
+        )
         sent = notify.send_slack_message(
             webhook,
             message,
@@ -520,6 +538,10 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
         if slice_table:
             print()
             print(slice_table)
+        ms_table = report.format_multislice_table(result.multislices)
+        if ms_table:
+            print()
+            print(ms_table)
         if result.local_probe is not None:
             status = "ok" if result.local_probe.get("ok") else "FAILED"
             print()

@@ -76,6 +76,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     )
     p.add_argument("--strict-slices", action="store_true",
                    help="exit 3 if any multi-host TPU slice is incomplete")
+    p.add_argument("--multislice-label", action="append", metavar="KEY",
+                   help="node label key that groups slices into a DCN-joined "
+                   "multislice (repeatable; checked before the built-in "
+                   "cloud.google.com/gke-multislice-group convention)")
     p.add_argument("--expected-chips", type=_expected_chips, metavar="[KEY=]N",
                    help="exit 3 unless at least N chips are on Ready nodes "
                    "(cluster-level capacity assertion, e.g. 256 for a "

@@ -44,6 +44,15 @@ LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
 # the NVIDIA GPU operator / feature-discovery stamps gpu.present="true".
 LABEL_GPU_ACCELERATOR = "cloud.google.com/gke-accelerator"
 LABEL_NVIDIA_GPU_PRESENT = "nvidia.com/gpu.present"
+# Multislice grouping labels, checked in order.  A multislice job spans
+# several slices joined over DCN; GKE has no single canonical *node* label
+# for the grouping (it is a workload-level concept), so operators commonly
+# stamp their TPU node pools with one of these — and ``--multislice-label``
+# adds site-specific keys in front.
+MULTISLICE_GROUP_LABELS = (
+    "cloud.google.com/gke-multislice-group",
+    "multislice-group",
+)
 
 _INSTANCE_CHIPS_RE = re.compile(r"-(\d+)t$")
 
@@ -384,6 +393,101 @@ class SliceInfo:
             "complete": self.complete,
             "host_names": [h.name for h in self.hosts],
         }
+
+
+@dataclass
+class MultisliceInfo:
+    """Several slices joined over DCN into one logical multislice job.
+
+    Grouping comes from a node label (``MULTISLICE_GROUP_LABELS`` or an
+    operator-supplied key).  The roll-up is over slices *present* in the
+    cluster: the labels cannot express how many slices the job was meant to
+    have, so "complete" means every member slice is complete — an entirely
+    missing slice (its node pool scaled to zero) is invisible here and must
+    be caught with ``--expected-chips``.
+    """
+
+    group: str
+    slices: List[SliceInfo] = field(default_factory=list)
+    # True when some member slice's hosts disagree about (or lack) the
+    # grouping label — mid-rollout or after a node recreate; the roll-up is
+    # still produced (majority label) but flagged so the flapping-label state
+    # is visible instead of silently reshaping groups run to run.
+    partial_labeling: bool = False
+
+    @property
+    def hosts(self) -> int:
+        return sum(len(s.hosts) for s in self.slices)
+
+    @property
+    def chips(self) -> int:
+        return sum(s.chips for s in self.slices)
+
+    @property
+    def ready_chips(self) -> int:
+        return sum(s.ready_chips for s in self.slices)
+
+    @property
+    def expected_chips(self) -> Optional[int]:
+        per_slice = [s.expected_chips for s in self.slices]
+        if any(e is None for e in per_slice):
+            return None
+        return sum(per_slice)
+
+    @property
+    def complete(self) -> bool:
+        return bool(self.slices) and all(s.complete for s in self.slices)
+
+    def to_dict(self) -> dict:
+        return {
+            "group": self.group,
+            "slices": [s.slice_id for s in self.slices],
+            "num_slices": len(self.slices),
+            "hosts": self.hosts,
+            "chips": self.chips,
+            "ready_chips": self.ready_chips,
+            "expected_chips": self.expected_chips,
+            "complete": self.complete,
+            "partial_labeling": self.partial_labeling,
+        }
+
+
+def group_multislices(
+    slices: Sequence[SliceInfo], extra_label_keys: Sequence[str] = ()
+) -> List[MultisliceInfo]:
+    """Group slices into multislices by their hosts' grouping label.
+
+    ``extra_label_keys`` (from ``--multislice-label``) are checked before the
+    built-in conventions.  Slices without any grouping label stay out —
+    single-slice jobs need no roll-up.
+    """
+    keys = tuple(extra_label_keys) + MULTISLICE_GROUP_LABELS
+    by_group: Dict[str, MultisliceInfo] = {}
+    for s in slices:
+        if not s.hosts:
+            continue
+        # Read the label from ALL hosts, not host[0]: under partial labeling
+        # (mid-rollout, node recreate) API ordering would otherwise make a
+        # slice's membership flap run to run.  Majority wins, ties break
+        # lexically — deterministic for any host order.
+        group, consistent = None, True
+        for k in keys:
+            counts: Dict[str, int] = {}
+            for h in s.hosts:
+                v = h.labels.get(k)
+                if isinstance(v, str) and v:
+                    counts[v] = counts.get(v, 0) + 1
+            if counts:
+                group = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[0][0]
+                consistent = len(counts) == 1 and sum(counts.values()) == len(s.hosts)
+                break
+        if group is None:
+            continue
+        m = by_group.setdefault(group, MultisliceInfo(group=group))
+        m.slices.append(s)
+        if not consistent:
+            m.partial_labeling = True
+    return sorted(by_group.values(), key=lambda m: m.group)
 
 
 def group_slices(infos: Sequence[NodeInfo]) -> List[SliceInfo]:

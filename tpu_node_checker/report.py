@@ -85,6 +85,28 @@ def format_slice_table(slices: Sequence[SliceInfo]) -> str:
     )
 
 
+def format_multislice_table(multislices: Sequence) -> str:
+    """DCN-joined multislice roll-up — one row per labeled group."""
+    if not multislices:
+        return ""
+    rows = []
+    for m in multislices:
+        expected = m.expected_chips
+        chips = f"{m.ready_chips}/{expected if expected else m.chips}"
+        rows.append(
+            [
+                m.group,
+                str(len(m.slices)),
+                str(m.hosts),
+                chips,
+                "complete" if m.complete else "DEGRADED",
+            ]
+        )
+    return _render_columns(
+        ["MULTISLICE(GROUP)", "SLICES", "HOSTS", "CHIPS", "STATUS"], rows
+    )
+
+
 def summary_line(accel: Sequence[NodeInfo], ready: Sequence[NodeInfo]) -> str:
     """Emoji status line in the spirit of check-gpu-node.py:281-287."""
     total_chips = sum(n.accelerators for n in accel)
@@ -150,6 +172,7 @@ def format_slack_message(
     ready: Sequence[NodeInfo],
     slices: Sequence[SliceInfo] = (),
     healthy: Optional[bool] = None,
+    multislices: Sequence = (),
 ) -> str:
     """Slack mrkdwn message.
 
@@ -202,5 +225,12 @@ def format_slack_message(
             f"• slice `{s.nodepool or s.accelerator or '?'}` "
             f"[{s.accelerator or '?'} {s.topology or '?'}]: "
             f"{s.ready_chips}/{expected} chips, {state}"
+        )
+    for m in multislices:
+        expected = m.expected_chips or m.chips
+        state = "complete" if m.complete else "DEGRADED"
+        lines.append(
+            f"• multislice `{m.group}`: {len(m.slices)} slice(s), "
+            f"{m.ready_chips}/{expected} chips, {state}"
         )
     return "\n".join(lines)
