@@ -25,6 +25,7 @@ def make_node(
     labels: Optional[dict] = None,
     taints: Optional[list] = None,
     conditions: Optional[list] = None,
+    unschedulable: bool = False,
 ) -> dict:
     """One raw node dict, shaped like a k8s REST ``V1Node`` serialization."""
     alloc = {"cpu": "8", "memory": "32Gi", "pods": "110"}
@@ -43,6 +44,8 @@ def make_node(
     }
     if taints:
         node["spec"]["taints"] = taints
+    if unschedulable:
+        node["spec"]["unschedulable"] = True
     return node
 
 

@@ -1,0 +1,368 @@
+"""--cordon-failed auto-quarantine tests.
+
+Node list comes from a fixture file (--nodes-json); the cordon PATCH goes to
+a local fake API server via --kubeconfig, so both network surfaces of the
+feature are exercised for real: request path, strategic-merge body, and
+content type — plus every safety rail (cap, dry-run, probe-verdict-only,
+already-cordoned, missing-report, PATCH failure is not fatal).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+
+
+@pytest.fixture
+def fake_api(tmp_path):
+    """Fake API server recording PATCHes + a kubeconfig pointing at it."""
+    patches = []
+    fail_with = {"status": None}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_PATCH(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            patches.append(
+                {
+                    "path": self.path,
+                    "content_type": self.headers.get("Content-Type"),
+                    "body": json.loads(body),
+                }
+            )
+            status = fail_with["status"] or 200
+            payload = b"{}"
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        f"""
+apiVersion: v1
+kind: Config
+current-context: t
+contexts: [{{name: t, context: {{cluster: t, user: t}}}}]
+clusters: [{{name: t, cluster: {{server: "http://127.0.0.1:{server.server_address[1]}"}}}}]
+users: [{{name: t, user: {{token: tok}}}}]
+"""
+    )
+    yield {"patches": patches, "kubeconfig": str(kubeconfig), "fail_with": fail_with}
+    server.shutdown()
+
+
+def _nodes_json(tmp_path, nodes):
+    p = tmp_path / "nodes.json"
+    p.write_text(json.dumps(fx.node_list(nodes)))
+    return str(p)
+
+
+def _probe_reports(tmp_path, verdicts):
+    """Write per-host probe reports; verdicts = {hostname: ok_bool}."""
+    d = tmp_path / "probes"
+    d.mkdir()
+    for host, ok in verdicts.items():
+        (d / f"{host}.json").write_text(
+            json.dumps(
+                {
+                    "ok": ok,
+                    "level": "compute",
+                    "hostname": host,
+                    "written_at": time.time(),
+                    "error": None if ok else "matmul numerics failed",
+                }
+            )
+        )
+    return str(d)
+
+
+def _tpu_nodes(n=3, **kw):
+    return [
+        fx.make_node(
+            f"tpu-{i}",
+            allocatable={"google.com/tpu": "4"},
+            labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-nodepool": "p",
+            },
+            **kw,
+        )
+        for i in range(n)
+    ]
+
+
+class TestCordonFailed:
+    def test_probe_failed_node_is_cordoned(self, tmp_path, fake_api, capsys):
+        nodes = _tpu_nodes(3)
+        reports = _probe_reports(
+            tmp_path, {"tpu-0": True, "tpu-1": False, "tpu-2": True}
+        )
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        code = checker.one_shot(args)
+        assert code == 0  # two healthy Ready nodes remain
+        assert len(fake_api["patches"]) == 1
+        patch = fake_api["patches"][0]
+        assert patch["path"] == "/api/v1/nodes/tpu-1"
+        assert patch["body"] == {"spec": {"unschedulable": True}}
+        assert patch["content_type"] == "application/strategic-merge-patch+json"
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cordon"]["cordoned"] == ["tpu-1"]
+        assert payload["cordon"]["dry_run"] is False
+
+    def test_cap_limits_cordons_and_reports_rest(self, tmp_path, fake_api, capsys):
+        nodes = _tpu_nodes(3)
+        reports = _probe_reports(
+            tmp_path, {"tpu-0": False, "tpu-1": False, "tpu-2": False}
+        )
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        assert len(fake_api["patches"]) == 1  # default --cordon-max 1
+        payload = json.loads(capsys.readouterr().out)
+        assert len(payload["cordon"]["cordoned"]) == 1
+        assert len(payload["cordon"]["skipped_over_cap"]) == 2
+
+    def test_raised_cap(self, tmp_path, fake_api, capsys):
+        nodes = _tpu_nodes(3)
+        reports = _probe_reports(
+            tmp_path, {"tpu-0": False, "tpu-1": False, "tpu-2": True}
+        )
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed", "--cordon-max", "5",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        assert len(fake_api["patches"]) == 2
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cordon"]["skipped_over_cap"] == []
+
+    def test_dry_run_patches_nothing(self, tmp_path, fake_api, capsys):
+        nodes = _tpu_nodes(2)
+        reports = _probe_reports(tmp_path, {"tpu-0": False, "tpu-1": True})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed", "--cordon-dry-run",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        assert fake_api["patches"] == []
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cordon"] == {
+            "dry_run": True,
+            "cordoned": ["tpu-0"],
+            "failed": [],
+            "already_cordoned": 0,
+            "skipped_over_cap": [],
+        }
+
+    def test_already_cordoned_and_notready_nodes_skipped(
+        self, tmp_path, fake_api, capsys
+    ):
+        nodes = [
+            fx.make_node(
+                "tpu-cordoned",
+                unschedulable=True,
+                allocatable={"google.com/tpu": "4"},
+                labels={"cloud.google.com/gke-tpu-accelerator": "x"},
+            ),
+            fx.make_node(
+                "tpu-notready",
+                ready=False,
+                allocatable={"google.com/tpu": "4"},
+                labels={"cloud.google.com/gke-tpu-accelerator": "x"},
+            ),
+        ]
+        reports = _probe_reports(
+            tmp_path, {"tpu-cordoned": False, "tpu-notready": False}
+        )
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        assert fake_api["patches"] == []
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cordon"]["cordoned"] == []
+        # cordoned state is surfaced per node
+        by_name = {n["name"]: n for n in payload["nodes"]}
+        assert by_name["tpu-cordoned"]["cordoned"] is True
+
+    def test_missing_report_is_not_cordoned(self, tmp_path, fake_api, capsys):
+        # --probe-results-required synthesizes level="missing" failures for
+        # unreported hosts; an absent report is NOT evidence of dead chips.
+        nodes = _tpu_nodes(2)
+        reports = _probe_reports(tmp_path, {"tpu-0": True})  # tpu-1 never reported
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports, "--probe-results-required",
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        code = checker.one_shot(args)
+        assert fake_api["patches"] == []
+        assert code == 0  # tpu-0 healthy
+
+    def test_cordon_max_is_a_state_budget_not_a_rate(self, tmp_path, fake_api, capsys):
+        # One node is ALREADY cordoned: with --cordon-max 1 the budget is
+        # spent, so a new probe-failed node is NOT cordoned.  This is what
+        # keeps a persistent regression under --watch from draining the pool
+        # one node per round.
+        nodes = [
+            fx.make_node(
+                "tpu-quarantined",
+                unschedulable=True,
+                allocatable={"google.com/tpu": "4"},
+                labels={"cloud.google.com/gke-tpu-accelerator": "x"},
+            ),
+            *_tpu_nodes(2),
+        ]
+        reports = _probe_reports(tmp_path, {"tpu-0": False, "tpu-1": True})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        assert fake_api["patches"] == []
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cordon"]["already_cordoned"] == 1
+        assert payload["cordon"]["skipped_over_cap"] == ["tpu-0"]
+
+    def test_payload_nodes_reflect_post_cordon_state(self, tmp_path, fake_api, capsys):
+        # The per-node entries must agree with the cordon report in the SAME
+        # payload: the cordon phase runs before render.
+        nodes = _tpu_nodes(2)
+        reports = _probe_reports(tmp_path, {"tpu-0": False, "tpu-1": True})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cordon"]["cordoned"] == ["tpu-0"]
+        by_name = {n["name"]: n for n in payload["nodes"]}
+        assert by_name["tpu-0"]["cordoned"] is True
+
+    def test_patch_failure_is_reported_not_fatal(self, tmp_path, fake_api, capsys):
+        fake_api["fail_with"]["status"] = 500
+        nodes = _tpu_nodes(2)
+        reports = _probe_reports(tmp_path, {"tpu-0": False, "tpu-1": True})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        code = checker.one_shot(args)
+        assert code == 0  # the check's verdict stands
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cordon"]["cordoned"] == []
+        assert payload["cordon"]["failed"][0]["node"] == "tpu-0"
+
+
+class TestCordonCli:
+    def test_requires_probe_source(self, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--cordon-failed"])
+        assert "requires --probe or --probe-results" in capsys.readouterr().err
+
+    def test_dead_plugin_node_does_not_consume_budget(
+        self, tmp_path, fake_api, capsys
+    ):
+        # A dead-device-plugin node (Ready, capacity shows chips, allocatable
+        # zero) is already unschedulable for device pods; it must not claim
+        # the cordon budget ahead of a genuinely dangerous node that still
+        # advertises chips.
+        nodes = [
+            fx.make_node(
+                "tpu-deadplugin",
+                allocatable={"google.com/tpu": "0"},
+                capacity={"cpu": "8", "google.com/tpu": "4"},
+                labels={"cloud.google.com/gke-tpu-accelerator": "x"},
+            ),
+            *_tpu_nodes(1),
+        ]
+        reports = _probe_reports(tmp_path, {"tpu-deadplugin": False, "tpu-0": False})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        assert [p["path"] for p in fake_api["patches"]] == ["/api/v1/nodes/tpu-0"]
+
+    @pytest.mark.parametrize(
+        "argv, fragment",
+        [
+            (["--cordon-max", "1"], "requires --cordon-failed"),
+            (["--cordon-max", "2"], "requires --cordon-failed"),
+            (["--cordon-dry-run"], "requires --cordon-failed"),
+            (["--probe", "--cordon-failed", "--cordon-max", "0"], "at least 1"),
+            (
+                ["--emit-probe", "x.json", "--probe-results", "d", "--cordon-failed"],
+                "cannot be combined with --emit-probe",
+            ),
+        ],
+    )
+    def test_flag_validation(self, argv, fragment, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(argv)
+        assert fragment in capsys.readouterr().err

@@ -364,7 +364,7 @@ class TestWatch:
             item = scripted.pop(0)
             if isinstance(item, Exception):
                 raise item
-            return item
+            return item, None
 
         monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
         monkeypatch.setattr(
@@ -391,7 +391,7 @@ class TestWatch:
         def fake_fetch(args, timer):
             if not node_sets:
                 raise KeyboardInterrupt
-            return node_sets.pop(0)
+            return node_sets.pop(0), None
 
         monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
         monkeypatch.setattr(
@@ -451,7 +451,7 @@ class TestWatch:
         def fake_fetch(args, timer):
             if not node_sets:
                 raise KeyboardInterrupt
-            return node_sets.pop(0)
+            return node_sets.pop(0), None
 
         def fake_send(url, message, **kw):
             sent.append(message.splitlines()[0])

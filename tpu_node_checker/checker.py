@@ -67,15 +67,19 @@ def _registry_from_args(args) -> ResourceRegistry:
     return reg
 
 
-def _fetch_nodes(args, timer: PhaseTimer) -> List[dict]:
-    """Node source: ``--nodes-json`` fixture file, or one live LIST call."""
+def _fetch_nodes(args, timer: PhaseTimer):
+    """Node source: ``--nodes-json`` fixture file, or one live LIST call.
+
+    Returns ``(nodes, client)``; ``client`` is ``None`` in offline mode and
+    otherwise reused by ``--cordon-failed`` instead of re-resolving config.
+    """
     nodes_json = getattr(args, "nodes_json", None)
     if nodes_json:
         with timer.phase("list"):
             with open(nodes_json) as f:
                 doc = json.load(f)
             # "items": null happens in Go-serialized NodeLists; treat as empty.
-            return (doc.get("items") or []) if isinstance(doc, dict) else doc
+            return ((doc.get("items") or []) if isinstance(doc, dict) else doc), None
     from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
 
     with timer.phase("config"):
@@ -83,9 +87,10 @@ def _fetch_nodes(args, timer: PhaseTimer) -> List[dict]:
             getattr(args, "kubeconfig", None), getattr(args, "context", None)
         )
     with timer.phase("list"):
-        return KubeClient(cfg).list_nodes(
+        client = KubeClient(cfg)
+        return client.list_nodes(
             label_selector=getattr(args, "label_selector", None)
-        )
+        ), client
 
 
 def _run_probe(
@@ -199,12 +204,101 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
                 }
 
 
+def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None) -> dict:
+    """``--cordon-failed``: mark probe-failed nodes unschedulable.
+
+    Auto-quarantine for the one failure mode only this tool can see — a
+    kubelet-Ready node whose *chips* are dead (probe verdict) — so the
+    scheduler stops placing TPU jobs on it while a human investigates.
+    Safety rails:
+
+    * only kubelet-Ready, not-already-cordoned nodes with an explicit failed
+      probe verdict qualify (NotReady nodes are already the control plane's
+      problem; dead-device-plugin nodes are already unschedulable for
+      device-requesting pods; an absent report is not evidence);
+    * ``--cordon-max`` is a **budget on total cordoned state**, not a
+      per-run rate: nodes already cordoned (by this tool or anyone) count
+      against it, so a persistent fleet-wide regression under ``--watch``
+      converges at N cordoned nodes instead of draining one more node per
+      round until the pool is gone;
+    * ``--cordon-dry-run`` reports the decisions without patching;
+    * a PATCH failure is reported, never fatal — the check's own verdict
+      stands regardless.
+
+    Returns the report dict for the payload.  ``client`` reuses the LIST
+    call's :class:`~tpu_node_checker.cluster.KubeClient`; offline runs
+    (``--nodes-json``) resolve one on demand.
+    """
+    candidates = [
+        n
+        for n in accel
+        if n.ready
+        and n.schedulable  # dead-plugin nodes must not consume the budget
+        and not n.cordoned
+        and n.probe is not None
+        and not n.probe.get("ok")
+        and n.probe.get("level") != "missing"  # absent report ≠ dead chips
+    ]
+    cap = getattr(args, "cordon_max", 1)
+    already = sum(1 for n in accel if n.cordoned)
+    budget = max(0, cap - already)
+    to_cordon, capped = candidates[:budget], candidates[budget:]
+    report_entry: dict = {
+        "dry_run": bool(getattr(args, "cordon_dry_run", False)),
+        "cordoned": [],
+        "failed": [],
+        "already_cordoned": already,
+        "skipped_over_cap": sorted(n.name for n in capped),
+    }
+    if capped:
+        print(
+            f"--cordon-failed: {len(capped)} candidate(s) beyond the "
+            f"--cordon-max={cap} budget ({already} already cordoned) left "
+            f"alone: {', '.join(report_entry['skipped_over_cap'])}",
+            file=sys.stderr,
+        )
+    if not to_cordon:
+        return report_entry
+    if report_entry["dry_run"]:
+        report_entry["cordoned"] = sorted(n.name for n in to_cordon)
+        for n in to_cordon:
+            print(f"[dry-run] would cordon {n.name} (chip probe failed)", file=sys.stderr)
+        return report_entry
+    if client is None:
+        from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
+
+        try:
+            client = KubeClient(
+                resolve_cluster_config(
+                    getattr(args, "kubeconfig", None), getattr(args, "context", None)
+                )
+            )
+        except Exception as exc:  # noqa: BLE001 — quarantine is best-effort
+            report_entry["failed"] = [
+                {"node": n.name, "error": f"no cluster client: {exc}"} for n in to_cordon
+            ]
+            print(f"--cordon-failed: cannot reach cluster: {exc}", file=sys.stderr)
+            return report_entry
+    for n in to_cordon:
+        try:
+            client.cordon_node(n.name)
+        except Exception as exc:  # noqa: BLE001
+            report_entry["failed"].append({"node": n.name, "error": str(exc)})
+            print(f"Cordon of {n.name} failed: {exc}", file=sys.stderr)
+        else:
+            n.cordoned = True
+            report_entry["cordoned"].append(n.name)
+            print(f"Cordoned {n.name} (chip probe failed).", file=sys.stderr)
+    return report_entry
+
+
 def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     """Pure-ish core of the run: everything except printing and Slack I/O
     gating decisions is computed here so tests can drive it directly."""
     timer = PhaseTimer()
+    kube_client = None
     if nodes is None:
-        nodes = _fetch_nodes(args, timer)
+        nodes, kube_client = _fetch_nodes(args, timer)
     result = CheckResult(exit_code=EXIT_OK)
     with timer.phase("detect"):
         accel, ready = select_accelerator_nodes(nodes, _registry_from_args(args))
@@ -245,6 +339,12 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
         result.exit_code = EXIT_NONE_READY
     else:
         result.exit_code = EXIT_OK
+
+    cordon_report = None
+    if getattr(args, "cordon_failed", False):
+        # Before render, so payload["nodes"] reflects post-cordon state.
+        with timer.phase("cordon"):
+            cordon_report = _cordon_failed_nodes(args, accel, client=kube_client)
 
     with timer.phase("render"):
         payload = report.build_json_payload(
@@ -293,6 +393,8 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
                 payload["expected_chips_key"] = expected_key
             payload["expected_chips_have"] = have_chips
             payload["expected_chips_met"] = have_chips >= expected_n
+        if cordon_report is not None:
+            payload["cordon"] = cordon_report
         payload["exit_code"] = result.exit_code
     payload["timings_ms"] = timer.as_dict()
     result.payload = payload

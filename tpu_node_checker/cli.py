@@ -151,6 +151,20 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        help="with --probe-results: grade any TPU node WITHOUT a fresh "
                        "report as probe-failed (full DaemonSet coverage expected)")
 
+    cordon = p.add_argument_group("Auto-quarantine (data-plane failures)")
+    cordon.add_argument("--cordon-failed", action="store_true",
+                        help="mark kubelet-Ready nodes whose chip probe FAILED as "
+                        "unschedulable (kubectl-cordon PATCH; needs the 'patch' "
+                        "verb on nodes — see deploy/rbac.yaml)")
+    cordon.add_argument("--cordon-max", type=int, default=None, metavar="N",
+                        help="budget on TOTAL cordoned accelerator nodes (default "
+                        "1): nodes already cordoned — by this tool or anyone — "
+                        "count against it, so a fleet-wide regression under "
+                        "--watch converges at N instead of draining the pool; "
+                        "raise deliberately for mass-repair workflows")
+    cordon.add_argument("--cordon-dry-run", action="store_true",
+                        help="report cordon decisions without patching anything")
+
     # Same group/flags/defaults as the reference (check-gpu-node.py:304-309).
     slack = p.add_argument_group("Slack")
     slack.add_argument("--slack-webhook", help="Slack incoming-webhook URL (or $SLACK_WEBHOOK_URL)")
@@ -173,6 +187,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
         p.error("--probe-results-required requires --probe-results DIR")
+    if args.cordon_failed and not (args.probe or args.probe_results):
+        # Cordoning keys off a data-plane verdict; without a probe source
+        # the flag could never act and the operator would assume coverage.
+        p.error("--cordon-failed requires --probe or --probe-results DIR")
+    if args.cordon_failed and args.emit_probe:
+        # emit-probe mode never runs the check, so the flag would silently
+        # do nothing (same rule as --probe-soak / --probe-distributed).
+        p.error("--cordon-failed cannot be combined with --emit-probe")
+    if args.cordon_max is not None and args.cordon_max < 1:
+        p.error("--cordon-max must be at least 1")
+    for flag, val in (
+        ("--cordon-max", args.cordon_max is not None),
+        ("--cordon-dry-run", args.cordon_dry_run),
+    ):
+        if val and not args.cordon_failed:
+            p.error(f"{flag} requires --cordon-failed")
+    if args.cordon_max is None:
+        args.cordon_max = 1
     if args.probe_distributed and not (args.probe or args.emit_probe):
         # Same rule as --probe-soak: a probe modifier that silently does
         # nothing would let an operator believe a distributed probe ran.

@@ -221,10 +221,12 @@ def resolve_cluster_config(
 
 
 class KubeClient:
-    """Just enough Kubernetes API for this tool: one LIST.
+    """Just enough Kubernetes API for this tool: one LIST, plus an opt-in
+    PATCH for ``--cordon-failed``.
 
     RBAC footprint is identical to the reference's (ClusterRole with
-    ``nodes: get,list`` — README.md:144-159 of the reference).
+    ``nodes: get,list`` — README.md:144-159 of the reference) unless
+    cordoning is enabled, which additionally needs the ``patch`` verb.
     """
 
     def __init__(self, config: ClusterConfig, session: Optional["requests.Session"] = None):
@@ -256,3 +258,17 @@ class KubeClient:
         )
         resp.raise_for_status()
         return resp.json().get("items") or []
+
+    def cordon_node(self, name: str, timeout: float = DEFAULT_TIMEOUT_S) -> None:
+        """``PATCH /api/v1/nodes/{name}`` → ``spec.unschedulable=true``.
+
+        The same strategic-merge patch ``kubectl cordon`` sends.  Requires
+        the ``patch`` verb on nodes (see deploy/rbac.yaml).
+        """
+        resp = self._session.patch(
+            f"{self.config.server}/api/v1/nodes/{name}",
+            data=json.dumps({"spec": {"unschedulable": True}}),
+            headers={"Content-Type": "application/strategic-merge-patch+json"},
+            timeout=timeout,
+        )
+        resp.raise_for_status()

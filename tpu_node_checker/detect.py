@@ -144,6 +144,11 @@ class NodeInfo:
     # False when capacity shows devices but allocatable advertises none
     # (dead device plugin): the node is visible but must not count as Ready.
     schedulable: bool = True
+    # spec.unschedulable — the node is cordoned (kubectl cordon or
+    # --cordon-failed).  Kept OUT of readiness (parity: the reference counts
+    # cordoned nodes as Ready); used to avoid re-cordoning and surfaced in
+    # the payload.
+    cordoned: bool = False
     # TPU-only fields (None on GPU/CPU nodes):
     tpu_accelerator: Optional[str] = None  # e.g. "tpu-v5-lite-podslice"
     tpu_topology: Optional[str] = None  # e.g. "16x16"
@@ -174,6 +179,7 @@ class NodeInfo:
             "name": self.name,
             "ready": self.ready,
             "schedulable": self.schedulable,
+            "cordoned": self.cordoned,
             "accelerators": self.accelerators,
             "breakdown": dict(self.breakdown),
             "families": list(self.families),
@@ -220,9 +226,10 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         ):
             families = ("gpu",)
             schedulable = False
+    spec = _as_dict(node.get("spec"))
     taints = [
         {"key": t.get("key"), "value": t.get("value"), "effect": t.get("effect")}
-        for t in map(_as_dict, _as_list(_as_dict(node.get("spec")).get("taints")))
+        for t in map(_as_dict, _as_list(spec.get("taints")))
     ]
     name = metadata.get("name")
 
@@ -241,6 +248,7 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         labels=dict(labels),
         taints=taints,
         schedulable=schedulable,
+        cordoned=bool(spec.get("unschedulable")),
         tpu_accelerator=_label(LABEL_TPU_ACCELERATOR),
         tpu_topology=_label(LABEL_TPU_TOPOLOGY),
         nodepool=_label(LABEL_NODEPOOL),
