@@ -57,6 +57,31 @@ class TestRenderMetrics:
         text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
         assert "tpu_node_checker_probe_ok" not in text
 
+    def test_multislice_families(self):
+        text = render_metrics(
+            self._result(fx.tpu_multislice(n_slices=2, not_ready=1))
+        )
+        assert 'tpu_node_checker_multislice_complete{group="ms-train-1"} 0.0' in text
+        assert 'tpu_node_checker_multislice_ready_chips{group="ms-train-1"} 28' in text
+        assert 'tpu_node_checker_multislice_slices{group="ms-train-1"} 2' in text
+
+    def test_no_multislice_no_families(self):
+        text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
+        assert "tpu_node_checker_multislice" not in text
+
+    def test_cordon_families(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["cordon"] = {
+            "dry_run": False,
+            "cordoned": ["a"],
+            "failed": [],
+            "already_cordoned": 0,
+            "skipped_over_cap": ["b", "c"],
+        }
+        text = render_metrics(result)
+        assert "tpu_node_checker_cordoned_nodes 1" in text
+        assert "tpu_node_checker_cordon_skipped_over_cap 2" in text
+
     def test_single_host_slice_pool_unique_series(self):
         # N single-host slices in one pool share nodepool+topology; the
         # "slice" label must keep every series unique or Prometheus drops

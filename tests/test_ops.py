@@ -106,6 +106,31 @@ class TestDmaProbe:
             assert "invalid shape" in r.error
 
 
+class TestInt8Probe:
+    def test_exact_integer_match(self):
+        from tpu_node_checker.ops import int8_matmul_probe
+
+        r = int8_matmul_probe(m=128, k=128, n=128)
+        assert r.ok, r.error
+        assert r.tops >= 0
+        assert r.elapsed_ms > 0
+
+    def test_invalid_dims_rejected(self):
+        from tpu_node_checker.ops import int8_matmul_probe
+
+        for kwargs in ({"m": 0}, {"k": -1}, {"n": 0}):
+            r = int8_matmul_probe(**{"m": 128, "k": 128, "n": 128, **kwargs})
+            assert not r.ok
+            assert "invalid shape" in r.error
+
+    def test_accumulator_cannot_wrap(self):
+        # Inputs are [-8, 7], so max |product| = 64 (−8·−8) and the chained
+        # accumulator is bounded by iters·k·64 — pin the default-shape bound
+        # the docstring claims, with real margin visible.
+        k, iters = 512, 8
+        assert iters * k * 64 == 262_144 < 2**31
+
+
 class TestHbmProbe:
     def test_bandwidth_positive(self):
         r = hbm_bandwidth_probe(mib=8, iters=2)

@@ -71,6 +71,7 @@ class TestComputeLevels:
         assert r.ok, r.error
         assert r.details.get("matmul_ok") is True
         assert r.details.get("matmul_tflops", 0) > 0
+        assert r.details.get("int8_ok") is True
         assert r.details.get("hbm_gbps", 0) > 0
         assert r.details.get("flash_attention_ok") is True
 

@@ -109,3 +109,25 @@ class TestSlackMessage:
         msg = report.format_slack_message(accel, ready, slices)
         assert msg.count("• `gke-gpu-pool-") == 3
         assert "omitted" not in msg
+
+    def test_many_slices_list_only_degraded(self):
+        # A pool of many single-host slices: only the degraded ones get
+        # bullets, same scaling policy as the node list.
+        nodes = [
+            fx.make_node(
+                f"tpu-solo-{i:02d}",
+                ready=i != 3,
+                allocatable={"google.com/tpu": "4"},
+                labels={
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-device",
+                    "cloud.google.com/gke-tpu-topology": "2x2",
+                    "cloud.google.com/gke-nodepool": "solo",
+                },
+            )
+            for i in range(16)
+        ]
+        accel, ready, slices = _analyzed(nodes)
+        assert len(slices) == 16  # one slice per host (topology fits on one)
+        msg = report.format_slack_message(accel, ready, slices)
+        assert msg.count("• slice ") == 1  # only the degraded one
+        assert "… 15 complete slices omitted" in msg

@@ -89,6 +89,43 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
         "Chips the slice topology label promises.",
         [(slice_labels(s), s.get("expected_chips") or 0) for s in slices],
     )
+    multislices = payload.get("multislices") or []
+    if multislices:
+        ms_labels = lambda m: {"group": m.get("group") or ""}  # noqa: E731
+        family(
+            "tpu_node_checker_multislice_complete",
+            "gauge",
+            "1 when every member slice of the DCN-joined group is complete.",
+            [(ms_labels(m), 1.0 if m.get("complete") else 0.0) for m in multislices],
+        )
+        family(
+            "tpu_node_checker_multislice_ready_chips",
+            "gauge",
+            "Effectively-Ready chips across the multislice group.",
+            [(ms_labels(m), m.get("ready_chips", 0)) for m in multislices],
+        )
+        family(
+            "tpu_node_checker_multislice_slices",
+            "gauge",
+            "Member slices present in the cluster for the group.",
+            [(ms_labels(m), m.get("num_slices", 0)) for m in multislices],
+        )
+    cordon = payload.get("cordon")
+    if cordon is not None:
+        family(
+            "tpu_node_checker_cordoned_nodes",
+            "gauge",
+            "Nodes cordoned by --cordon-failed this round (dry-run rounds "
+            "report what would have been cordoned).",
+            [({}, len(cordon.get("cordoned", [])))],
+        )
+        family(
+            "tpu_node_checker_cordon_skipped_over_cap",
+            "gauge",
+            "Probe-failed candidates left alone by the --cordon-max budget — "
+            "nonzero means humans must look NOW.",
+            [({}, len(cordon.get("skipped_over_cap", [])))],
+        )
     probe = payload.get("local_probe")
     if probe:
         family(
@@ -101,6 +138,7 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             # (payload key, metric suffix, help)
             ("device_count", "probe_devices", "Chips the probe enumerated."),
             ("matmul_tflops", "probe_matmul_tflops", "MXU burn throughput."),
+            ("int8_tops", "probe_int8_tops", "Int8 MXU matmul throughput."),
             ("hbm_gbps", "probe_hbm_gbps", "HBM streaming bandwidth sample."),
             ("dma_gbps", "probe_dma_gbps", "DMA-engine stream bandwidth."),
             ("collective_busbw_gbps", "probe_collective_busbw_gbps",

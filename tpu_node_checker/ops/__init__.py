@@ -24,6 +24,7 @@ from tpu_node_checker.ops.flash_attention import (
     flash_attention_probe,
 )
 from tpu_node_checker.ops.hbm import HbmResult, hbm_bandwidth_probe
+from tpu_node_checker.ops.int8_probe import Int8Result, int8_matmul_probe
 from tpu_node_checker.ops.pallas_probe import PallasProbeResult, pallas_matmul_probe
 
 __all__ = [
@@ -38,6 +39,8 @@ __all__ = [
     "flash_attention_probe",
     "HbmResult",
     "hbm_bandwidth_probe",
+    "Int8Result",
+    "int8_matmul_probe",
     "PallasProbeResult",
     "pallas_matmul_probe",
 ]

@@ -1,0 +1,117 @@
+"""Int8 MXU probe — the quantized systolic-array mode.
+
+The bf16 burn (:mod:`tpu_node_checker.ops.burn`) exercises the MXU's float
+path; quantized serving runs the **int8** mode, a physically distinct
+configuration of the same array (double-rate multipliers, i32 accumulators).
+A chip can pass every bf16 check and still corrupt int8 inference, so node
+acceptance needs both.
+
+Verification is **exact**: int8 × int8 → int32 via
+``preferred_element_type=jnp.int32`` is integer arithmetic with a closed-form
+host answer and zero tolerance — with inputs in [-8, 7] the worst-case
+per-term product is 64 (from −8·−8), so the chained accumulator is bounded by
+``iters·k·64`` (defaults → 262 144), far inside i32; any deviation whatsoever
+is a hardware or lowering fault, never rounding.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class Int8Result:
+    ok: bool
+    tops: float  # tera-ops/s of the timed int8 matmul (2mk n ops)
+    elapsed_ms: float
+    error: Optional[str] = None
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _int8_chain(a: jax.Array, b: jax.Array, iters: int) -> jax.Array:
+    """Accumulate ``iters`` int8 matmuls in ONE compiled program.
+
+    Per-dispatch overhead (tens of ms through remote transports — see
+    ops.hbm) would otherwise dominate the timing; the row-roll makes each
+    iteration a genuinely different matmul so the loop cannot be hoisted,
+    while staying exactly verifiable on the host (``roll(a, i) @ b ==
+    roll(a @ b, i)`` — one reference matmul, rolled and summed).
+    """
+
+    def body(i, acc):
+        prod = jax.lax.dot_general(
+            jnp.roll(a, i, axis=0), b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return acc + prod
+
+    m, n = a.shape[0], b.shape[1]
+    return jax.lax.fori_loop(0, iters, body, jnp.zeros((m, n), jnp.int32))
+
+
+def int8_matmul_probe(
+    m: int = 512,
+    k: int = 512,
+    n: int = 512,
+    iters: int = 8,
+    device: Optional[jax.Device] = None,
+) -> Int8Result:
+    """Run a chain of int8 matmuls on the chip; verify EXACT equality vs numpy."""
+    try:
+        if min(m, k, n, iters) <= 0:
+            return Int8Result(
+                ok=False, tops=0.0, elapsed_ms=0.0,
+                error=f"invalid shape ({m},{k},{n})x{iters}: dims must be positive",
+            )
+        device = device or jax.local_devices()[0]
+        rng = np.random.default_rng(0)
+        a_host = rng.integers(-8, 8, size=(m, k), dtype=np.int8)
+        b_host = rng.integers(-8, 8, size=(k, n), dtype=np.int8)
+        a = jax.device_put(jnp.asarray(a_host), device)
+        b = jax.device_put(jnp.asarray(b_host), device)
+
+        out = _int8_chain(a, b, iters)
+        int(out[0, 0])  # warmup completion barrier
+        t0 = time.perf_counter()
+        out = _int8_chain(a, b, iters)
+        # Scalar fetch as the in-window completion barrier (ops.burn
+        # rationale: block_until_ready can return early through remote
+        # transports).  The full m×n verification fetch happens AFTER the
+        # clock stops — inside the window it would time the transport, not
+        # the MXU.
+        int(out[0, 0])
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        out_host = np.asarray(out)
+
+        # roll(a, i) @ b == roll(a @ b, i): one matmul, iters cheap rolls.
+        # Accumulator bound: iters · k · 64 ≪ 2^31, so no wrap anywhere.
+        base = a_host.astype(np.int32) @ b_host.astype(np.int32)
+        ref = np.zeros_like(base)
+        for i in range(iters):
+            ref += np.roll(base, i, axis=0)
+        if not np.array_equal(out_host, ref):
+            bad = int(np.count_nonzero(out_host != ref))
+            return Int8Result(
+                ok=False, tops=0.0, elapsed_ms=elapsed_ms,
+                error=(
+                    f"int8 matmul WRONG in {bad}/{out_host.size} elements — "
+                    "integer arithmetic admits no rounding excuse"
+                ),
+            )
+        tops = (
+            (2.0 * m * k * n * iters) / (elapsed_ms * 1e-3) / 1e12
+            if elapsed_ms > 0
+            else 0.0
+        )
+        return Int8Result(ok=True, tops=tops, elapsed_ms=elapsed_ms)
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return Int8Result(
+            ok=False, tops=0.0, elapsed_ms=0.0, error=f"{type(exc).__name__}: {exc}"
+        )

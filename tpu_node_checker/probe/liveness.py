@@ -11,8 +11,9 @@ import error — degrades to a structured failure, never an exception.
 Probe levels (each includes the previous):
 
 * ``enumerate``  — backend init + device enumeration (platform, chip count);
-* ``compute``    — MXU matmul burn, HBM bandwidth sample, and Pallas/Mosaic
-                   kernel cross-checks (tiled matmul + flash attention) on one
+* ``compute``    — MXU matmul burn (bf16) + exact-integer int8 MXU check,
+                   HBM bandwidth sample, and Pallas/Mosaic kernel
+                   cross-checks (tiled matmul + flash attention) on one
                    chip (:mod:`tpu_node_checker.ops`);
 * ``collective`` — psum/all_gather/reduce-scatter and a ppermute ring walk
                    over all local chips (:mod:`tpu_node_checker.parallel`),
@@ -137,6 +138,14 @@ try:
         out["hbm_ok"] = hbm.ok
         pallas = pallas_matmul_probe()
         out["pallas_ok"] = pallas.ok
+        from tpu_node_checker.ops import int8_matmul_probe
+        # Quantized serving path: the MXU's int8 mode is a distinct engine
+        # configuration from the bf16 burn; verification is exact-integer.
+        i8 = int8_matmul_probe()
+        out["int8_ok"] = i8.ok
+        out["int8_tops"] = round(i8.tops, 3)
+        if not i8.ok:
+            out["int8_err"] = i8.error
         fa_gate = True
         if os.environ.get("TNC_SKIP_FLASH_ATTENTION") == "1":
             # Operator escape hatch (cf. TNC_SOAK_*): the flash-attention
@@ -158,7 +167,10 @@ try:
         dma = dma_stream_probe()
         out["dma_ok"] = dma.ok
         out["dma_gbps"] = round(dma.gbps, 2)
-        out["ok"] = out["ok"] and burn.ok and hbm.ok and pallas.ok and fa_gate and dma.ok
+        out["ok"] = (
+            out["ok"] and burn.ok and hbm.ok and pallas.ok and i8.ok
+            and fa_gate and dma.ok
+        )
         soak_s = float(os.environ.get("TNC_SOAK_S") or 0)
         if soak_s > 0 and out["ok"]:
             # Node-acceptance soak: sustained MXU load for the requested

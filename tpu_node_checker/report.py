@@ -218,7 +218,16 @@ def format_slack_message(
         lines.append(f"• … {omitted_problems} more problem nodes omitted")
     if omitted_healthy:
         lines.append(f"• … {omitted_healthy} healthy nodes omitted")
-    for s in slices:
+    # Same scaling policy as the node bullets: a pool of many single-host
+    # slices must not bury the signal or overflow Slack's limits.
+    listed_slices = list(slices)
+    omitted_ok_slices = omitted_bad_slices = 0
+    if len(listed_slices) > 12:
+        bad = [s for s in listed_slices if not s.complete]
+        omitted_ok_slices = len(listed_slices) - len(bad)
+        listed_slices = bad[:30]
+        omitted_bad_slices = len(bad) - len(listed_slices)
+    for s in listed_slices:
         expected = s.expected_chips or s.chips
         state = "complete" if s.complete else "DEGRADED"
         lines.append(
@@ -226,6 +235,10 @@ def format_slack_message(
             f"[{s.accelerator or '?'} {s.topology or '?'}]: "
             f"{s.ready_chips}/{expected} chips, {state}"
         )
+    if omitted_bad_slices:
+        lines.append(f"• … {omitted_bad_slices} more degraded slices omitted")
+    if omitted_ok_slices:
+        lines.append(f"• … {omitted_ok_slices} complete slices omitted")
     for m in multislices:
         expected = m.expected_chips or m.chips
         state = "complete" if m.complete else "DEGRADED"
