@@ -121,7 +121,10 @@ class TestCordonFailed:
         assert len(fake_api["patches"]) == 1
         patch = fake_api["patches"][0]
         assert patch["path"] == "/api/v1/nodes/tpu-1"
-        assert patch["body"] == {"spec": {"unschedulable": True}}
+        assert patch["body"]["spec"] == {"unschedulable": True}
+        # Cordon is stamped as OURS so --uncordon-recovered can identify it.
+        anno = patch["body"]["metadata"]["annotations"]
+        assert "tpu-node-checker.io/quarantined" in anno
         assert patch["content_type"] == "application/strategic-merge-patch+json"
         payload = json.loads(capsys.readouterr().out)
         assert payload["cordon"]["cordoned"] == ["tpu-1"]
@@ -312,6 +315,177 @@ class TestCordonFailed:
         payload = json.loads(capsys.readouterr().out)
         assert payload["cordon"]["cordoned"] == []
         assert payload["cordon"]["failed"][0]["node"] == "tpu-0"
+
+
+def _quarantined_node(name, probe_ok):
+    """A node cordoned by US (annotation present) with a given probe state."""
+    node = fx.make_node(
+        name,
+        unschedulable=True,
+        allocatable={"google.com/tpu": "4"},
+        labels={"cloud.google.com/gke-tpu-accelerator": "x"},
+    )
+    node["metadata"]["annotations"] = {
+        "tpu-node-checker.io/quarantined": "1700000000"
+    }
+    return node
+
+
+class TestUncordonRecovered:
+    def _args(self, tmp_path, fake_api, reports, *extra):
+        return cli.parse_args(
+            [
+                "--nodes-json", tmp_path,
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--uncordon-recovered",
+                "--json",
+                *extra,
+            ]
+        )
+
+    def test_recovered_quarantined_node_is_uncordoned(
+        self, tmp_path, fake_api, capsys
+    ):
+        nodes = [_quarantined_node("tpu-q", probe_ok=True)]
+        reports = _probe_reports(tmp_path, {"tpu-q": True})
+        args = self._args(_nodes_json(tmp_path, nodes), fake_api, reports)
+        checker.one_shot(args)
+        assert len(fake_api["patches"]) == 1
+        patch = fake_api["patches"][0]
+        assert patch["path"] == "/api/v1/nodes/tpu-q"
+        assert patch["body"]["spec"] == {"unschedulable": False}
+        # Strategic-merge null removes OUR annotation.
+        assert patch["body"]["metadata"]["annotations"] == {
+            "tpu-node-checker.io/quarantined": None
+        }
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["uncordon"]["uncordoned"] == ["tpu-q"]
+
+    def test_human_cordon_never_touched(self, tmp_path, fake_api, capsys):
+        # Cordoned but WITHOUT our annotation: a human did this; hands off
+        # even with a passing probe.
+        nodes = [
+            fx.make_node(
+                "tpu-human",
+                unschedulable=True,
+                allocatable={"google.com/tpu": "4"},
+                labels={"cloud.google.com/gke-tpu-accelerator": "x"},
+            )
+        ]
+        reports = _probe_reports(tmp_path, {"tpu-human": True})
+        args = self._args(_nodes_json(tmp_path, nodes), fake_api, reports)
+        checker.one_shot(args)
+        assert fake_api["patches"] == []
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["uncordon"]["uncordoned"] == []
+
+    def test_still_failing_quarantine_stays(self, tmp_path, fake_api, capsys):
+        nodes = [_quarantined_node("tpu-q", probe_ok=False)]
+        reports = _probe_reports(tmp_path, {"tpu-q": False})
+        args = self._args(_nodes_json(tmp_path, nodes), fake_api, reports)
+        checker.one_shot(args)
+        assert fake_api["patches"] == []
+
+    def test_no_fresh_probe_no_uncordon(self, tmp_path, fake_api, capsys):
+        # Quarantined node with NO probe report this round: no evidence of
+        # recovery, no uncordon.
+        nodes = [_quarantined_node("tpu-q", probe_ok=True)]
+        reports = _probe_reports(tmp_path, {})
+        args = self._args(_nodes_json(tmp_path, nodes), fake_api, reports)
+        checker.one_shot(args)
+        assert fake_api["patches"] == []
+
+    def test_dry_run_shared_flag(self, tmp_path, fake_api, capsys):
+        nodes = [_quarantined_node("tpu-q", probe_ok=True)]
+        reports = _probe_reports(tmp_path, {"tpu-q": True})
+        args = self._args(
+            _nodes_json(tmp_path, nodes), fake_api, reports, "--cordon-dry-run"
+        )
+        checker.one_shot(args)
+        assert fake_api["patches"] == []
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["uncordon"] == {
+            "dry_run": True,
+            "uncordoned": ["tpu-q"],
+            "failed": [],
+        }
+
+    def test_out_of_band_uncordon_clears_stale_annotation(
+        self, tmp_path, fake_api, capsys
+    ):
+        # `kubectl uncordon` flips spec.unschedulable but leaves our
+        # annotation behind; the checker must strip it — otherwise a later
+        # HUMAN cordon on the node would be misattributed as ours and
+        # auto-lifted.
+        node = fx.make_node(
+            "tpu-ooband",
+            allocatable={"google.com/tpu": "4"},
+            labels={"cloud.google.com/gke-tpu-accelerator": "x"},
+        )  # schedulable again, annotation stale
+        node["metadata"]["annotations"] = {
+            "tpu-node-checker.io/quarantined": "1700000000"
+        }
+        reports = _probe_reports(tmp_path, {"tpu-ooband": True})
+        args = self._args(_nodes_json(tmp_path, [node]), fake_api, reports)
+        checker.one_shot(args)
+        assert len(fake_api["patches"]) == 1
+        patch = fake_api["patches"][0]
+        assert patch["path"] == "/api/v1/nodes/tpu-ooband"
+        # Annotation-only patch: spec is NOT touched.
+        assert "spec" not in patch["body"]
+        assert patch["body"]["metadata"]["annotations"] == {
+            "tpu-node-checker.io/quarantined": None
+        }
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["uncordon"]["stale_annotations_cleared"] == ["tpu-ooband"]
+        assert payload["uncordon"]["uncordoned"] == []
+
+    def test_dry_run_previews_budget_consistently(self, tmp_path, fake_api, capsys):
+        # Dry-run must preview the SAME decisions a real run would make:
+        # the would-be-uncordoned node frees --cordon-max budget for the
+        # new failure (cf. test_recovery_frees_cordon_budget_same_round).
+        nodes = [_quarantined_node("tpu-q", probe_ok=True), *_tpu_nodes(1)]
+        reports = _probe_reports(tmp_path, {"tpu-q": True, "tpu-0": False})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--uncordon-recovered", "--cordon-failed", "--cordon-dry-run",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        assert fake_api["patches"] == []
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["uncordon"]["uncordoned"] == ["tpu-q"]
+        assert payload["cordon"]["cordoned"] == ["tpu-0"]
+        assert payload["cordon"]["skipped_over_cap"] == []
+
+    def test_recovery_frees_cordon_budget_same_round(
+        self, tmp_path, fake_api, capsys
+    ):
+        # Uncordon runs first: a recovered quarantine frees --cordon-max
+        # budget for this round's new failure.
+        nodes = [_quarantined_node("tpu-q", probe_ok=True), *_tpu_nodes(1)]
+        reports = _probe_reports(tmp_path, {"tpu-q": True, "tpu-0": False})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--uncordon-recovered", "--cordon-failed",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        paths = [p["path"] for p in fake_api["patches"]]
+        assert paths == ["/api/v1/nodes/tpu-q", "/api/v1/nodes/tpu-0"]
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["uncordon"]["uncordoned"] == ["tpu-q"]
+        assert payload["cordon"]["cordoned"] == ["tpu-0"]
+        assert payload["cordon"]["skipped_over_cap"] == []
 
 
 class TestCordonCli:

@@ -125,10 +125,17 @@ class TestInt8Probe:
 
     def test_accumulator_cannot_wrap(self):
         # Inputs are [-8, 7], so max |product| = 64 (−8·−8) and the chained
-        # accumulator is bounded by iters·k·64 — pin the default-shape bound
-        # the docstring claims, with real margin visible.
-        k, iters = 512, 8
-        assert iters * k * 64 == 262_144 < 2**31
+        # accumulator is bounded by iters·k·64.  Read the PROBE'S OWN
+        # defaults so bumping k/iters without rethinking the bound fails
+        # here instead of silently eroding the exactness guarantee.
+        import inspect
+
+        from tpu_node_checker.ops import int8_matmul_probe
+
+        sig = inspect.signature(int8_matmul_probe)
+        k = sig.parameters["k"].default
+        iters = sig.parameters["iters"].default
+        assert iters * k * 64 < 2**31 // 8  # 8x headroom, not just no-wrap
 
 
 class TestHbmProbe:

@@ -204,6 +204,108 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
                 }
 
 
+def _resolve_client(args, client):
+    """Reuse the LIST call's client; offline runs resolve one on demand."""
+    if client is not None:
+        return client
+    from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
+
+    return KubeClient(
+        resolve_cluster_config(
+            getattr(args, "kubeconfig", None), getattr(args, "context", None)
+        )
+    )
+
+
+def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None) -> dict:
+    """``--uncordon-recovered``: lift OUR quarantines once chips pass again.
+
+    The closing half of the quarantine lifecycle.  A node qualifies only
+    when ALL of: it is cordoned, the cordon carries this tool's annotation
+    (``QUARANTINE_ANNOTATION`` — a human's cordon is never touched), the
+    kubelet reports Ready, and a *fresh passing* probe verdict vouches for
+    the chips.  No budget: uncordoning restores capacity and each lift is
+    individually evidence-backed.  Shares ``--cordon-dry-run``.
+    """
+    candidates = [
+        n
+        for n in accel
+        if n.cordoned
+        and n.quarantined_by_us
+        and n.ready
+        and n.probe is not None
+        and n.probe.get("ok")
+    ]
+    # Annotation hygiene: an annotated-but-SCHEDULABLE node means someone
+    # lifted our quarantine out-of-band (`kubectl uncordon` only flips
+    # spec.unschedulable).  Strip the stale annotation now, or a later
+    # *human* cordon on the node would read as ours and be auto-lifted.
+    stale = [n for n in accel if n.quarantined_by_us and not n.cordoned]
+    report_entry: dict = {
+        "dry_run": bool(getattr(args, "cordon_dry_run", False)),
+        "uncordoned": [],
+        "failed": [],
+        "stale_annotations_cleared": [],
+    }
+    if not candidates and not stale:
+        return report_entry
+    if report_entry["dry_run"]:
+        report_entry["uncordoned"] = sorted(n.name for n in candidates)
+        report_entry["stale_annotations_cleared"] = sorted(n.name for n in stale)
+        for n in candidates:
+            # Preview post-action state throughout the run: the cordon
+            # budget math (and payload nodes) must match what a real run
+            # would do after this lift.
+            n.cordoned = False
+            n.quarantined_by_us = False
+            print(
+                f"[dry-run] would uncordon {n.name} (probe recovered)", file=sys.stderr
+            )
+        for n in stale:
+            n.quarantined_by_us = False
+            print(
+                f"[dry-run] would clear stale quarantine annotation on {n.name}",
+                file=sys.stderr,
+            )
+        return report_entry
+    try:
+        client = _resolve_client(args, client)
+    except Exception as exc:  # noqa: BLE001 — best-effort, like cordoning
+        report_entry["failed"] = [
+            {"node": n.name, "error": f"no cluster client: {exc}"} for n in candidates
+        ]
+        print(f"--uncordon-recovered: cannot reach cluster: {exc}", file=sys.stderr)
+        return report_entry
+    for n in candidates:
+        try:
+            client.uncordon_node(n.name)
+        except Exception as exc:  # noqa: BLE001
+            report_entry["failed"].append({"node": n.name, "error": str(exc)})
+            print(f"Uncordon of {n.name} failed: {exc}", file=sys.stderr)
+        else:
+            n.cordoned = False
+            n.quarantined_by_us = False
+            report_entry["uncordoned"].append(n.name)
+            print(f"Uncordoned {n.name} (chip probe recovered).", file=sys.stderr)
+    for n in stale:
+        try:
+            client.clear_quarantine_annotation(n.name)
+        except Exception as exc:  # noqa: BLE001
+            report_entry["failed"].append({"node": n.name, "error": str(exc)})
+            print(
+                f"Clearing stale annotation on {n.name} failed: {exc}", file=sys.stderr
+            )
+        else:
+            n.quarantined_by_us = False
+            report_entry["stale_annotations_cleared"].append(n.name)
+            print(
+                f"Cleared stale quarantine annotation on {n.name} "
+                "(uncordoned out-of-band).",
+                file=sys.stderr,
+            )
+    return report_entry
+
+
 def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None) -> dict:
     """``--cordon-failed``: mark probe-failed nodes unschedulable.
 
@@ -264,21 +366,14 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None) -> dict:
         for n in to_cordon:
             print(f"[dry-run] would cordon {n.name} (chip probe failed)", file=sys.stderr)
         return report_entry
-    if client is None:
-        from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
-
-        try:
-            client = KubeClient(
-                resolve_cluster_config(
-                    getattr(args, "kubeconfig", None), getattr(args, "context", None)
-                )
-            )
-        except Exception as exc:  # noqa: BLE001 — quarantine is best-effort
-            report_entry["failed"] = [
-                {"node": n.name, "error": f"no cluster client: {exc}"} for n in to_cordon
-            ]
-            print(f"--cordon-failed: cannot reach cluster: {exc}", file=sys.stderr)
-            return report_entry
+    try:
+        client = _resolve_client(args, client)
+    except Exception as exc:  # noqa: BLE001 — quarantine is best-effort
+        report_entry["failed"] = [
+            {"node": n.name, "error": f"no cluster client: {exc}"} for n in to_cordon
+        ]
+        print(f"--cordon-failed: cannot reach cluster: {exc}", file=sys.stderr)
+        return report_entry
     for n in to_cordon:
         try:
             client.cordon_node(n.name)
@@ -340,11 +435,18 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     else:
         result.exit_code = EXIT_OK
 
-    cordon_report = None
-    if getattr(args, "cordon_failed", False):
+    cordon_report = uncordon_report = None
+    if getattr(args, "cordon_failed", False) or getattr(args, "uncordon_recovered", False):
         # Before render, so payload["nodes"] reflects post-cordon state.
         with timer.phase("cordon"):
-            cordon_report = _cordon_failed_nodes(args, accel, client=kube_client)
+            if getattr(args, "uncordon_recovered", False):
+                # Uncordon FIRST: a recovered node leaving quarantine frees
+                # --cordon-max budget for this round's new failures.
+                uncordon_report = _uncordon_recovered_nodes(
+                    args, accel, client=kube_client
+                )
+            if getattr(args, "cordon_failed", False):
+                cordon_report = _cordon_failed_nodes(args, accel, client=kube_client)
 
     with timer.phase("render"):
         payload = report.build_json_payload(
@@ -395,6 +497,8 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
             payload["expected_chips_met"] = have_chips >= expected_n
         if cordon_report is not None:
             payload["cordon"] = cordon_report
+        if uncordon_report is not None:
+            payload["uncordon"] = uncordon_report
         payload["exit_code"] = result.exit_code
     payload["timings_ms"] = timer.as_dict()
     result.payload = payload

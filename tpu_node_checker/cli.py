@@ -163,7 +163,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "--watch converges at N instead of draining the pool; "
                         "raise deliberately for mass-repair workflows")
     cordon.add_argument("--cordon-dry-run", action="store_true",
-                        help="report cordon decisions without patching anything")
+                        help="report cordon/uncordon decisions without patching anything")
+    cordon.add_argument("--uncordon-recovered", action="store_true",
+                        help="lift THIS TOOL'S quarantines (cordons carrying the "
+                        "tpu-node-checker.io/quarantined annotation) once the node "
+                        "is Ready with a fresh passing chip probe; human cordons "
+                        "are never touched")
 
     # Same group/flags/defaults as the reference (check-gpu-node.py:304-309).
     slack = p.add_argument_group("Slack")
@@ -187,22 +192,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
         p.error("--probe-results-required requires --probe-results DIR")
-    if args.cordon_failed and not (args.probe or args.probe_results):
-        # Cordoning keys off a data-plane verdict; without a probe source
-        # the flag could never act and the operator would assume coverage.
-        p.error("--cordon-failed requires --probe or --probe-results DIR")
-    if args.cordon_failed and args.emit_probe:
-        # emit-probe mode never runs the check, so the flag would silently
-        # do nothing (same rule as --probe-soak / --probe-distributed).
-        p.error("--cordon-failed cannot be combined with --emit-probe")
+    for flag, on in (
+        ("--cordon-failed", args.cordon_failed),
+        ("--uncordon-recovered", args.uncordon_recovered),
+    ):
+        if on and not (args.probe or args.probe_results):
+            # Both key off a data-plane verdict; without a probe source the
+            # flag could never act and the operator would assume coverage.
+            p.error(f"{flag} requires --probe or --probe-results DIR")
+        if on and args.emit_probe:
+            # emit-probe mode never runs the check, so the flag would
+            # silently do nothing (same rule as --probe-soak/--probe-distributed).
+            p.error(f"{flag} cannot be combined with --emit-probe")
     if args.cordon_max is not None and args.cordon_max < 1:
         p.error("--cordon-max must be at least 1")
-    for flag, val in (
-        ("--cordon-max", args.cordon_max is not None),
-        ("--cordon-dry-run", args.cordon_dry_run),
-    ):
-        if val and not args.cordon_failed:
-            p.error(f"{flag} requires --cordon-failed")
+    if args.cordon_max is not None and not args.cordon_failed:
+        p.error("--cordon-max requires --cordon-failed")
+    if args.cordon_dry_run and not (args.cordon_failed or args.uncordon_recovered):
+        p.error("--cordon-dry-run requires --cordon-failed or --uncordon-recovered")
     if args.cordon_max is None:
         args.cordon_max = 1
     if args.probe_distributed and not (args.probe or args.emit_probe):

@@ -30,6 +30,10 @@ import tempfile
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, List, Optional, Tuple
 
+# Stamped on nodes cordoned by --cordon-failed; --uncordon-recovered only
+# ever lifts cordons carrying it, so human cordons stay untouched.
+from tpu_node_checker.detect import QUARANTINE_ANNOTATION
+
 if TYPE_CHECKING:  # pragma: no cover — requests is imported lazily at runtime
     import requests
 
@@ -262,12 +266,55 @@ class KubeClient:
     def cordon_node(self, name: str, timeout: float = DEFAULT_TIMEOUT_S) -> None:
         """``PATCH /api/v1/nodes/{name}`` → ``spec.unschedulable=true``.
 
-        The same strategic-merge patch ``kubectl cordon`` sends.  Requires
+        The same strategic-merge patch ``kubectl cordon`` sends, plus the
+        :data:`QUARANTINE_ANNOTATION` marking the cordon as OURS — the
+        uncordon path refuses to touch nodes a human cordoned.  Requires
         the ``patch`` verb on nodes (see deploy/rbac.yaml).
         """
+        import time as _time
+
+        self._patch_node(
+            name,
+            {
+                "metadata": {
+                    "annotations": {QUARANTINE_ANNOTATION: str(round(_time.time(), 3))}
+                },
+                "spec": {"unschedulable": True},
+            },
+            timeout,
+        )
+
+    def uncordon_node(self, name: str, timeout: float = DEFAULT_TIMEOUT_S) -> None:
+        """Lift a quarantine: ``spec.unschedulable=false`` + drop the
+        annotation (strategic-merge ``null`` removes a map key)."""
+        self._patch_node(
+            name,
+            {
+                "metadata": {"annotations": {QUARANTINE_ANNOTATION: None}},
+                "spec": {"unschedulable": False},
+            },
+            timeout,
+        )
+
+    def clear_quarantine_annotation(
+        self, name: str, timeout: float = DEFAULT_TIMEOUT_S
+    ) -> None:
+        """Drop a stale quarantine annotation WITHOUT touching spec.
+
+        Hygiene for the out-of-band-uncordon case: ``kubectl uncordon`` only
+        flips ``spec.unschedulable`` and leaves our annotation behind; were
+        it kept, a later *human* cordon on the node would read as ours and
+        be auto-lifted."""
+        self._patch_node(
+            name,
+            {"metadata": {"annotations": {QUARANTINE_ANNOTATION: None}}},
+            timeout,
+        )
+
+    def _patch_node(self, name: str, body: dict, timeout: float) -> None:
         resp = self._session.patch(
             f"{self.config.server}/api/v1/nodes/{name}",
-            data=json.dumps({"spec": {"unschedulable": True}}),
+            data=json.dumps(body),
             headers={"Content-Type": "application/strategic-merge-patch+json"},
             timeout=timeout,
         )

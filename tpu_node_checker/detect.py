@@ -53,6 +53,10 @@ MULTISLICE_GROUP_LABELS = (
     "cloud.google.com/gke-multislice-group",
     "multislice-group",
 )
+# Annotation stamped by --cordon-failed (written in cluster.py, read here):
+# marks a cordon as this tool's quarantine, so --uncordon-recovered can lift
+# it without ever touching a human's cordon.
+QUARANTINE_ANNOTATION = "tpu-node-checker.io/quarantined"
 
 _INSTANCE_CHIPS_RE = re.compile(r"-(\d+)t$")
 
@@ -149,6 +153,9 @@ class NodeInfo:
     # cordoned nodes as Ready); used to avoid re-cordoning and surfaced in
     # the payload.
     cordoned: bool = False
+    # True when the cordon carries OUR quarantine annotation — the only
+    # cordons --uncordon-recovered may lift.
+    quarantined_by_us: bool = False
     # TPU-only fields (None on GPU/CPU nodes):
     tpu_accelerator: Optional[str] = None  # e.g. "tpu-v5-lite-podslice"
     tpu_topology: Optional[str] = None  # e.g. "16x16"
@@ -192,6 +199,8 @@ class NodeInfo:
                 "topology": self.tpu_topology,
                 "nodepool": self.nodepool,
             }
+        if self.quarantined_by_us:
+            d["quarantined_by_us"] = True
         if self.probe is not None:
             d["probe"] = self.probe
         return d
@@ -249,6 +258,8 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         taints=taints,
         schedulable=schedulable,
         cordoned=bool(spec.get("unschedulable")),
+        quarantined_by_us=QUARANTINE_ANNOTATION
+        in _as_dict(metadata.get("annotations")),
         tpu_accelerator=_label(LABEL_TPU_ACCELERATOR),
         tpu_topology=_label(LABEL_TPU_TOPOLOGY),
         nodepool=_label(LABEL_NODEPOOL),

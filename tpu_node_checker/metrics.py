@@ -126,6 +126,14 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             "nonzero means humans must look NOW.",
             [({}, len(cordon.get("skipped_over_cap", [])))],
         )
+    uncordon = payload.get("uncordon")
+    if uncordon is not None:
+        family(
+            "tpu_node_checker_uncordoned_nodes",
+            "gauge",
+            "Quarantines lifted by --uncordon-recovered this round.",
+            [({}, len(uncordon.get("uncordoned", [])))],
+        )
     probe = payload.get("local_probe")
     if probe:
         family(

@@ -129,7 +129,12 @@ try:
         out["distributed_psum_ok"] = abs(total - expected) < 1e-6
         out["ok"] = out["ok"] and out["distributed_psum_ok"]
     if level in ("compute", "collective", "workload") and out["ok"]:
-        from tpu_node_checker.ops import hbm_bandwidth_probe, matmul_burn, pallas_matmul_probe
+        from tpu_node_checker.ops import (
+            hbm_bandwidth_probe,
+            int8_matmul_probe,
+            matmul_burn,
+            pallas_matmul_probe,
+        )
         burn = matmul_burn()
         out["matmul_tflops"] = round(burn.tflops, 3)
         out["matmul_ok"] = burn.ok
@@ -138,7 +143,6 @@ try:
         out["hbm_ok"] = hbm.ok
         pallas = pallas_matmul_probe()
         out["pallas_ok"] = pallas.ok
-        from tpu_node_checker.ops import int8_matmul_probe
         # Quantized serving path: the MXU's int8 mode is a distinct engine
         # configuration from the bf16 burn; verification is exact-integer.
         i8 = int8_matmul_probe()
