@@ -139,10 +139,34 @@ users: [{{name: bench, user: {{token: bench-token}}}}]
                 "vs_baseline": round(baseline_ms / cold_p50, 1),
                 "internal_p50_ms": round(internal_p50, 2),
                 "cold_e2e_p50_ms": round(cold_p50, 2),
+                **_provenance(),
             }
         )
     )
     return 0
+
+
+def _provenance() -> dict:
+    """Tie the evidence to the tree it measured (ADVICE r02): git SHA, dirty
+    flag, and a UTC timestamp.  Best-effort — a non-git checkout still benches."""
+    prov = {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    try:
+        root = os.path.dirname(os.path.abspath(__file__))
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True, cwd=root
+        )
+        status = subprocess.run(
+            ["git", "status", "--porcelain"], capture_output=True, text=True, cwd=root
+        )
+        if sha.returncode == 0:
+            prov["git_sha"] = sha.stdout.strip()
+            if status.returncode == 0:
+                # Only claim cleanliness when status actually ran: an empty
+                # stdout from a failed command must not stamp dirty=false.
+                prov["git_dirty"] = bool(status.stdout.strip())
+    except OSError:
+        pass
+    return prov
 
 
 if __name__ == "__main__":

@@ -409,6 +409,7 @@ class TestUncordonRecovered:
             "dry_run": True,
             "uncordoned": ["tpu-q"],
             "failed": [],
+            "stale_annotations_cleared": [],
         }
 
     def test_out_of_band_uncordon_clears_stale_annotation(

@@ -57,6 +57,57 @@ class TestRenderMetrics:
         text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
         assert "tpu_node_checker_probe_ok" not in text
 
+    def test_probe_summary_families(self):
+        # VERDICT r02 #5: the aggregator Deployment must be able to alert on
+        # "N hosts probe-failed" from the scrape alone.
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["probe_summary"] = {
+            "hosts_reported": 62,
+            "hosts_ok": 60,
+            "hosts_failed": ["gke-a", "gke-b"],
+            "hosts_missing": ["gke-z"],
+        }
+        text = render_metrics(result)
+        assert 'tpu_node_checker_probe_hosts{state="reported"} 62' in text
+        assert 'tpu_node_checker_probe_hosts{state="ok"} 60' in text
+        assert 'tpu_node_checker_probe_hosts{state="failed"} 2' in text
+        assert 'tpu_node_checker_probe_hosts{state="missing"} 1' in text
+        assert ('tpu_node_checker_probe_host_unhealthy'
+                '{host="gke-a",state="failed"} 1.0') in text
+        assert ('tpu_node_checker_probe_host_unhealthy'
+                '{host="gke-z",state="missing"} 1.0') in text
+
+    def test_probe_summary_all_healthy_no_per_host_series(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["probe_summary"] = {
+            "hosts_reported": 64,
+            "hosts_ok": 64,
+            "hosts_failed": [],
+            "hosts_missing": [],
+        }
+        text = render_metrics(result)
+        assert 'tpu_node_checker_probe_hosts{state="ok"} 64' in text
+        assert "tpu_node_checker_probe_host_unhealthy" not in text
+
+    def test_no_probe_summary_no_fleet_families(self):
+        text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
+        assert "tpu_node_checker_probe_hosts" not in text
+
+    def test_probe_summary_per_host_series_capped(self):
+        # A fleet-wide emitter outage must not mint one series per node.
+        result = self._result(fx.tpu_v5e_256_slice())
+        missing = [f"gke-{i:04d}" for i in range(150)]
+        result.payload["probe_summary"] = {
+            "hosts_reported": 0,
+            "hosts_ok": 0,
+            "hosts_failed": [],
+            "hosts_missing": missing,
+        }
+        text = render_metrics(result)
+        assert text.count("tpu_node_checker_probe_host_unhealthy{") == 100
+        assert "tpu_node_checker_probe_host_unhealthy_omitted 50" in text
+        assert 'tpu_node_checker_probe_hosts{state="missing"} 150' in text
+
     def test_multislice_families(self):
         text = render_metrics(
             self._result(fx.tpu_multislice(n_slices=2, not_ready=1))

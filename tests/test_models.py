@@ -140,3 +140,58 @@ class TestShardedStep:
         assert sh.spec == jax.sharding.PartitionSpec(None, None, "model")
         # 8 devices each hold a shard of w1:
         assert len(params["layers"]["w1"].addressable_shards) == 8
+
+
+class TestAdam:
+    """The hand-rolled Adam (burnin._Adam) against a NumPy reference.
+
+    Round-2 verdict #1 replaced ``optax.adam`` with ~40 in-package lines to
+    keep the probe's dependency surface at requests+PyYAML+jax; that trade
+    is only sound if the optimizer is pinned numerically.
+    """
+
+    def _numpy_adam(self, grads_seq, p0, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8):
+        p = np.array(p0, np.float32)
+        mu = np.zeros_like(p)
+        nu = np.zeros_like(p)
+        for t, g in enumerate(grads_seq, start=1):
+            g = np.asarray(g, np.float32)
+            mu = b1 * mu + (1 - b1) * g
+            nu = b2 * nu + (1 - b2) * g * g
+            mu_hat = mu / (1 - b1**t)
+            nu_hat = nu / (1 - b2**t)
+            p = p - lr * mu_hat / (np.sqrt(nu_hat) + eps)
+        return p
+
+    def test_matches_reference_update(self):
+        from tpu_node_checker.models.burnin import _Adam
+
+        rng = np.random.default_rng(0)
+        p0 = rng.normal(size=(5, 3)).astype(np.float32)
+        grads_seq = [rng.normal(size=(5, 3)).astype(np.float32) for _ in range(7)]
+
+        tx = _Adam(lr=1e-3)
+        params = {"w": jax.numpy.asarray(p0)}
+        state = tx.init(params)
+        for g in grads_seq:
+            updates, state = tx.update({"w": jax.numpy.asarray(g)}, state, params)
+            params = _Adam.apply_updates(params, updates)
+
+        expected = self._numpy_adam(grads_seq, p0)
+        np.testing.assert_allclose(np.asarray(params["w"]), expected, rtol=1e-5, atol=1e-7)
+        assert int(state["count"]) == len(grads_seq)
+
+    def test_state_inherits_param_sharding(self):
+        # Moments are zeros_like over sharded params → same layout, so the
+        # sharded train step's opt-state shardings can be inferred (burnin
+        # builds sharded_init exactly this way).
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_node_checker.models.burnin import _Adam
+
+        mesh = build_mesh(MeshSpec((("data", 2), ("model", 4))))
+        sh = NamedSharding(mesh, P(None, "model"))
+        params = {"w": jax.device_put(jax.numpy.ones((4, 8)), sh)}
+        state = _Adam().init(params)
+        assert state["mu"]["w"].sharding == sh
+        assert state["nu"]["w"].sharding == sh

@@ -130,3 +130,36 @@ class TestMultisliceSurfaces:
             accel, ready, slices, healthy=False, multislices=ms
         )
         assert "multislice `ms-train-1`: 2 slice(s), 28/32 chips, DEGRADED" in msg
+
+    def test_slack_multislice_lines_capped_at_fleet_scale(self):
+        # VERDICT r02 #7: the grouping label is operator-chosen — a per-job
+        # label can mint one multislice group per workload, so the group
+        # lines get the same cap-and-summarize policy as nodes and slices:
+        # >12 groups → degraded-only, at most 30 bullets, omissions counted.
+        nodes = []
+        for g in range(40):
+            for i in range(4):
+                nodes.append(
+                    fx.make_node(
+                        f"gke-ms{g:02d}-{i}",
+                        ready=not (g < 35 and i == 0),
+                        allocatable={"google.com/tpu": "4"},
+                        labels={
+                            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                            "cloud.google.com/gke-tpu-topology": "4x4",
+                            "cloud.google.com/gke-nodepool": f"pool-{g:02d}",
+                            "cloud.google.com/gke-multislice-group": f"job-{g:02d}",
+                        },
+                    )
+                )
+        accel, ready = select_accelerator_nodes(nodes)
+        slices = group_slices(accel)
+        ms = group_multislices(slices)
+        assert len(ms) == 40
+        msg = report.format_slack_message(
+            accel, ready, slices, healthy=False, multislices=ms
+        )
+        assert msg.count("• multislice `") == 30  # degraded only, capped
+        assert "• multislice `job-39`" not in msg  # complete group omitted
+        assert "… 5 more degraded multislice groups omitted" in msg
+        assert "… 5 complete multislice groups omitted" in msg

@@ -472,3 +472,191 @@ class TestWatch:
         assert sent[0].startswith("✅")
         assert sent[1].startswith("⚠️")
         assert "State change: exit 0 → 3" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+class TestDaemonSetLoopEndToEnd:
+    """The full production loop as ONE piece (VERDICT r02 #4).
+
+    A REAL emitter process (``--emit-probe FILE --watch``) writes reports
+    into a shared directory; a real aggregator round (``--probe-results
+    --probe-results-required --cordon-failed``) consumes them against a fake
+    API server reached through a real kubeconfig.  Three phases prove the
+    integration seam end to end: fresh-and-healthy grades 0, a killed
+    emitter lets ``written_at`` age past ``--probe-results-max-age`` and the
+    host flips to missing (exit 3, but deliberately NOT cordoned — absence
+    is not evidence of dead chips), and a genuinely failing emitter's report
+    drives a real cordon PATCH.
+    """
+
+    HOST = "e2e-tpu-0"
+
+    @pytest.fixture
+    def fake_api(self, tmp_path):
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        patches = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_PATCH(self):
+                body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                patches.append({"path": self.path, "body": json.loads(body)})
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *args):
+                pass
+
+        server = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(
+            "apiVersion: v1\nkind: Config\ncurrent-context: t\n"
+            "contexts: [{name: t, context: {cluster: t, user: t}}]\n"
+            "clusters: [{name: t, cluster: {server: "
+            f'"http://127.0.0.1:{server.server_address[1]}"}}}}]\n'
+            "users: [{name: t, user: {token: tok}}]\n"
+        )
+        yield {"patches": patches, "kubeconfig": str(kubeconfig)}
+        server.shutdown()
+
+    def _nodes_json(self, tmp_path):
+        p = tmp_path / "nodes.json"
+        p.write_text(
+            json.dumps(
+                fx.node_list(
+                    [
+                        fx.make_node(
+                            self.HOST,
+                            allocatable={"google.com/tpu": "8"},
+                            labels={
+                                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                                "cloud.google.com/gke-nodepool": "e2e-pool",
+                            },
+                        )
+                    ]
+                )
+            )
+        )
+        return str(p)
+
+    def _spawn_emitter(self, report, interval="0.3", env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = {**os.environ, "NODE_NAME": self.HOST, **(env_extra or {})}
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "tpu_node_checker",
+                "--emit-probe", str(report),
+                "--watch", interval,
+                "--probe-timeout", "120",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env=env,
+        )
+
+    def _wait_for_report(self, report, timeout=120.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if report.exists() and report.stat().st_size > 0:
+                return
+            time.sleep(0.1)
+        raise AssertionError(f"emitter never wrote {report}")
+
+    def _aggregate(self, tmp_path, shared, kubeconfig, capsys, max_age):
+        args = cli.parse_args(
+            [
+                "--nodes-json", self._nodes_json(tmp_path),
+                "--kubeconfig", kubeconfig,
+                "--probe-results", str(shared),
+                "--probe-results-required",
+                "--probe-results-max-age", max_age,
+                "--cordon-failed",
+                "--json",
+            ]
+        )
+        code = checker.one_shot(args)
+        return code, json.loads(capsys.readouterr().out)
+
+    def test_emitter_aggregator_cordon_lifecycle(self, tmp_path, fake_api, capsys):
+        import time
+
+        shared = tmp_path / "shared"
+        shared.mkdir()
+        report = shared / f"{self.HOST}.json"
+
+        # Phase 1 — healthy emitter: the aggregator consumes the real
+        # emitter-written schema and grades the fleet healthy.
+        emitter = self._spawn_emitter(report)
+        try:
+            self._wait_for_report(report)
+            code, payload = self._aggregate(
+                tmp_path, shared, fake_api["kubeconfig"], capsys, max_age="300"
+            )
+            assert code == 0
+            node = payload["nodes"][0]
+            assert node["probe"]["ok"] is True
+            assert node["probe"]["level"] == "enumerate"
+            assert node["probe"]["device_count"] == 8  # virtual CPU mesh
+            assert "written_at" in node["probe"]  # the staleness anchor
+            assert payload["probe_summary"] == {
+                "hosts_reported": 1,
+                "hosts_ok": 1,
+                "hosts_failed": [],
+                "hosts_missing": [],
+            }
+            assert payload["cordon"]["cordoned"] == []
+            assert fake_api["patches"] == []
+        finally:
+            emitter.kill()
+            emitter.wait()
+
+        # Phase 2 — emitter dead: the report stops refreshing, written_at
+        # ages past max-age, and required coverage flips the host to
+        # MISSING.  Exit 3, but no cordon: absence is not evidence.
+        time.sleep(1.2)
+        code, payload = self._aggregate(
+            tmp_path, shared, fake_api["kubeconfig"], capsys, max_age="1.0"
+        )
+        assert code == 3
+        assert payload["nodes"][0]["probe"]["level"] == "missing"
+        assert payload["probe_summary"]["hosts_missing"] == [self.HOST]
+        assert payload["probe_summary"]["hosts_reported"] == 0
+        assert payload["cordon"]["cordoned"] == []
+        assert fake_api["patches"] == []
+
+        # Phase 3 — emitter whose chips genuinely fail (broken jax platform
+        # in its child): a fresh ok=false report drives a REAL cordon PATCH
+        # through the kubeconfig to the fake API server.
+        emitter = self._spawn_emitter(
+            report, env_extra={"JAX_PLATFORMS": "bogus_dead_platform"}
+        )
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                self._wait_for_report(report)
+                if json.loads(report.read_text()).get("ok") is False:
+                    break
+                time.sleep(0.1)
+            assert json.loads(report.read_text())["ok"] is False
+            code, payload = self._aggregate(
+                tmp_path, shared, fake_api["kubeconfig"], capsys, max_age="300"
+            )
+            assert code == 3
+            assert payload["probe_summary"]["hosts_failed"] == [self.HOST]
+            assert payload["cordon"]["cordoned"] == [self.HOST]
+            assert len(fake_api["patches"]) == 1
+            patch = fake_api["patches"][0]
+            assert f"/api/v1/nodes/{self.HOST}" in patch["path"]
+            assert patch["body"]["spec"]["unschedulable"] is True
+        finally:
+            emitter.kill()
+            emitter.wait()

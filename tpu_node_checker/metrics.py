@@ -16,7 +16,10 @@ Exported metric families:
 * ``tpu_node_checker_last_run_timestamp_seconds`` — staleness detector;
 * ``tpu_node_checker_probe_*`` — when ``--probe`` ran: pass/fail by level and
   numeric chip telemetry (device count, MXU TFLOP/s, HBM/DMA GB/s, collective
-  bus and per-link ICI bandwidth, workload step time).
+  bus and per-link ICI bandwidth, workload step time);
+* ``tpu_node_checker_probe_hosts{state="reported|ok|failed|missing"}`` — the
+  ``--probe-results`` fleet roll-up, plus
+  ``tpu_node_checker_probe_host_unhealthy{host,state}`` naming each sick host.
 """
 
 from __future__ import annotations
@@ -160,6 +163,49 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             value = probe.get(key)
             if isinstance(value, (int, float)) and not isinstance(value, bool):
                 family(f"tpu_node_checker_{suffix}", "gauge", help_text, [({}, value)])
+    summary = payload.get("probe_summary")
+    if summary is not None:
+        # Fleet chip-health roll-up under the DaemonSet pattern
+        # (--probe-results): the aggregator Deployment alerts on "N hosts
+        # probe-failed" straight off the scrape, no JSON-log parsing.
+        family(
+            "tpu_node_checker_probe_hosts",
+            "gauge",
+            "Hosts by data-plane probe state across the fleet "
+            "(--probe-results roll-up).",
+            [
+                ({"state": "reported"}, summary.get("hosts_reported", 0)),
+                ({"state": "ok"}, summary.get("hosts_ok", 0)),
+                ({"state": "failed"}, len(summary.get("hosts_failed", []))),
+                ({"state": "missing"}, len(summary.get("hosts_missing", []))),
+            ],
+        )
+        unhealthy = [("failed", h) for h in summary.get("hosts_failed", [])] + [
+            ("missing", h) for h in summary.get("hosts_missing", [])
+        ]
+        if unhealthy:
+            # Info-style series naming the sick hosts; healthy hosts emit no
+            # series, so cardinality tracks the (alertable) problem count,
+            # not fleet size.  Capped all the same: a fleet-wide emitter
+            # outage (every host missing) must not mint one series per node —
+            # the aggregate family above carries the full counts, and the cap
+            # is surfaced as its own series rather than silently truncating
+            # (same policy as the Slack list caps).
+            cap = 100
+            family(
+                "tpu_node_checker_probe_host_unhealthy",
+                "gauge",
+                "1 per host whose chip probe failed or that never reported "
+                f"(first {cap}; see ..._probe_hosts for full counts).",
+                [({"host": h, "state": state}, 1.0) for state, h in unhealthy[:cap]],
+            )
+            if len(unhealthy) > cap:
+                family(
+                    "tpu_node_checker_probe_host_unhealthy_omitted",
+                    "gauge",
+                    "Unhealthy hosts beyond the per-host series cap.",
+                    [({}, len(unhealthy) - cap)],
+                )
     family(
         "tpu_node_checker_exit_code",
         "gauge",

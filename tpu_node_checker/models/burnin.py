@@ -32,8 +32,51 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class _Adam:
+    """Hand-rolled Adam (Kingma & Ba) with bias correction.
+
+    Deliberately not optax: the probe's entire dependency surface is
+    requests + PyYAML + jax (pyproject ``probe`` extra), and an optimizer
+    the size of this class is not worth a fourth wheel.  The moment trees
+    are built with ``zeros_like`` over the (possibly already-sharded)
+    params, so under GSPMD they inherit the parameter layout and the
+    update stays elementwise — no collectives beyond the gradient
+    all-reduce the loss grad already implies.
+    """
+
+    lr: float = 1e-3
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+
+    def init(self, params) -> dict:
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)  # noqa: E731
+        return {"mu": zeros(), "nu": zeros(), "count": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params=None) -> Tuple[dict, dict]:
+        del params  # same signature shape as optax GradientTransformation
+        count = state["count"] + 1
+        mu = jax.tree.map(lambda m, g: self.b1 * m + (1 - self.b1) * g, state["mu"], grads)
+        nu = jax.tree.map(
+            lambda v, g: self.b2 * v + (1 - self.b2) * jnp.square(g), state["nu"], grads
+        )
+        c = count.astype(jnp.float32)
+        mu_scale = 1.0 / (1.0 - jnp.power(self.b1, c))
+        nu_scale = 1.0 / (1.0 - jnp.power(self.b2, c))
+        updates = jax.tree.map(
+            lambda m, v: -self.lr * (m * mu_scale) / (jnp.sqrt(v * nu_scale) + self.eps),
+            mu,
+            nu,
+        )
+        return updates, {"mu": mu, "nu": nu, "count": count}
+
+    @staticmethod
+    def apply_updates(params, updates):
+        return jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
 
 
 @dataclass(frozen=True)
@@ -223,7 +266,7 @@ def make_train_step(
             raise ValueError(
                 f'attention="flash" needs seq % {BLOCK} == 0, got seq={cfg.seq}'
             )
-    tx = optax.adam(learning_rate)
+    tx = _Adam(lr=learning_rate)
 
     def init_fn(key: jax.Array):
         params = init_params(key, cfg)
@@ -233,7 +276,7 @@ def make_train_step(
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(_loss)(params, tokens, cfg)
         updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        params = _Adam.apply_updates(params, updates)
         return params, opt_state, loss
 
     if mesh is None:

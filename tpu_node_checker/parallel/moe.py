@@ -214,30 +214,48 @@ def moe_probe(
         )
         fn(w1s, w2s, wrs, xs)  # warmup: compile + first pass
         t0 = time.perf_counter()
-        gated_dev, ungated_dev = jax.device_get(fn(w1s, w2s, wrs, xs))
+        gated_dev, ungated_dev = fn(w1s, w2s, wrs, xs)
+        jax.block_until_ready((gated_dev, ungated_dev))
         latency_ms = (time.perf_counter() - t0) * 1e3
-        out_host, raw_host = np.asarray(gated_dev), np.asarray(ungated_dev)
 
-        ref, raw_ref = jax.device_get(reference_moe(w1, w2, wr, x, n, with_ungated=True))
-        ref, raw_ref = np.asarray(ref), np.asarray(raw_ref)
-        max_abs_err = float(np.max(np.abs(out_host - ref)))
-        # Verdict on the UNGATED surface: the gate can scale a corrupted
-        # token below any absolute tolerance (see make_moe_layer docstring).
-        ok = bool(np.allclose(raw_host, raw_ref, rtol=rtol, atol=rtol)) and bool(
-            np.allclose(out_host, ref, rtol=rtol, atol=rtol)
+        # Every process computes the dense reference from the same host-side
+        # inputs; the comparison itself runs ON DEVICE with replicated
+        # outputs (scalars + a per-expert badness vector), so the probe works
+        # unchanged over a multi-host global mesh (--probe-distributed) where
+        # the sharded expert outputs are not host-addressable.
+        ref, raw_ref = reference_moe(w1, w2, wr, x, n, with_ungated=True)
+        ref_s = jax.device_put(np.asarray(ref), NamedSharding(mesh, P("ep", None)))
+        raw_ref_s = jax.device_put(
+            np.asarray(raw_ref), NamedSharding(mesh, P("ep", None))
         )
+        rep = NamedSharding(mesh, P())
+        expert_of_dev = jnp.arange(n * T) % n  # token j serves expert j mod n
+
+        def _verify(got_g, got_u, want_g, want_u):
+            close = lambda a, b: jnp.abs(a - b) <= rtol + rtol * jnp.abs(b)  # noqa: E731
+            gated_err = jnp.max(jnp.abs(got_g - want_g))
+            raw_err = jnp.max(jnp.abs(got_u - want_u))
+            gated_bad = jnp.any(~close(got_g, want_g))
+            # Verdict on the UNGATED surface: the gate can scale a corrupted
+            # token below any absolute tolerance (see make_moe_layer
+            # docstring).  Per-expert attribution via one-hot scatter-add.
+            bad_tok = jnp.any(~close(got_u, want_u), axis=1).astype(jnp.int32)
+            onehot = jax.nn.one_hot(expert_of_dev, n, dtype=jnp.int32)
+            bad_per_expert = jnp.sum(onehot * bad_tok[:, None], axis=0)
+            return gated_err, raw_err, gated_bad, bad_per_expert
+
+        verify = jax.jit(_verify, out_shardings=(rep, rep, rep, rep))
+        gated_err, raw_err, gated_bad, bad_per_expert = verify(
+            gated_dev, ungated_dev, ref_s, raw_ref_s
+        )
+        max_abs_err = float(gated_err)
+        bad_per_expert = np.asarray(bad_per_expert)
+        ok = not bool(gated_bad) and int(bad_per_expert.sum()) == 0
         details = None
         error = None
         if not ok:
-            # Per-expert attribution: global token j serves expert j mod n
-            # (T divides by n, so the local round-robin IS the global one).
-            err = np.abs(raw_host - raw_ref).max(axis=1)  # (n*T,)
-            tol = rtol * np.maximum(np.abs(raw_ref).max(axis=1), 1.0)
-            expert_of = np.arange(n * T) % n
-            bad_experts = sorted(
-                int(e) for e in np.unique(expert_of[err > tol])
-            )
-            raw_max_err = float(np.max(np.abs(raw_host - raw_ref)))
+            bad_experts = sorted(int(e) for e in np.nonzero(bad_per_expert)[0])
+            raw_max_err = float(raw_err)
             details = {"bad_experts": bad_experts, "ungated_max_abs_err": raw_max_err}
             # Report the UNGATED magnitude the verdict was based on — the
             # gated delta can read as float noise on a low-gate token.

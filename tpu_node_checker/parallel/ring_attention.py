@@ -170,21 +170,40 @@ def ring_attention_probe(
 
         keys = jax.random.split(jax.random.PRNGKey(0), 3)
         shape = (batch, S, heads, head_dim)
-        q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in keys)
+        # Host copies feed both the sharded inputs and the local reference.
+        q, k, v = (
+            np.asarray(jax.random.normal(kk, shape, jnp.float32)) for kk in keys
+        )
         spec = NamedSharding(mesh, P(None, "sp", None, None))
         qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
 
         ring_fn = make_ring_attention(mesh)
         out = ring_fn(qs, ks, vs)  # warmup: compile + first pass
-        out_host = np.asarray(jax.device_get(out))
+        jax.block_until_ready(out)
         t0 = time.perf_counter()
         out = ring_fn(qs, ks, vs)
-        out_host = np.asarray(jax.device_get(out))  # host fetch = completion barrier
+        jax.block_until_ready(out)  # completion barrier for the timing
         latency_ms = (time.perf_counter() - t0) * 1e3
 
-        ref = np.asarray(jax.device_get(reference_causal_attention(q, k, v)))
-        max_abs_err = float(np.max(np.abs(out_host - ref)))
-        ok = bool(np.allclose(out_host, ref, rtol=rtol, atol=rtol))
+        # Every process computes the full (probe-scale) reference from the
+        # same host inputs, then comparison runs ON DEVICE with replicated
+        # scalar outputs — fetching the sharded ring output itself would
+        # throw on a multi-host global mesh (--probe-distributed), where
+        # remote shards are not addressable.
+        ref = jax.device_put(
+            np.asarray(reference_causal_attention(q, k, v)), spec
+        )
+        rep = NamedSharding(mesh, P())
+        verify = jax.jit(
+            lambda a, b: (
+                jnp.max(jnp.abs(a - b)),
+                jnp.any(jnp.abs(a - b) > rtol + rtol * jnp.abs(b)),
+            ),
+            out_shardings=(rep, rep),
+        )
+        err_dev, bad_dev = verify(out, ref)
+        max_abs_err = float(err_dev)
+        ok = not bool(bad_dev)
         return RingAttentionResult(
             ok=ok,
             n_devices=n,

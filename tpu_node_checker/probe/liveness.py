@@ -131,7 +131,6 @@ try:
     if level in ("compute", "collective", "workload") and out["ok"]:
         from tpu_node_checker.ops import (
             hbm_bandwidth_probe,
-            int8_matmul_probe,
             matmul_burn,
             pallas_matmul_probe,
         )
@@ -143,13 +142,24 @@ try:
         out["hbm_ok"] = hbm.ok
         pallas = pallas_matmul_probe()
         out["pallas_ok"] = pallas.ok
-        # Quantized serving path: the MXU's int8 mode is a distinct engine
-        # configuration from the bf16 burn; verification is exact-integer.
-        i8 = int8_matmul_probe()
-        out["int8_ok"] = i8.ok
-        out["int8_tops"] = round(i8.tops, 3)
-        if not i8.ok:
-            out["int8_err"] = i8.error
+        i8_gate = True
+        if os.environ.get("TNC_SKIP_INT8") == "1":
+            # Operator escape hatch, same contract as TNC_SKIP_FLASH_ATTENTION
+            # below: the int8 check pins a distinct MXU engine configuration,
+            # so an int8 *lowering* regression in a jax bump would grade every
+            # healthy node in the fleet failed with no unblock short of
+            # downgrading.  Skipping is visible in the report, never silent.
+            out["int8_skipped"] = True
+        else:
+            from tpu_node_checker.ops import int8_matmul_probe
+            # Quantized serving path: the MXU's int8 mode is a distinct engine
+            # configuration from the bf16 burn; verification is exact-integer.
+            i8 = int8_matmul_probe()
+            out["int8_ok"] = i8.ok
+            out["int8_tops"] = round(i8.tops, 3)
+            i8_gate = i8.ok
+            if not i8.ok:
+                out["int8_err"] = i8.error
         fa_gate = True
         if os.environ.get("TNC_SKIP_FLASH_ATTENTION") == "1":
             # Operator escape hatch (cf. TNC_SOAK_*): the flash-attention
@@ -172,7 +182,7 @@ try:
         out["dma_ok"] = dma.ok
         out["dma_gbps"] = round(dma.gbps, 2)
         out["ok"] = (
-            out["ok"] and burn.ok and hbm.ok and pallas.ok and i8.ok
+            out["ok"] and burn.ok and hbm.ok and pallas.ok and i8_gate
             and fa_gate and dma.ok
         )
         soak_s = float(os.environ.get("TNC_SOAK_S") or 0)

@@ -167,6 +167,23 @@ def error_payload(message: str) -> str:
     return json.dumps({"error": message}, ensure_ascii=False)
 
 
+def _cap_listing(items, is_problem, threshold: int, cap: int = 30):
+    """Shared Slack scaling policy: small sets list exhaustively; above
+    ``threshold`` only problem entries are listed, at most ``cap`` of them.
+
+    Returns ``(listed, omitted_problems, omitted_healthy)`` — the caller
+    renders the omission counts so truncation is never silent.
+    """
+    listed = list(items)
+    omitted_problems = omitted_healthy = 0
+    if len(listed) > threshold:
+        problems = [x for x in listed if is_problem(x)]
+        omitted_healthy = len(listed) - len(problems)
+        listed = problems[:cap]
+        omitted_problems = len(problems) - len(listed)
+    return listed, omitted_problems, omitted_healthy
+
+
 def format_slack_message(
     accel: Sequence[NodeInfo],
     ready: Sequence[NodeInfo],
@@ -198,16 +215,12 @@ def format_slack_message(
     # Small clusters keep the reference's exhaustive per-node bullets
     # (check-gpu-node.py:128-137).  Large fleets (a v5e-256 slice is 64 node
     # objects) would bury the signal and hit Slack's message limits, so
-    # above the threshold only problem nodes are listed.
-    listed = list(accel)
-    omitted_healthy = omitted_problems = 0
-    if len(accel) > 20:
-        # effectively_ready already folds in probe failures (detect.py).
-        problems = [n for n in accel if not n.effectively_ready]
-        omitted_healthy = len(accel) - len(problems)
-        # A mass outage must not overflow Slack's message limits either.
-        listed = problems[:30]
-        omitted_problems = len(problems) - len(listed)
+    # above the threshold only problem nodes are listed — and a mass outage
+    # must not overflow Slack either, hence the cap (_cap_listing).
+    # effectively_ready already folds in probe failures (detect.py).
+    listed, omitted_problems, omitted_healthy = _cap_listing(
+        accel, lambda n: not n.effectively_ready, threshold=20
+    )
     for n in listed:
         keys = ", ".join(f"{k}:{v}" for k, v in sorted(n.breakdown.items()))
         line = f"• `{n.name}`: {_status(n)}, devices: {n.accelerators} ({keys})"
@@ -220,13 +233,9 @@ def format_slack_message(
         lines.append(f"• … {omitted_healthy} healthy nodes omitted")
     # Same scaling policy as the node bullets: a pool of many single-host
     # slices must not bury the signal or overflow Slack's limits.
-    listed_slices = list(slices)
-    omitted_ok_slices = omitted_bad_slices = 0
-    if len(listed_slices) > 12:
-        bad = [s for s in listed_slices if not s.complete]
-        omitted_ok_slices = len(listed_slices) - len(bad)
-        listed_slices = bad[:30]
-        omitted_bad_slices = len(bad) - len(listed_slices)
+    listed_slices, omitted_bad_slices, omitted_ok_slices = _cap_listing(
+        slices, lambda s: not s.complete, threshold=12
+    )
     for s in listed_slices:
         expected = s.expected_chips or s.chips
         state = "complete" if s.complete else "DEGRADED"
@@ -239,11 +248,21 @@ def format_slack_message(
         lines.append(f"• … {omitted_bad_slices} more degraded slices omitted")
     if omitted_ok_slices:
         lines.append(f"• … {omitted_ok_slices} complete slices omitted")
-    for m in multislices:
+    # Multislice groups scale with however operators label their fleet (a
+    # per-job grouping label can mint one group per workload), so they get
+    # the same cap-and-summarize policy as nodes and slices.
+    listed_ms, omitted_bad_ms, omitted_ok_ms = _cap_listing(
+        multislices, lambda m: not m.complete, threshold=12
+    )
+    for m in listed_ms:
         expected = m.expected_chips or m.chips
         state = "complete" if m.complete else "DEGRADED"
         lines.append(
             f"• multislice `{m.group}`: {len(m.slices)} slice(s), "
             f"{m.ready_chips}/{expected} chips, {state}"
         )
+    if omitted_bad_ms:
+        lines.append(f"• … {omitted_bad_ms} more degraded multislice groups omitted")
+    if omitted_ok_ms:
+        lines.append(f"• … {omitted_ok_ms} complete multislice groups omitted")
     return "\n".join(lines)
