@@ -207,3 +207,18 @@ def mixed_cluster_one_notready() -> List[dict]:
 def node_list(items: List[dict]) -> dict:
     """Wrap items the way ``GET /api/v1/nodes`` does."""
     return {"kind": "NodeList", "apiVersion": "v1", "items": items}
+
+
+def serve_http(handler_cls):
+    """Silenced, daemon-threaded HTTPServer on an ephemeral port.
+
+    Shared by every fixture that plays an HTTP endpoint (fake API server,
+    probe-report webhooks); the caller defines behavior in ``handler_cls``
+    and owns shutdown (``server.shutdown()``).
+    """
+    import threading
+    from http.server import HTTPServer
+
+    server = HTTPServer(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

@@ -229,3 +229,116 @@ class TestKubeClient:
         assert session.calls[0]["params"] == {
             "labelSelector": "cloud.google.com/gke-tpu-accelerator"
         }
+
+
+class TestStdlibSession:
+    """The default stdlib transport (requests is off the happy path)."""
+
+    @pytest.fixture
+    def http_server(self):
+        from http.server import BaseHTTPRequestHandler
+
+        seen = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def _respond(self, status, body=b'{"items": []}'):
+                seen.append(
+                    {
+                        "method": self.command,
+                        "path": self.path,
+                        "auth": self.headers.get("Authorization"),
+                        "content_type": self.headers.get("Content-Type"),
+                    }
+                )
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if "redirect" in self.path:
+                    self.send_response(302)
+                    self.send_header("Location", "http://127.0.0.1:1/elsewhere")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    seen.append({"method": self.command, "path": self.path})
+                    return
+                self._respond(404 if "missing" in self.path else 200)
+
+            def do_PATCH(self):
+                self._respond(200)
+
+            def log_message(self, *args):
+                pass
+
+        server = fx.serve_http(Handler)
+        yield f"http://127.0.0.1:{server.server_address[1]}", seen
+        server.shutdown()
+
+    def test_get_encodes_params_and_parses_json(self, http_server):
+        base, seen = http_server
+        s = cluster._StdlibSession()
+        resp = s.get(f"{base}/api/v1/nodes", params={"labelSelector": "a=b,c"}, timeout=5)
+        resp.raise_for_status()
+        assert resp.json() == {"items": []}
+        assert seen[0]["path"] == "/api/v1/nodes?labelSelector=a%3Db%2Cc"
+
+    def test_basic_auth_header(self, http_server):
+        base, seen = http_server
+        s = cluster._StdlibSession()
+        s.auth = ("user", "pass")
+        s.get(f"{base}/x", timeout=5).raise_for_status()
+        import base64
+
+        assert seen[0]["auth"] == "Basic " + base64.b64encode(b"user:pass").decode()
+
+    def test_bearer_header_via_headers_dict(self, http_server):
+        base, seen = http_server
+        s = cluster._StdlibSession()
+        s.headers["Authorization"] = "Bearer tok"
+        s.get(f"{base}/x", timeout=5)
+        assert seen[0]["auth"] == "Bearer tok"
+
+    def test_non_2xx_raises_on_raise_for_status_not_on_request(self, http_server):
+        base, _ = http_server
+        s = cluster._StdlibSession()
+        resp = s.get(f"{base}/missing", timeout=5)  # must NOT raise here
+        assert resp.status_code == 404
+        with pytest.raises(cluster.ClusterAPIError, match="HTTP 404"):
+            resp.raise_for_status()
+
+    def test_patch_preserves_content_type(self, http_server):
+        base, seen = http_server
+        s = cluster._StdlibSession()
+        s.patch(
+            f"{base}/api/v1/nodes/n",
+            data='{"spec": {"unschedulable": true}}',
+            headers={"Content-Type": "application/strategic-merge-patch+json"},
+            timeout=5,
+        ).raise_for_status()
+        assert seen[0]["method"] == "PATCH"
+        assert seen[0]["content_type"] == "application/strategic-merge-patch+json"
+
+    def test_redirects_refused_and_auth_not_resent(self, http_server):
+        # A 302 must surface as an error, never be followed: urllib's default
+        # redirect handler re-sends Authorization to the redirect target —
+        # a cluster-token leak if the API endpoint is MITM'd or misconfigured.
+        base, seen = http_server
+        s = cluster._StdlibSession()
+        s.headers["Authorization"] = "Bearer secret"
+        resp = s.get(f"{base}/redirect", timeout=5)
+        assert resp.status_code == 302
+        with pytest.raises(cluster.ClusterAPIError, match="HTTP 302"):
+            resp.raise_for_status()
+        # Exactly one request reached the server — nothing was re-sent.
+        assert len(seen) == 1
+
+    def test_tls_opener_built_once(self):
+        s = cluster._StdlibSession()
+        assert s._get_opener() is s._get_opener()
+
+    def test_kube_client_defaults_to_stdlib_session(self):
+        cfg = cluster.ClusterConfig(server="https://api:6443", token="t")
+        client = cluster.KubeClient(cfg)
+        assert isinstance(client._session, cluster._StdlibSession)
+        assert client._session.headers["Authorization"] == "Bearer t"

@@ -8,9 +8,8 @@ already-cordoned, missing-report, PATCH failure is not fatal).
 """
 
 import json
-import threading
 import time
-from http.server import BaseHTTPRequestHandler, HTTPServer
+from http.server import BaseHTTPRequestHandler
 
 import pytest
 
@@ -44,8 +43,7 @@ def fake_api(tmp_path):
         def log_message(self, *args):
             pass
 
-    server = HTTPServer(("127.0.0.1", 0), Handler)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
+    server = fx.serve_http(Handler)
     kubeconfig = tmp_path / "kubeconfig"
     kubeconfig.write_text(
         f"""

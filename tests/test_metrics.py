@@ -43,7 +43,7 @@ class TestRenderMetrics:
             "hbm_gbps": 2.2,
             "collective_busbw_gbps": 12.5,
             "ring_link_gbps": 40.0,
-            "ici_axis_ok": {"t0": True},  # non-numeric: must not be exported
+            "ici_axis_ok": {"t0": True},  # dict: exported as a labeled family
         }
         text = render_metrics(result)
         assert 'tpu_node_checker_probe_ok{level="collective"} 1.0' in text
@@ -51,11 +51,48 @@ class TestRenderMetrics:
         assert "tpu_node_checker_probe_matmul_tflops 3.9" in text
         assert "tpu_node_checker_probe_collective_busbw_gbps 12.5" in text
         assert "tpu_node_checker_probe_ring_link_gbps 40.0" in text
-        assert "ici_axis_ok" not in text
+        # The dict never leaks as a raw scalar sample; it becomes the
+        # per-axis family (test_fabric_fault_trending_families pins it).
+        assert "tpu_node_checker_probe_ici_axis_ok {" not in text
+        assert 'tpu_node_checker_probe_ici_axis_ok{axis="t0"} 1.0' in text
 
     def test_no_probe_no_probe_families(self):
         text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
         assert "tpu_node_checker_probe_ok" not in text
+
+    def test_fabric_fault_trending_families(self):
+        # VERDICT r02 #9: per-axis verdicts and named bad links as series,
+        # so fabric faults trend instead of living in one round's JSON.
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["local_probe"] = {
+            "ok": False,
+            "level": "collective",
+            "collective_ok": True,
+            "ring_ok": False,
+            "ring_bad_links": ["3->4", "7->0"],
+            "ici_axis_ok": {"t0": True, "t1": False},
+        }
+        text = render_metrics(result)
+        assert "tpu_node_checker_probe_collective_ok 1.0" in text
+        assert "tpu_node_checker_probe_ring_ok 0.0" in text
+        assert 'tpu_node_checker_probe_ici_axis_ok{axis="t0"} 1.0' in text
+        assert 'tpu_node_checker_probe_ici_axis_ok{axis="t1"} 0.0' in text
+        assert "tpu_node_checker_probe_ring_bad_links 2" in text
+        assert 'tpu_node_checker_probe_ring_bad_link{link="3->4"} 1.0' in text
+        assert 'tpu_node_checker_probe_ring_bad_link{link="7->0"} 1.0' in text
+
+    def test_healthy_ring_no_bad_link_series(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["local_probe"] = {
+            "ok": True,
+            "level": "collective",
+            "collective_ok": True,
+            "ring_ok": True,
+        }
+        text = render_metrics(result)
+        assert "tpu_node_checker_probe_ring_ok 1.0" in text
+        assert "tpu_node_checker_probe_ring_bad_link" not in text
+        assert "tpu_node_checker_probe_ici_axis_ok" not in text
 
     def test_probe_summary_families(self):
         # VERDICT r02 #5: the aggregator Deployment must be able to alert on

@@ -115,6 +115,43 @@ class TestComputeLevels:
         assert "int8_tops" not in r.details
         assert r.details.get("matmul_ok") is True  # the rest still ran
 
+    def test_chaos_env_hooks_propagate_structured_fault_details(self, monkeypatch):
+        # Full-stack chaos: inject one fault per fabric surface via the env
+        # hooks and assert the CHILD REPORT carries the structured triage
+        # fields (per-leg verdicts, named bad links, per-axis map) plus the
+        # injection stamp — the path the aggregator and metrics trend on.
+        monkeypatch.setenv("TNC_CHAOS_COLLECTIVE_LEG", "all_gather")
+        monkeypatch.setenv("TNC_CHAOS_RING_LINK", "3")
+        monkeypatch.setenv("TNC_CHAOS_AXIS", "t1")
+        r = run_local_probe(level="collective", timeout_s=300, topology="2x4")
+        assert not r.ok
+        assert r.details["chaos_injected"] == {
+            "collective_leg": "all_gather",
+            "ring_link": 3,
+            "axis": "t1",
+        }
+        assert r.details["collective_ok"] is False
+        assert r.details["collective_legs_ok"] == {
+            "psum_ok": True,
+            "all_gather_ok": False,
+            "reduce_scatter_ok": True,
+        }
+        assert r.details["ring_ok"] is False
+        assert r.details["ring_bad_links"] == ["3->4"]
+        assert "ring_err" in r.details
+        assert r.details["ici_axis_ok"] == {"t0": True, "t1": False}
+
+    def test_malformed_chaos_var_fails_loudly_with_stamp(self, monkeypatch):
+        # A bad injection value must grade failed WITH the chaos stamp and a
+        # message naming the env var — otherwise the failure reads as a
+        # hardware fault and --cordon-failed would quarantine a healthy node
+        # with nothing tying it to the injection.
+        monkeypatch.setenv("TNC_CHAOS_RING_LINK", "3->4")
+        r = run_local_probe(level="collective", timeout_s=300)
+        assert not r.ok
+        assert r.details.get("chaos_injected") == {"ring_link": "3->4"}
+        assert "TNC_CHAOS_RING_LINK" in (r.error or "")
+
     def test_collective_level_with_topology_localizes_axes(self):
         r = run_local_probe(level="collective", timeout_s=300, topology="2x4")
         assert r.ok, r.error

@@ -493,8 +493,7 @@ class TestDaemonSetLoopEndToEnd:
 
     @pytest.fixture
     def fake_api(self, tmp_path):
-        import threading
-        from http.server import BaseHTTPRequestHandler, HTTPServer
+        from http.server import BaseHTTPRequestHandler
 
         patches = []
 
@@ -510,8 +509,7 @@ class TestDaemonSetLoopEndToEnd:
             def log_message(self, *args):
                 pass
 
-        server = HTTPServer(("127.0.0.1", 0), Handler)
-        threading.Thread(target=server.serve_forever, daemon=True).start()
+        server = fx.serve_http(Handler)
         kubeconfig = tmp_path / "kubeconfig"
         kubeconfig.write_text(
             "apiVersion: v1\nkind: Config\ncurrent-context: t\n"

@@ -2,11 +2,11 @@
 
 The reference delegates this layer to the ``kubernetes`` client package
 (``load_kube_config`` check-gpu-node.py:160-169, ``client.CoreV1Api()`` :253,
-``api.list_node()`` :217).  This build ships its own thin client over
-``requests`` instead: the checker makes exactly **one** GET, so a full client
-library is dead weight on the <2 s latency budget (importing ``kubernetes``
-alone costs hundreds of ms), and raw REST dicts are exactly what the pure core
-(``tpu_node_checker.detect``) consumes.
+``api.list_node()`` :217).  This build ships its own thin client over stdlib
+``urllib`` instead: the checker makes exactly **one** GET, so a client library
+is dead weight on the <2 s latency budget (importing ``kubernetes`` costs
+hundreds of ms; even ``requests`` alone is ~200 ms), and raw REST dicts are
+exactly what the pure core (``tpu_node_checker.detect``) consumes.
 
 Config discovery preserves the reference's precedence — ``--kubeconfig`` flag →
 ``$KUBECONFIG`` (only if the path exists, check-gpu-node.py:165-167) → default
@@ -28,14 +28,11 @@ import os
 import subprocess
 import tempfile
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 # Stamped on nodes cordoned by --cordon-failed; --uncordon-recovered only
 # ever lifts cordons carrying it, so human cordons stay untouched.
 from tpu_node_checker.detect import QUARANTINE_ANNOTATION
-
-if TYPE_CHECKING:  # pragma: no cover — requests is imported lazily at runtime
-    import requests
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 DEFAULT_KUBECONFIG = os.path.join(os.path.expanduser("~"), ".kube", "config")
@@ -44,6 +41,124 @@ DEFAULT_TIMEOUT_S = 10.0
 
 class ClusterConfigError(RuntimeError):
     """Raised when no usable cluster configuration can be resolved."""
+
+
+class ClusterAPIError(RuntimeError):
+    """Non-2xx response from the API server (the stdlib transport's analog
+    of ``requests.HTTPError`` — callers rely only on the exit-1 catch-all)."""
+
+
+class _Response:
+    """Minimal requests-Response-shaped result for :class:`_StdlibSession`."""
+
+    def __init__(self, status_code: int, body: bytes, url: str):
+        self.status_code = status_code
+        self._body = body
+        self._url = url
+
+    def raise_for_status(self) -> None:
+        # Anything non-2xx is an error — INCLUDING 3xx: redirects are never
+        # followed (see _StdlibSession), because re-sending the request
+        # would forward the Authorization header to wherever the redirect
+        # points, leaking the cluster token off-host.
+        if not 200 <= self.status_code < 300:
+            snippet = self._body[:300].decode("utf-8", errors="replace")
+            raise ClusterAPIError(f"HTTP {self.status_code} from {self._url}: {snippet}")
+
+    def json(self):
+        return json.loads(self._body)
+
+
+class _StdlibSession:
+    """``requests.Session``-shaped transport over stdlib urllib.
+
+    Importing requests costs ~200 ms — more than half of what the checker
+    actually spends against its <2 s budget — to make one GET (plus an
+    opt-in PATCH).  The Slack notifier keeps requests (its retry
+    classification is pinned to requests' exception taxonomy by the
+    reference contract, check-gpu-node.py:86-99), but that import only
+    happens when a webhook is configured, off the happy path.
+
+    Attribute contract shared with requests.Session (and the test fakes):
+    ``headers`` dict, ``verify`` (True | False | CA path), ``cert``
+    ((cert, key) paths), ``auth`` ((user, password)).
+    """
+
+    def __init__(self):
+        self.headers: dict = {}
+        self.verify = True
+        self.cert: Optional[Tuple[str, str]] = None
+        self.auth: Optional[Tuple[str, str]] = None
+        self._opener = None
+
+    def _context(self):
+        import ssl
+
+        if self.verify is False:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif isinstance(self.verify, str):
+            ctx = ssl.create_default_context(cafile=self.verify)
+        else:
+            ctx = ssl.create_default_context()
+        if self.cert:
+            ctx.load_cert_chain(self.cert[0], self.cert[1])
+        return ctx
+
+    def _get_opener(self):
+        """Opener with redirects DISABLED and the TLS context cached.
+
+        Never following redirects (3xx surfaces as an error via
+        raise_for_status) is a security posture, not a convenience: the
+        default urllib redirect handler re-sends the original headers —
+        Authorization included — to wherever the redirect points, leaking
+        the cluster token off-host; the Kubernetes API never legitimately
+        redirects these calls.  The context is built once per session
+        (verify/cert are fixed at KubeClient construction), so watch-mode
+        rounds issuing several PATCHes don't re-read and re-parse the CA
+        bundle and client cert per call.
+        """
+        if self._opener is None:
+            import urllib.request
+
+            class _NoRedirect(urllib.request.HTTPRedirectHandler):
+                def redirect_request(self, *args, **kwargs):
+                    return None  # default handlers turn the 3xx into HTTPError
+
+            self._opener = urllib.request.build_opener(
+                _NoRedirect(), urllib.request.HTTPSHandler(context=self._context())
+            )
+        return self._opener
+
+    def _request(self, method, url, *, params=None, data=None, headers=None, timeout=None):
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        if params:
+            url = f"{url}?{urllib.parse.urlencode(params)}"
+        hdrs = {**self.headers, **(headers or {})}
+        if self.auth and "Authorization" not in hdrs:
+            cred = base64.b64encode(f"{self.auth[0]}:{self.auth[1]}".encode()).decode()
+            hdrs["Authorization"] = f"Basic {cred}"
+        body = data.encode() if isinstance(data, str) else data
+        req = urllib.request.Request(url, data=body, headers=hdrs, method=method)
+        try:
+            with self._get_opener().open(req, timeout=timeout) as raw:
+                return _Response(raw.status, raw.read(), url)
+        except urllib.error.HTTPError as exc:
+            # An HTTP error IS a response (3xx included, redirects refused);
+            # surface it through the same raise_for_status contract instead
+            # of a transport exception.
+            with exc:
+                return _Response(exc.code, exc.read(), url)
+
+    def get(self, url, params=None, timeout=None):
+        return self._request("GET", url, params=params, timeout=timeout)
+
+    def patch(self, url, data=None, headers=None, timeout=None):
+        return self._request("PATCH", url, data=data, headers=headers, timeout=timeout)
 
 
 @dataclass
@@ -233,12 +348,13 @@ class KubeClient:
     cordoning is enabled, which additionally needs the ``patch`` verb.
     """
 
-    def __init__(self, config: ClusterConfig, session: Optional["requests.Session"] = None):
+    def __init__(self, config: ClusterConfig, session=None):
         self.config = config
         if session is None:
-            import requests  # lazy: offline (--nodes-json) runs never pay the import
-
-            session = requests.Session()
+            # Stdlib transport by default (see _StdlibSession: requests'
+            # import cost has no place on the latency budget).  Anything
+            # session-shaped — including a requests.Session — drops in.
+            session = _StdlibSession()
         self._session = session
         self._session.verify = config.verify
         if config.client_cert:

@@ -163,6 +163,43 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             value = probe.get(key)
             if isinstance(value, (int, float)) and not isinstance(value, bool):
                 family(f"tpu_node_checker_{suffix}", "gauge", help_text, [({}, value)])
+        # Fabric-fault trending: boolean fabric verdicts as 0/1 gauges, the
+        # per-torus-axis localization map, and named bad ICI links — so a
+        # flapping link or a recurring sick axis shows up as a time series,
+        # not only in one round's JSON.
+        for key, suffix, help_text in (
+            ("collective_ok", "probe_collective_ok",
+             "1 when flat psum/all_gather/reduce-scatter verified."),
+            ("ring_ok", "probe_ring_ok",
+             "1 when the ppermute ring walk returned every payload."),
+        ):
+            value = probe.get(key)
+            if isinstance(value, bool):
+                family(f"tpu_node_checker_{suffix}", "gauge", help_text,
+                       [({}, 1.0 if value else 0.0)])
+        axis_ok = probe.get("ici_axis_ok")
+        if isinstance(axis_ok, dict) and axis_ok:
+            family(
+                "tpu_node_checker_probe_ici_axis_ok",
+                "gauge",
+                "Per-ICI-torus-dimension psum verdict (0 names the sick axis).",
+                [({"axis": a}, 1.0 if ok else 0.0) for a, ok in sorted(axis_ok.items())],
+            )
+        bad_links = probe.get("ring_bad_links")
+        if isinstance(bad_links, list):
+            family(
+                "tpu_node_checker_probe_ring_bad_links",
+                "gauge",
+                "ICI links the single-hop diagnostic named as corrupting.",
+                [({}, len(bad_links))],
+            )
+            if bad_links:
+                family(
+                    "tpu_node_checker_probe_ring_bad_link",
+                    "gauge",
+                    "1 per named bad ICI link (receiver-side hop i->i+1).",
+                    [({"link": str(l)}, 1.0) for l in bad_links],
+                )
     summary = payload.get("probe_summary")
     if summary is not None:
         # Fleet chip-health roll-up under the DaemonSet pattern

@@ -102,41 +102,40 @@ def collective_probe(
                 scattered = scattered + 1.0
             return total, gathered, scattered
 
-        # Verification happens **on-device**: each leg's result is checked
-        # against its closed form and only replicated per-leg mismatch
-        # counts ever reach the host.  That is what lets the same probe run
-        # over a multi-host global mesh (--probe-distributed), where remote
-        # shards are not host-addressable and an np.asarray of a P("d")
-        # output would throw.
-        def _verify():
-            total, gathered, scattered = _legs()
-            exp_gather = jnp.arange(n, dtype=jnp.float32)[:, None]
+        # ONE collective program (also the timed one — the verification
+        # reductions must not inflate the latency the busbw figure divides
+        # by); a separate compare-only jit consumes its sharded outputs and
+        # returns replicated per-leg mismatch counts.  On-device
+        # verification of replicated verdicts is what lets the same probe
+        # run over a multi-host global mesh (--probe-distributed), where
+        # remote shards are not host-addressable and an np.asarray of a
+        # P("d") output would throw — and verifying the timed program's own
+        # outputs means the verdict covers exactly the program measured,
+        # with one collective compile instead of two.
+        from jax.sharding import NamedSharding
+
+        timed = jax.jit(sm(_legs, mesh=mesh, in_specs=(), out_specs=(P(), P("d"), P("d"))))
+        rep = NamedSharding(mesh, P())
+
+        def _check(total, gathered, scattered):
+            # Global shapes: total (1, payload) replicated; gathered
+            # (n*n, payload) — n identical per-device copies of the
+            # [0..n-1] column blocks; scattered (n, payload) — every row
+            # the full reduction.
+            exp_gather = jnp.arange(n, dtype=jnp.float32)[None, :, None]
             bad_sum = jnp.sum((jnp.abs(total - expected_sum) > 1e-3).astype(jnp.int32))
-            bad_gather = jnp.sum(
-                (jnp.abs(gathered - exp_gather) > 1e-3).astype(jnp.int32)
-            )
+            g = gathered.reshape(n, n, payload)
+            bad_gather = jnp.sum((jnp.abs(g - exp_gather) > 1e-3).astype(jnp.int32))
             bad_scatter = jnp.sum(
                 (jnp.abs(scattered - expected_sum) > 1e-3).astype(jnp.int32)
             )
-            return (
-                jax.lax.psum(bad_sum, "d"),
-                jax.lax.psum(bad_gather, "d"),
-                jax.lax.psum(bad_scatter, "d"),
-            )
+            return bad_sum, bad_gather, bad_scatter
 
-        verify = jax.jit(sm(_verify, mesh=mesh, in_specs=(), out_specs=(P(), P(), P())))
-        # The TIMED program runs the collectives alone — the verification
-        # reductions (3 compares + 3 scalar psums) must not inflate the
-        # latency the busbw figure divides by, or the telemetry would shift
-        # across tool versions on identical hardware.  Returning the sharded
-        # results keeps them live; block_until_ready never fetches them.
-        timed = jax.jit(sm(_legs, mesh=mesh, in_specs=(), out_specs=(P(), P("d"), P("d"))))
+        check = jax.jit(_check, out_shardings=(rep, rep, rep))
 
-        outs = verify()
-        jax.block_until_ready(outs)
-        sum_ok, gather_ok, scatter_ok = (int(o) == 0 for o in outs)
+        first = timed()  # compile + first pass
+        sum_ok, gather_ok, scatter_ok = (int(o) == 0 for o in check(*first))
 
-        jax.block_until_ready(timed())  # warmup: compile outside the timing
         t0 = time.perf_counter()
         for _ in range(timed_iters):
             outs = timed()
@@ -336,9 +335,15 @@ def ring_probe(
                 out = jnp.where(i == recv, out + 1.0, out)
             return out
 
-        # As in collective_probe: payloads are derived on-device from the
-        # axis index and only replicated verdicts reach the host, so the walk
-        # runs unchanged over a multi-host global mesh.
+        # As in collective_probe: ONE walk program (payloads derived
+        # on-device from the axis index) that is also the timed one; a
+        # compare-only jit consumes its sharded output and returns a
+        # replicated mismatch count, so the probe runs unchanged over a
+        # multi-host global mesh and the verdict covers exactly the program
+        # measured — the verification compare must not inflate the wall
+        # clock link_gbps divides by.
+        from jax.sharding import NamedSharding
+
         def _walk():
             i = jax.lax.axis_index("d").astype(jnp.float32)
             local = i * jnp.ones((1, payload), jnp.float32)
@@ -347,17 +352,6 @@ def ring_probe(
                 return _deliver(carry), None
 
             out, _ = jax.lax.scan(step, local, None, length=n)
-            return out, i
-
-        def _full_ring_verdict():
-            out, i = _walk()
-            bad = jnp.sum((jnp.abs(out - i) > 1e-3).astype(jnp.int32))
-            return jax.lax.psum(bad, "d")
-
-        def _full_ring_timed():
-            # The timed walk carries NO verification — the verdict's compare
-            # + psum would inflate the wall clock link_gbps divides by.
-            out, _ = _walk()
             return out
 
         def _one_hop():
@@ -372,18 +366,24 @@ def ring_probe(
             onehot = jnp.zeros((n,), jnp.int32).at[idx].set(bad)
             return jax.lax.psum(onehot, "d")
 
-        verdict = jax.jit(sm(_full_ring_verdict, mesh=mesh, in_specs=(), out_specs=P()))
-        timed = jax.jit(sm(_full_ring_timed, mesh=mesh, in_specs=(), out_specs=P("d")))
+        timed = jax.jit(sm(_walk, mesh=mesh, in_specs=(), out_specs=P("d")))
+        rep = NamedSharding(mesh, P())
+        # Global walk output row r = device r's payload, back at origin = r.
+        check = jax.jit(
+            lambda o: jnp.sum(
+                (jnp.abs(o - jnp.arange(n, dtype=jnp.float32)[:, None]) > 1e-3).astype(
+                    jnp.int32
+                )
+            ),
+            out_shardings=rep,
+        )
 
-        bad_total = verdict()
-        bad_total.block_until_ready()
-        jax.block_until_ready(timed())  # warmup: compile outside the timing
+        first = timed()  # compile + first pass
+        ok = int(check(first)) == 0
         t0 = time.perf_counter()
         out = timed()
         jax.block_until_ready(out)
         latency_us = (time.perf_counter() - t0) * 1e6
-
-        ok = int(bad_total) == 0
         # Every device pushes its payload one hop per step, n steps total:
         # per-hop link bandwidth ≈ payload bytes / (wall time / hops).
         # None when n == 1 — no links exist, and 0.0 would read as a dead one.

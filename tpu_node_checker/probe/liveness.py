@@ -204,13 +204,54 @@ try:
             out["ok"] = out["ok"] and soak.ok
     if level in ("collective", "workload") and out["ok"]:
         from tpu_node_checker.parallel import collective_probe, ring_probe
-        coll = collective_probe()
+        # Full-stack chaos hooks (cf. the per-probe inject_fault_* args): env
+        # driven so the WHOLE child path — probe, report schema, aggregator,
+        # metrics — can be rehearsed against a named fault on healthy
+        # hardware.  Any injection is stamped into the report: a probe that
+        # failed because an operator left a chaos var set must say so.
+        chaos = {}
+        if os.environ.get("TNC_CHAOS_COLLECTIVE_LEG"):
+            chaos["collective_leg"] = os.environ["TNC_CHAOS_COLLECTIVE_LEG"]
+        if os.environ.get("TNC_CHAOS_RING_LINK"):
+            chaos["ring_link"] = os.environ["TNC_CHAOS_RING_LINK"]
+        if os.environ.get("TNC_CHAOS_AXIS"):
+            chaos["axis"] = os.environ["TNC_CHAOS_AXIS"]
+        if chaos:
+            # Stamp BEFORE parsing/validating: a malformed chaos var must
+            # still show up in the report, or the resulting probe failure
+            # reads as a hardware fault (and --cordon-failed would act on
+            # it) with nothing tying it to the injection.  Typo'd leg/axis
+            # names fail loudly downstream (the probes validate their
+            # inject_fault_* args), never inject-nothing-silently.
+            out["chaos_injected"] = chaos
+        if "ring_link" in chaos:
+            try:
+                chaos["ring_link"] = int(chaos["ring_link"])
+            except ValueError:
+                raise ValueError(
+                    f"TNC_CHAOS_RING_LINK {chaos['ring_link']!r} is not an "
+                    "integer link index"
+                )
+        coll = collective_probe(inject_fault_leg=chaos.get("collective_leg"))
         out["collective_ok"] = coll.ok
         out["collective_latency_us"] = round(coll.latency_us, 1)
         out["collective_busbw_gbps"] = (coll.details or {}).get("busbw_gbps")
-        ring = ring_probe()
+        if not coll.ok:
+            # Per-leg verdicts for triage: a psum-only failure and an
+            # all-legs failure point at different fabric subgraphs.
+            out["collective_legs_ok"] = {
+                k: (coll.details or {}).get(k)
+                for k in ("psum_ok", "all_gather_ok", "reduce_scatter_ok")
+            }
+            out["collective_err"] = coll.error
+        ring = ring_probe(inject_fault_link=chaos.get("ring_link"))
         out["ring_ok"] = ring.ok
         out["ring_link_gbps"] = (ring.details or {}).get("link_gbps")
+        if not ring.ok:
+            # Structured link names (e.g. ["3->4"]), not just the error
+            # string: the aggregator and metrics surface trend on these.
+            out["ring_bad_links"] = (ring.details or {}).get("bad_links") or []
+            out["ring_err"] = ring.error
         out["ok"] = out["ok"] and coll.ok and ring.ok
         topo = os.environ.get("TNC_TOPOLOGY")
         if topo and "x" in topo:
@@ -219,7 +260,7 @@ try:
             # the flat verdict — localization matters MOST when the flat
             # collectives just failed.
             from tpu_node_checker.parallel import per_axis_probe
-            ax = per_axis_probe(topology=topo)
+            ax = per_axis_probe(topology=topo, inject_fault_axis=chaos.get("axis"))
             out["ici_axis_ok"] = (ax.details or {}).get("axis_ok")
             out["ici_topology"] = (ax.details or {}).get("topology")
             if not ax.ok:
