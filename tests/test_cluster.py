@@ -257,11 +257,13 @@ class TestStdlibSession:
 
             def do_GET(self):
                 if "redirect" in self.path:
+                    # Record BEFORE responding: the client-side assertion can
+                    # run the moment the response bytes land.
+                    seen.append({"method": self.command, "path": self.path})
                     self.send_response(302)
                     self.send_header("Location", "http://127.0.0.1:1/elsewhere")
                     self.send_header("Content-Length", "0")
                     self.end_headers()
-                    seen.append({"method": self.command, "path": self.path})
                     return
                 self._respond(404 if "missing" in self.path else 200)
 

@@ -539,3 +539,34 @@ class TestCordonCli:
         with pytest.raises(SystemExit):
             cli.parse_args(argv)
         assert fragment in capsys.readouterr().err
+
+
+class TestSlackCordonIntegration:
+    def test_one_shot_slack_message_carries_cordon_lines(
+        self, tmp_path, fake_api, monkeypatch, capsys
+    ):
+        from tpu_node_checker import notify
+
+        sent = {}
+
+        def fake_send(url, message, **kw):
+            sent["message"] = message
+            return True
+
+        monkeypatch.setattr(notify, "send_slack_message", fake_send)
+        nodes = _tpu_nodes(3)
+        reports = _probe_reports(
+            tmp_path, {"tpu-0": True, "tpu-1": False, "tpu-2": True}
+        )
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", reports,
+                "--cordon-failed",
+                "--slack-webhook", "https://hooks.example/x",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        assert "🚧 auto-cordoned (chip probe failed): `tpu-1`" in sent["message"]

@@ -141,6 +141,16 @@ class TestComputeLevels:
         assert "ring_err" in r.details
         assert r.details["ici_axis_ok"] == {"t0": True, "t1": False}
 
+    def test_chaos_axis_without_topology_fails_loudly(self, monkeypatch):
+        # TNC_CHAOS_AXIS with no multi-dim topology would otherwise be a
+        # silent no-op: the per-axis probe never runs, the probe grades ok,
+        # and the rehearsal "passes" while testing nothing.
+        monkeypatch.setenv("TNC_CHAOS_AXIS", "t1")
+        r = run_local_probe(level="collective", timeout_s=300)
+        assert not r.ok
+        assert r.details.get("chaos_injected") == {"axis": "t1"}
+        assert "TNC_CHAOS_AXIS" in (r.error or "")
+
     def test_malformed_chaos_var_fails_loudly_with_stamp(self, monkeypatch):
         # A bad injection value must grade failed WITH the chaos stamp and a
         # message naming the env var — otherwise the failure reads as a

@@ -131,3 +131,77 @@ class TestSlackMessage:
         msg = report.format_slack_message(accel, ready, slices)
         assert msg.count("• slice ") == 1  # only the degraded one
         assert "… 15 complete slices omitted" in msg
+
+
+class TestSlackQuarantineLines:
+    def test_cordon_actions_surface(self):
+        accel, ready, slices = _analyzed(fx.tpu_v5p_64_slice())
+        msg = report.format_slack_message(
+            accel, ready, slices, healthy=False,
+            cordon={
+                "dry_run": False,
+                "cordoned": ["gke-tpu-v5p-3"],
+                "failed": [],
+                "skipped_over_cap": ["gke-tpu-v5p-4", "gke-tpu-v5p-5"],
+            },
+        )
+        assert "🚧 auto-cordoned (chip probe failed): `gke-tpu-v5p-3`" in msg
+        assert (
+            "⚠️ cordon budget exhausted — left alone: `gke-tpu-v5p-4`, `gke-tpu-v5p-5`"
+            in msg
+        )
+
+    def test_dry_run_prefix_and_uncordon(self):
+        accel, ready, slices = _analyzed(fx.tpu_v5p_64_slice())
+        msg = report.format_slack_message(
+            accel, ready, slices, healthy=True,
+            cordon={"dry_run": True, "cordoned": ["a"], "skipped_over_cap": []},
+            uncordon={"dry_run": False, "uncordoned": ["b"], "failed": []},
+        )
+        assert "[dry-run] would auto-cordon (chip probe failed): `a`" in msg
+        assert "♻️ uncordoned (probe recovered): `b`" in msg
+
+    def test_patch_failures_surface_as_worst_state(self):
+        # A known-bad node the PATCH could not cordon is STILL accepting
+        # workloads — it must not hide in stderr/JSON.
+        accel, ready, slices = _analyzed(fx.tpu_v5p_64_slice())
+        msg = report.format_slack_message(
+            accel, ready, slices, healthy=False,
+            cordon={
+                "dry_run": False,
+                "cordoned": [],
+                "skipped_over_cap": [],
+                "failed": [{"node": "tpu-sick", "error": "403 forbidden"}],
+            },
+            uncordon={
+                "dry_run": False,
+                "uncordoned": [],
+                "failed": [{"node": "tpu-held", "error": "timeout"}],
+            },
+        )
+        assert "❌ cordon FAILED — still schedulable: `tpu-sick`" in msg
+        assert "⚠️ uncordon failed — capacity still quarantined: `tpu-held`" in msg
+
+    def test_empty_reports_add_no_lines(self):
+        accel, ready, slices = _analyzed(fx.tpu_v5p_64_slice())
+        base = report.format_slack_message(accel, ready, slices)
+        with_empty = report.format_slack_message(
+            accel, ready, slices,
+            cordon={"dry_run": False, "cordoned": [], "skipped_over_cap": []},
+            uncordon={"dry_run": False, "uncordoned": []},
+        )
+        assert with_empty == base
+
+    def test_long_name_lists_capped(self):
+        accel, ready, slices = _analyzed(fx.tpu_v5p_64_slice())
+        msg = report.format_slack_message(
+            accel, ready, slices,
+            cordon={
+                "dry_run": False,
+                "cordoned": [f"node-{i:02d}" for i in range(14)],
+                "skipped_over_cap": [],
+            },
+        )
+        assert "`node-09`" in msg
+        assert "`node-10`" not in msg
+        assert "(+4 more)" in msg

@@ -709,7 +709,13 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
         webhook, getattr(args, "slack_only_on_error", False), healthy
     ):
         message = report.format_slack_message(
-            accel, ready, slices, healthy=healthy, multislices=result.multislices
+            accel,
+            ready,
+            slices,
+            healthy=healthy,
+            multislices=result.multislices,
+            cordon=result.payload.get("cordon"),
+            uncordon=result.payload.get("uncordon"),
         )
         sent = notify.send_slack_message(
             webhook,

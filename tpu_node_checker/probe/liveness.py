@@ -254,6 +254,16 @@ try:
             out["ring_err"] = ring.error
         out["ok"] = out["ok"] and coll.ok and ring.ok
         topo = os.environ.get("TNC_TOPOLOGY")
+        if "axis" in chaos and not (topo and "x" in topo):
+            # Same never-inject-nothing-silently rule as typo'd leg names: an
+            # axis injection with no multi-dim topology means the per-axis
+            # probe will not run at all, and the rehearsal would "pass"
+            # while testing nothing.
+            raise ValueError(
+                f"TNC_CHAOS_AXIS={chaos['axis']!r} requested but no multi-dim "
+                f"topology is set (TNC_TOPOLOGY={topo!r}); the per-axis probe "
+                "will not run"
+            )
         if topo and "x" in topo:
             # Multi-dim topology label: probe each ICI torus dimension
             # separately so a fault names the sick axis.  Runs regardless of

@@ -184,12 +184,21 @@ def _cap_listing(items, is_problem, threshold: int, cap: int = 30):
     return listed, omitted_problems, omitted_healthy
 
 
+def _named_list(names: Sequence[str], cap: int = 10) -> str:
+    """Backticked name list, capped: `a`, `b` … (+N more)."""
+    shown = [f"`{n}`" for n in names[:cap]]
+    extra = len(names) - len(shown)
+    return ", ".join(shown) + (f" (+{extra} more)" if extra > 0 else "")
+
+
 def format_slack_message(
     accel: Sequence[NodeInfo],
     ready: Sequence[NodeInfo],
     slices: Sequence[SliceInfo] = (),
     healthy: Optional[bool] = None,
     multislices: Sequence = (),
+    cordon: Optional[dict] = None,
+    uncordon: Optional[dict] = None,
 ) -> str:
     """Slack mrkdwn message.
 
@@ -265,4 +274,37 @@ def format_slack_message(
         lines.append(f"• … {omitted_bad_ms} more degraded multislice groups omitted")
     if omitted_ok_ms:
         lines.append(f"• … {omitted_ok_ms} complete multislice groups omitted")
+    # Quarantine actions taken this round: scheduling capacity changed (or
+    # would have, under dry-run) — exactly what an operator wants pushed,
+    # not discovered later in a JSON log.
+    if cordon:
+        prefix = "[dry-run] would auto-cordon" if cordon.get("dry_run") else "auto-cordoned"
+        if cordon.get("cordoned"):
+            lines.append(
+                f"🚧 {prefix} (chip probe failed): {_named_list(cordon['cordoned'])}"
+            )
+        if cordon.get("skipped_over_cap"):
+            lines.append(
+                f"⚠️ cordon budget exhausted — left alone: "
+                f"{_named_list(cordon['skipped_over_cap'])}"
+            )
+        if cordon.get("failed"):
+            # The worst state: a known-bad node the PATCH could not cordon is
+            # STILL accepting workloads — it must not hide in stderr/JSON.
+            names = [f.get("node", "?") for f in cordon["failed"]]
+            lines.append(
+                f"❌ cordon FAILED — still schedulable: {_named_list(names)}"
+            )
+    if uncordon:
+        prefix = "[dry-run] would uncordon" if uncordon.get("dry_run") else "uncordoned"
+        if uncordon.get("uncordoned"):
+            lines.append(
+                f"♻️ {prefix} (probe recovered): {_named_list(uncordon['uncordoned'])}"
+            )
+        if uncordon.get("failed"):
+            names = [f.get("node", "?") for f in uncordon["failed"]]
+            lines.append(
+                f"⚠️ uncordon failed — capacity still quarantined: "
+                f"{_named_list(names)}"
+            )
     return "\n".join(lines)
