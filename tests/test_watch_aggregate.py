@@ -658,3 +658,121 @@ class TestDaemonSetLoopEndToEnd:
         finally:
             emitter.kill()
             emitter.wait()
+
+
+class TestReportFreshLiveness:
+    """--report-fresh: the emitter pod's exec livenessProbe verdict."""
+
+    def _write(self, tmp_path, age_s=0.0, body=None):
+        import time
+
+        p = tmp_path / "host.json"
+        doc = body if body is not None else {
+            "ok": True, "hostname": "h", "written_at": time.time() - age_s,
+        }
+        p.write_text(json.dumps(doc) if isinstance(doc, dict) else doc)
+        return str(p)
+
+    def test_fresh_report_exits_0(self, tmp_path, capsys):
+        path = self._write(tmp_path, age_s=1.0)
+        assert cli.main(["--report-fresh", path]) == 0
+
+    def test_stale_report_exits_1(self, tmp_path, capsys):
+        path = self._write(tmp_path, age_s=50.0)
+        code = cli.main(["--report-fresh", path, "--probe-results-max-age", "10"])
+        assert code == 1
+        assert "stale" in capsys.readouterr().err
+
+    def test_missing_or_malformed_exits_1(self, tmp_path, capsys):
+        assert cli.main(["--report-fresh", str(tmp_path / "nope.json")]) == 1
+        bad = self._write(tmp_path, body="not json {")
+        assert cli.main(["--report-fresh", bad]) == 1
+        no_anchor = self._write(tmp_path, body={"ok": True})
+        assert cli.main(["--report-fresh", no_anchor]) == 1
+
+    @pytest.mark.parametrize(
+        "extra",
+        [
+            ["--emit-probe", "x"],
+            ["--probe"],
+            ["--watch", "5"],
+            ["--probe-results", "/r"],
+            ["--probe-results", "/r", "--cordon-failed"],
+            ["--probe-results", "/r", "--uncordon-recovered"],
+        ],
+    )
+    def test_runs_alone(self, extra, capsys):
+        # Combined check/emit/quarantine flags would silently do nothing
+        # (main() returns at the report-fresh branch) while the operator
+        # assumes coverage.
+        with pytest.raises(SystemExit) as exc:
+            cli.parse_args(["--report-fresh", "f.json", *extra])
+        assert exc.value.code == 2
+        assert "--report-fresh runs alone" in capsys.readouterr().err
+
+    def test_non_object_json_root_is_unreadable_not_traceback(self, tmp_path, capsys):
+        p = tmp_path / "weird.json"
+        p.write_text("[1, 2]")
+        assert cli.main(["--report-fresh", str(p)]) == 1
+        err = capsys.readouterr().err
+        assert "unreadable" in err
+        assert "Traceback" not in err
+
+
+class TestReportSchemaVersioning:
+    def test_emit_stamps_schema(self, tmp_path, capsys):
+        out = tmp_path / "host.json"
+        assert cli.main(["--emit-probe", str(out), "--probe-timeout", "120"]) == 0
+        data = json.loads(out.read_text())
+        assert data["schema"] == checker.REPORT_SCHEMA_VERSION
+        # The emitter's own report passes its own liveness check.
+        assert cli.main(["--report-fresh", str(out)]) == 0
+
+    def test_unknown_schema_major_is_refused(self, tmp_path, capsys):
+        # Rolling-upgrade skew: a report from a future emitter grades the
+        # host MISSING under required coverage, never misread.
+        import time
+
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5e-0.json").write_text(
+            json.dumps(
+                {
+                    "ok": True,
+                    "hostname": "gke-tpu-v5e-0",
+                    "schema": checker.REPORT_SCHEMA_VERSION + 1,
+                    "written_at": time.time(),
+                }
+            )
+        )
+        code = checker.one_shot(
+            args_for(
+                "--probe-results", str(reports),
+                "--probe-results-required", "--json",
+            ),
+            nodes=fx.tpu_v5e_single_host(),
+        )
+        assert code == 3
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["probe_summary"]["hosts_missing"] == ["gke-tpu-v5e-0"]
+        assert payload["probe_summary"]["hosts_reported"] == 0
+
+    def test_schemaless_report_still_accepted(self, tmp_path, capsys):
+        # Pre-versioning emitters keep working through the upgrade.
+        import time
+
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5e-0.json").write_text(
+            json.dumps(
+                {"ok": True, "hostname": "gke-tpu-v5e-0", "written_at": time.time()}
+            )
+        )
+        code = checker.one_shot(
+            args_for(
+                "--probe-results", str(reports),
+                "--probe-results-required", "--json",
+            ),
+            nodes=fx.tpu_v5e_single_host(),
+        )
+        assert code == 0

@@ -178,6 +178,18 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
         except (OSError, json.JSONDecodeError) as exc:
             print(f"Skipping unreadable probe report {path}: {exc}", file=sys.stderr)
             continue
+        schema = data.get("schema")
+        if schema is not None and schema != REPORT_SCHEMA_VERSION:
+            # Version skew during a rolling upgrade: refuse what we cannot
+            # be sure to read correctly (under --probe-results-required the
+            # host grades missing — safe direction).  Absent schema =
+            # pre-versioning emitter, accepted.
+            print(
+                f"Skipping probe report {path}: schema {schema!r} != "
+                f"{REPORT_SCHEMA_VERSION} (emitter/aggregator version skew?)",
+                file=sys.stderr,
+            )
+            continue
         age = now - float(written_at)
         if age > max_age:
             print(
@@ -518,6 +530,42 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     return result
 
 
+# Major version of the emitter→aggregator report contract.  Emitter pods and
+# the aggregator Deployment upgrade independently (a DaemonSet rollout is not
+# atomic); the aggregator refuses reports whose major it does not speak
+# rather than misreading them (missing-schema reports are accepted — the
+# pre-versioning emitters).
+REPORT_SCHEMA_VERSION = 1
+
+
+def report_fresh(path: str, max_age: float) -> int:
+    """``--report-fresh FILE``: liveness verdict on an emitter's own report.
+
+    The kubelet-facing half of emitter health: a wedged emitter process
+    (libtpu hang that outlives the child's kill-timer, stuck shared-volume
+    write) stops refreshing ``written_at``; an exec livenessProbe running
+    this flag lets the kubelet restart the pod instead of the fleet relying
+    solely on the aggregator grading the host missing.  Exit 0 = fresh.
+    """
+    try:
+        with open(path) as f:
+            # AttributeError covers valid-JSON-but-not-an-object roots
+            # ([1,2], "x"): still "unreadable", not a traceback.
+            written_at = float(json.load(f).get("written_at"))
+    except (OSError, json.JSONDecodeError, TypeError, ValueError, AttributeError) as exc:
+        print(f"probe report {path} unreadable: {exc}", file=sys.stderr)
+        return 1
+    age = time.time() - written_at
+    if age > max_age:
+        print(
+            f"probe report {path} stale: age {age:.0f}s > {max_age:.0f}s",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"probe report {path} fresh (age {age:.0f}s).", file=sys.stderr)
+    return 0
+
+
 def emit_probe(args) -> int:
     """``--emit-probe FILE``: run the local probe, write its JSON report.
 
@@ -542,6 +590,7 @@ def emit_probe(args) -> int:
         dist_init_timeout_s=getattr(args, "probe_rendezvous_timeout", None),
     )
     doc = probed.to_dict()
+    doc["schema"] = REPORT_SCHEMA_VERSION  # aggregator contract version
     doc["written_at"] = time.time()  # staleness anchor for the aggregator
     payload = json.dumps(doc, ensure_ascii=False, indent=2)
     target = args.emit_probe

@@ -116,6 +116,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     probe.add_argument("--probe-results", metavar="DIR",
                        help="attach per-host probe reports (written by --emit-probe on "
                        "each host) from DIR to the matching nodes")
+    probe.add_argument("--report-fresh", metavar="FILE",
+                       help="exit 0 iff FILE is a readable probe report whose "
+                       "written_at is younger than --probe-results-max-age, else 1 "
+                       "— the kubelet livenessProbe for emitter pods, so a wedged "
+                       "emitter is restarted instead of letting its report age out")
     probe.add_argument("--probe-distributed", action="store_true",
                        help="join the jax.distributed rendezvous before enumerating, so "
                        "the probe sees GLOBAL chips of a multi-host slice, verifies a "
@@ -192,6 +197,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
         p.error("--probe-results-required requires --probe-results DIR")
+    if args.report_fresh and (
+        args.emit_probe
+        or args.probe
+        or args.watch is not None
+        or args.probe_results
+        or args.cordon_failed
+        or args.uncordon_recovered
+    ):
+        # A liveness verdict must stay a liveness verdict: combined check /
+        # emit / quarantine flags would silently do nothing (main() returns
+        # at the report-fresh branch) while the operator assumes coverage —
+        # the same rule as the --emit-probe combination guards.
+        p.error(
+            "--report-fresh runs alone (no --emit-probe/--probe/--watch/"
+            "--probe-results/--cordon-failed/--uncordon-recovered)"
+        )
     for flag, on in (
         ("--cordon-failed", args.cordon_failed),
         ("--uncordon-recovered", args.uncordon_recovered),
@@ -238,6 +259,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
     try:
+        if getattr(args, "report_fresh", None):
+            return checker.report_fresh(
+                args.report_fresh, args.probe_results_max_age
+            )
         if getattr(args, "emit_probe", None):
             if args.watch is not None:
                 # Periodic re-emission — the DaemonSet pattern: keep the
