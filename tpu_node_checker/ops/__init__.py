@@ -25,6 +25,7 @@ from tpu_node_checker.ops.flash_attention import (
 )
 from tpu_node_checker.ops.hbm import HbmResult, hbm_bandwidth_probe
 from tpu_node_checker.ops.int8_probe import Int8Result, int8_matmul_probe
+from tpu_node_checker.ops.memtest import MemtestResult, hbm_pattern_probe
 from tpu_node_checker.ops.pallas_probe import PallasProbeResult, pallas_matmul_probe
 
 __all__ = [
@@ -41,6 +42,8 @@ __all__ = [
     "hbm_bandwidth_probe",
     "Int8Result",
     "int8_matmul_probe",
+    "MemtestResult",
+    "hbm_pattern_probe",
     "PallasProbeResult",
     "pallas_matmul_probe",
 ]

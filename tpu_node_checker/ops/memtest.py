@@ -1,0 +1,139 @@
+"""HBM data-integrity pattern probe — the memtest analog for TPU memory.
+
+The bandwidth probe (:mod:`tpu_node_checker.ops.hbm`) answers "how fast";
+this one answers "does the memory HOLD data".  Known bit patterns are
+written across a large HBM buffer, left to dwell, then read back and
+exact-compared.  Stuck bits, address-decoder aliasing, and retention faults
+corrupt specific words — invisible inside a bandwidth figure and easily
+averaged away inside a matmul reduction, but fatal to an exact compare.
+(The reference performs no computation at all, SURVEY §2.3; among classic
+accelerator burn-in suites this is the memory-diagnostic leg.)
+
+Patterns (uint32 words):
+
+* ``0x55555555`` and ``0xAAAAAAAA`` — complementary bit checkerboards;
+  between the two rounds every bit of every word is exercised in both
+  polarities;
+* ``addr`` — word ``i`` holds a hash of ``i`` (odd-multiplier mix), so a
+  read served from the WRONG location (row/column decoder fault) is caught
+  even when every cell is individually healthy — a constant pattern cannot
+  see aliasing.
+
+TPU-first: patterns are generated, stored, and verified entirely on device
+(generation by ``iota`` + integer ops; verification reduced to one scalar
+mismatch count) — the host only ever fetches counts, never the buffer.
+The write program's output is a materialized device array, so the data
+genuinely sits in HBM across the dwell window.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+PATTERNS = ("0x55", "0xAA", "addr")
+
+
+@dataclass
+class MemtestResult:
+    ok: bool
+    mib: int
+    dwell_s: float
+    mismatches: Dict[str, int] = field(default_factory=dict)
+    elapsed_ms: float = 0.0
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d = {
+            "ok": self.ok,
+            "mib": self.mib,
+            "dwell_s": self.dwell_s,
+            "mismatches": dict(self.mismatches),
+            "elapsed_ms": round(self.elapsed_ms, 1),
+        }
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+def _pattern(name: str, n: int) -> jax.Array:
+    """Device-side pattern generator (traced inside both jitted programs)."""
+    if name == "0x55":
+        return jnp.full((n,), 0x55555555, jnp.uint32)
+    if name == "0xAA":
+        return jnp.full((n,), 0xAAAAAAAA, jnp.uint32)
+    if name == "addr":
+        i = jax.lax.iota(jnp.uint32, n)
+        # Odd-multiplier integer mix (Knuth 2654435761 + golden-ratio xor):
+        # distinct per address, cheap, and bijective in the low bits.
+        return (i * jnp.uint32(2654435761)) ^ jnp.uint32(0x9E3779B9)
+    raise ValueError(f"unknown memtest pattern {name!r}; expected one of {PATTERNS}")
+
+
+@partial(jax.jit, static_argnames=("name", "n"))
+def _write(name: str, n: int) -> jax.Array:
+    return _pattern(name, n)
+
+
+@partial(jax.jit, static_argnames=("name",))
+def _verify(name: str, x: jax.Array) -> jax.Array:
+    # Regenerate the expectation on device and count mismatching words.  No
+    # buffer donation: the CPU backend can't honor it (warning noise), and
+    # the per-pattern buffer is dropped right after this call anyway.
+    expected = _pattern(name, x.shape[0])
+    return jnp.sum((x != expected).astype(jnp.int32))
+
+
+def hbm_pattern_probe(
+    mib: int = 64,
+    dwell_s: float = 0.2,
+    device: Optional[jax.Device] = None,
+) -> MemtestResult:
+    """Write/dwell/verify each pattern over a ``mib``-MiB uint32 buffer.
+
+    ``ok`` ⇔ zero mismatching words across all patterns.  ``dwell_s`` is the
+    hold time between write and readback (retention window); the probe's
+    wall clock is ~``len(PATTERNS) * dwell_s`` plus two memory passes per
+    pattern, so defaults stay well inside the compute-level budget.
+    """
+    try:
+        if mib <= 0 or dwell_s < 0:
+            return MemtestResult(
+                ok=False, mib=mib, dwell_s=dwell_s,
+                error=f"invalid args mib={mib} dwell_s={dwell_s}",
+            )
+        device = device or jax.local_devices()[0]
+        n = (mib * 1024 * 1024) // 4
+        t0 = time.perf_counter()
+        mismatches: Dict[str, int] = {}
+        with jax.default_device(device):
+            for name in PATTERNS:
+                buf = _write(name, n)
+                buf.block_until_ready()  # pattern is resident before the dwell
+                if dwell_s:
+                    time.sleep(dwell_s)
+                mismatches[name] = int(_verify(name, buf))
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        bad = {k: v for k, v in mismatches.items() if v}
+        return MemtestResult(
+            ok=not bad,
+            mib=mib,
+            dwell_s=dwell_s,
+            mismatches=mismatches,
+            elapsed_ms=elapsed_ms,
+            error=None
+            if not bad
+            else (
+                "HBM pattern mismatch (stuck bits / aliasing / retention?): "
+                + ", ".join(f"{k}={v} words" for k, v in bad.items())
+            ),
+        )
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return MemtestResult(
+            ok=False, mib=mib, dwell_s=dwell_s, error=f"{type(exc).__name__}: {exc}"
+        )

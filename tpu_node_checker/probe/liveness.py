@@ -12,9 +12,10 @@ Probe levels (each includes the previous):
 
 * ``enumerate``  — backend init + device enumeration (platform, chip count);
 * ``compute``    — MXU matmul burn (bf16) + exact-integer int8 MXU check,
-                   HBM bandwidth sample, and Pallas/Mosaic kernel
-                   cross-checks (tiled matmul + flash attention) on one
-                   chip (:mod:`tpu_node_checker.ops`);
+                   HBM bandwidth sample + data-integrity pattern memtest,
+                   DMA stream, and Pallas/Mosaic kernel cross-checks (tiled
+                   matmul + flash attention) on one chip
+                   (:mod:`tpu_node_checker.ops`);
 * ``collective`` — psum/all_gather/reduce-scatter and a ppermute ring walk
                    over all local chips (:mod:`tpu_node_checker.parallel`),
                    exercising ICI;
@@ -181,9 +182,18 @@ try:
         dma = dma_stream_probe()
         out["dma_ok"] = dma.ok
         out["dma_gbps"] = round(dma.gbps, 2)
+        # Data INTEGRITY, not just bandwidth: pattern write/dwell/readback
+        # catches stuck bits, decoder aliasing, and retention faults that a
+        # throughput number or a matmul reduction averages away.
+        from tpu_node_checker.ops import hbm_pattern_probe
+        mt = hbm_pattern_probe()
+        out["memtest_ok"] = mt.ok
+        if not mt.ok:
+            out["memtest_err"] = mt.error
+            out["memtest_mismatches"] = mt.mismatches
         out["ok"] = (
             out["ok"] and burn.ok and hbm.ok and pallas.ok and i8_gate
-            and fa_gate and dma.ok
+            and fa_gate and dma.ok and mt.ok
         )
         soak_s = float(os.environ.get("TNC_SOAK_S") or 0)
         if soak_s > 0 and out["ok"]:
