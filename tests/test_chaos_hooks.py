@@ -56,6 +56,22 @@ class TestRingLinkInjection:
         # ...and ONLY that link.
         assert len(r.details["bad_links"]) == 1
 
+    def test_sum_preserving_swap_is_detected_and_localized(self):
+        # The fault class position-varying payloads exist for: a link that
+        # REORDERS elements (sum unchanged) must still be caught and named —
+        # a constant payload would grade this healthy.
+        r = ring_probe(payload=16, inject_fault_link=2, inject_fault_swap=True)
+        assert not r.ok
+        assert r.details["bad_links"] == ["2->3"], r.details
+
+    def test_swap_hook_validated(self):
+        r = ring_probe(payload=16, inject_fault_swap=True)
+        assert not r.ok
+        assert "requires inject_fault_link" in r.error
+        r = ring_probe(payload=1, inject_fault_link=0, inject_fault_swap=True)
+        assert not r.ok
+        assert "payload >= 2" in r.error
+
     def test_out_of_range_link_fails_loudly(self):
         r = ring_probe(payload=16, inject_fault_link=N)
         assert not r.ok

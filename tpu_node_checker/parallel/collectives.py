@@ -17,7 +17,20 @@ Three collectives, three failure surfaces, all via ``shard_map`` over a
 :mod:`tpu_node_checker.parallel.pipeline`.)
 
 Everything is jitted with static shapes; verification compares device results
-against values computable on the host without any collective.
+against closed forms, on device.
+
+Payloads are **position-varying**: device ``i``'s element ``j`` carries the
+integer ``i + j``, not a constant vector.  A constant payload would mask an
+entire fault class — a link that permutes, swaps, or misroutes elements
+*within* a payload delivers the same constant back; with position-varying
+data any intra-payload reordering shows up in the exact compare (cf. the
+address pattern in :mod:`tpu_node_checker.ops.memtest`, which exists for
+the same reason on the HBM side).  The step is a whole ``1`` deliberately:
+every payload value and every closed-form reduction stays an integer, and
+float32 integer arithmetic is exact below 2^24 — the psum expectation
+``n(n-1)/2 + n·j`` stays exact past 4096 devices at the default payload
+(a fractional step would be rounded OFF the running sum long before that,
+falsely failing healthy large slices).
 """
 
 from __future__ import annotations
@@ -49,9 +62,10 @@ def collective_probe(
 ) -> CollectiveResult:
     """psum + all_gather + reduce-scatter over ``mesh`` (default: all local).
 
-    Device ``i`` contributes a constant vector of ``i``; psum and the
-    reduce-scatter shard must yield ``n(n-1)/2`` everywhere and the gather
-    must reproduce ``[0, ..., n-1]``.
+    Device ``i`` contributes ``i + j`` at element ``j`` (position-varying —
+    see the module docstring); psum and the reduce-scatter shard must yield
+    ``n(n-1)/2 + n·j`` at element ``j`` and the gather must reproduce every
+    origin row exactly.
 
     ``inject_fault_leg`` perturbs ONE named leg's device-side result — a
     chaos hook proving the per-leg verdict contract ("a corrupted leg is
@@ -82,9 +96,11 @@ def collective_probe(
 
         # The three collective legs, payloads derived on-device from the axis
         # index (cf. per_axis_probe) — no host-built sharded inputs.
+        col = jnp.arange(payload, dtype=jnp.float32)  # integer position row
+
         def _legs():
             i = jax.lax.axis_index("d").astype(jnp.float32)
-            local = i * jnp.ones((1, payload), jnp.float32)
+            local = i + col[None, :]  # (1, payload), element j = i + j
             total = jax.lax.psum(local, "d")
             if inject_fault_leg == "psum":
                 total = total + 1.0  # simulated reduction corruption
@@ -93,7 +109,7 @@ def collective_probe(
             if inject_fault_leg == "all_gather":
                 gathered = gathered + 1.0
             # Reduce-scatter: every device contributes the full (n, payload)
-            # matrix (rows = its constant i) and keeps one reduced row.
+            # matrix (every row its own payload) and keeps one reduced row.
             contrib = jnp.broadcast_to(local, (n, payload))
             scattered = jax.lax.psum_scatter(
                 contrib, "d", scatter_dimension=0, tiled=True
@@ -119,15 +135,20 @@ def collective_probe(
 
         def _check(total, gathered, scattered):
             # Global shapes: total (1, payload) replicated; gathered
-            # (n*n, payload) — n identical per-device copies of the
-            # [0..n-1] column blocks; scattered (n, payload) — every row
-            # the full reduction.
-            exp_gather = jnp.arange(n, dtype=jnp.float32)[None, :, None]
-            bad_sum = jnp.sum((jnp.abs(total - expected_sum) > 1e-3).astype(jnp.int32))
+            # (n*n, payload) — n identical per-device copies of the origin
+            # rows; scattered (n, payload) — every row the full reduction.
+            # Expected values carry the position-varying term: reductions
+            # gain n·col, gathered rows keep their origin's row verbatim.
+            exp_red = expected_sum + n * col[None, :]
+            exp_gather = (
+                jnp.arange(n, dtype=jnp.float32)[None, :, None]
+                + col[None, None, :]
+            )
+            bad_sum = jnp.sum((jnp.abs(total - exp_red) > 1e-3).astype(jnp.int32))
             g = gathered.reshape(n, n, payload)
             bad_gather = jnp.sum((jnp.abs(g - exp_gather) > 1e-3).astype(jnp.int32))
             bad_scatter = jnp.sum(
-                (jnp.abs(scattered - expected_sum) > 1e-3).astype(jnp.int32)
+                (jnp.abs(scattered - exp_red) > 1e-3).astype(jnp.int32)
             )
             return bad_sum, bad_gather, bad_scatter
 
@@ -244,17 +265,23 @@ def per_axis_probe(
             lin = sum(
                 (idx * s for idx, s in zip(idxs, strides)), jnp.int32(0)
             ).astype(jnp.float32)
-            local = lin * jnp.ones((payload,), jnp.float32)
+            # Position-varying payload (see module docstring): element e
+            # carries lin + e, so intra-payload reordering on a torus
+            # link is visible to the exact compare.
+            col = jnp.arange(payload, dtype=jnp.float32)
+            local = lin + col
             bad_counts = []
             for a, nm in enumerate(axis_names):
                 total = jax.lax.psum(local, nm)
                 if nm == inject_fault_axis:
                     total = total + 1.0  # simulated link corruption
-                # Σ over the axis of (lin with coordinate a set to j):
-                # s_a·(lin − c_a·stride_a) + stride_a·s_a(s_a−1)/2.
+                # Σ over the axis of ((lin with coordinate a set to k) + col):
+                # s_a·(lin − c_a·stride_a) + stride_a·s_a(s_a−1)/2 + s_a·col.
                 s_a, st_a = shape[a], strides[a]
-                expected = s_a * (lin - idxs[a].astype(jnp.float32) * st_a) + (
-                    st_a * s_a * (s_a - 1) / 2.0
+                expected = (
+                    s_a * (lin - idxs[a].astype(jnp.float32) * st_a)
+                    + st_a * s_a * (s_a - 1) / 2.0
+                    + s_a * col
                 )
                 bad = jnp.sum((jnp.abs(total - expected) > 1e-3).astype(jnp.int32))
                 bad_counts.append(jax.lax.psum(bad, axis_names))
@@ -288,7 +315,10 @@ def per_axis_probe(
 
 
 def ring_probe(
-    mesh=None, payload: int = 256, inject_fault_link: Optional[int] = None
+    mesh=None,
+    payload: int = 256,
+    inject_fault_link: Optional[int] = None,
+    inject_fault_swap: bool = False,
 ) -> CollectiveResult:
     """Walk the device ring with ``ppermute``, one hop per ``lax.scan`` step.
 
@@ -301,6 +331,10 @@ def ring_probe(
 
     ``inject_fault_link`` corrupts everything delivered over the named link
     (receiver side), proving the localization contract on healthy hardware.
+    With ``inject_fault_swap`` the corruption is a *sum-preserving* swap of
+    the payload's first two elements instead of +1.0 — the fault class
+    (element reordering on a link) that only position-varying payloads can
+    see; a constant payload would grade it healthy.
     """
     try:
         import jax
@@ -323,6 +357,10 @@ def ring_probe(
             raise ValueError(
                 f"inject_fault_link {inject_fault_link} out of range for {n} links"
             )
+        if inject_fault_swap and inject_fault_link is None:
+            raise ValueError("inject_fault_swap requires inject_fault_link")
+        if inject_fault_swap and payload < 2:
+            raise ValueError("inject_fault_swap needs payload >= 2 elements")
         recv = None if inject_fault_link is None else (inject_fault_link + 1) % n
 
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -332,21 +370,30 @@ def ring_probe(
             out = jax.lax.ppermute(carry, "d", perm)
             if recv is not None:
                 i = jax.lax.axis_index("d")
-                out = jnp.where(i == recv, out + 1.0, out)
+                if inject_fault_swap:
+                    # Sum-preserving element swap: invisible to a constant
+                    # payload, fatal to the position-varying compare.
+                    bad = out.at[:, 0].set(out[:, 1]).at[:, 1].set(out[:, 0])
+                else:
+                    bad = out + 1.0
+                out = jnp.where(i == recv, bad, out)
             return out
 
-        # As in collective_probe: ONE walk program (payloads derived
-        # on-device from the axis index) that is also the timed one; a
-        # compare-only jit consumes its sharded output and returns a
+        # As in collective_probe: ONE walk program (position-varying payloads
+        # derived on-device from the axis index — a constant vector would
+        # mask intra-payload reordering faults) that is also the timed one;
+        # a compare-only jit consumes its sharded output and returns a
         # replicated mismatch count, so the probe runs unchanged over a
         # multi-host global mesh and the verdict covers exactly the program
         # measured — the verification compare must not inflate the wall
         # clock link_gbps divides by.
         from jax.sharding import NamedSharding
 
+        col = jnp.arange(payload, dtype=jnp.float32)
+
         def _walk():
             i = jax.lax.axis_index("d").astype(jnp.float32)
-            local = i * jnp.ones((1, payload), jnp.float32)
+            local = i + col[None, :]
 
             def step(carry, _):
                 return _deliver(carry), None
@@ -355,25 +402,32 @@ def ring_probe(
             return out
 
         def _one_hop():
-            # Receiver r must hold origin (r-1)'s constant payload; a one-hot
+            # Receiver r must hold origin (r-1)'s payload verbatim; a one-hot
             # per-receiver badness vector psum-reduces to a replicated (n,)
             # map the host can read to name exact links.
             idx = jax.lax.axis_index("d")
-            local = idx.astype(jnp.float32) * jnp.ones((1, payload), jnp.float32)
+            local = idx.astype(jnp.float32) + col[None, :]
             out = _deliver(local)
-            expect = ((idx - 1) % n).astype(jnp.float32)
+            expect = ((idx - 1) % n).astype(jnp.float32) + col[None, :]
             bad = jnp.any(jnp.abs(out - expect) > 1e-3).astype(jnp.int32)
             onehot = jnp.zeros((n,), jnp.int32).at[idx].set(bad)
             return jax.lax.psum(onehot, "d")
 
         timed = jax.jit(sm(_walk, mesh=mesh, in_specs=(), out_specs=P("d")))
         rep = NamedSharding(mesh, P())
-        # Global walk output row r = device r's payload, back at origin = r.
+        # Global walk output row r = device r's payload, back at origin.
         check = jax.jit(
             lambda o: jnp.sum(
-                (jnp.abs(o - jnp.arange(n, dtype=jnp.float32)[:, None]) > 1e-3).astype(
-                    jnp.int32
-                )
+                (
+                    jnp.abs(
+                        o
+                        - (
+                            jnp.arange(n, dtype=jnp.float32)[:, None]
+                            + col[None, :]
+                        )
+                    )
+                    > 1e-3
+                ).astype(jnp.int32)
             ),
             out_shardings=rep,
         )
