@@ -776,3 +776,85 @@ class TestReportSchemaVersioning:
             nodes=fx.tpu_v5e_single_host(),
         )
         assert code == 0
+
+
+class TestKindMismatchWarning:
+    """Control-plane label vs data-plane device_kind cross-check."""
+
+    def _run(self, tmp_path, capsys, kinds, label="tpu-v5-lite-podslice"):
+        import time
+
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-x-0.json").write_text(
+            json.dumps(
+                {
+                    "ok": True,
+                    "hostname": "gke-tpu-x-0",
+                    "device_kinds": kinds,
+                    "written_at": time.time(),
+                }
+            )
+        )
+        nodes = [
+            fx.make_node(
+                "gke-tpu-x-0",
+                allocatable={"google.com/tpu": "4"},
+                labels={"cloud.google.com/gke-tpu-accelerator": label},
+            )
+        ]
+        code = checker.one_shot(
+            args_for("--probe-results", str(reports), "--json"), nodes=nodes
+        )
+        captured = capsys.readouterr()
+        return code, json.loads(captured.out), captured.err
+
+    def test_wrong_generation_flagged_but_not_failed(self, tmp_path, capsys):
+        code, payload, err = self._run(tmp_path, capsys, kinds=["TPU v4"])
+        assert code == 0  # informational: grading untouched
+        mm = payload["nodes"][0]["probe"]["kind_mismatch"]
+        assert mm["expected_kind_contains"] == "v5 lite"
+        assert mm["enumerated"] == ["TPU v4"]
+        assert "mislabeled pool or wrong image" in err
+
+    def test_in_process_probe_mismatch_shows_on_local_probe_surface(
+        self, monkeypatch, capsys
+    ):
+        # The annotation must appear on payload["local_probe"] too — the
+        # documented surface for --probe — not only on the node entry.
+        from tpu_node_checker.probe.liveness import ProbeResult
+
+        monkeypatch.setenv("NODE_NAME", "gke-tpu-v5e-0")
+        monkeypatch.setattr(
+            checker,
+            "run_local_probe",
+            lambda **kw: ProbeResult(
+                ok=True, level="enumerate", hostname="gke-tpu-v5e-0",
+                elapsed_ms=1.0, device_count=4, platform="tpu",
+                device_kinds=["TPU v4"],
+            ),
+            raising=False,
+        )
+        import tpu_node_checker.probe as probe_pkg
+
+        monkeypatch.setattr(
+            probe_pkg, "run_local_probe", checker.run_local_probe, raising=False
+        )
+        result = checker.run_check(
+            args_for("--probe", "--json"), nodes=fx.tpu_v5e_single_host()
+        )
+        assert "kind_mismatch" in result.payload["local_probe"]
+        assert "kind_mismatch" in result.payload["nodes"][0]["probe"]
+
+    def test_matching_generation_silent(self, tmp_path, capsys):
+        code, payload, err = self._run(tmp_path, capsys, kinds=["TPU v5 lite"])
+        assert code == 0
+        assert "kind_mismatch" not in payload["nodes"][0]["probe"]
+        assert "mislabeled" not in err
+
+    def test_unknown_label_never_guesses(self, tmp_path, capsys):
+        code, payload, err = self._run(
+            tmp_path, capsys, kinds=["TPU v99"], label="tpu-v99-megaslice"
+        )
+        assert code == 0
+        assert "kind_mismatch" not in payload["nodes"][0]["probe"]

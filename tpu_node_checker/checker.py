@@ -139,7 +139,51 @@ def _run_probe(
     )
     if local is not None:
         local.probe = probed.to_dict()
-    result.local_probe = probed.to_dict()
+        _flag_kind_mismatch(local)
+        # Same dict on both surfaces: the label/kind annotation must show in
+        # payload["local_probe"] too, not only on the node entry.
+        result.local_probe = local.probe
+    else:
+        result.local_probe = probed.to_dict()
+
+
+# GKE accelerator label → substring the enumerated PJRT device_kind must
+# contain.  Only KNOWN label families participate; unknown labels (new
+# generations, custom pools) stay silent rather than guess — and a mismatch
+# is a WARNING, never a failure grade: the strings come from two independent
+# vendors' surfaces and must not be able to cordon a fleet by renaming.
+_KIND_TOKENS = {
+    "tpu-v4-podslice": "v4",
+    "tpu-v5-lite-podslice": "v5 lite",
+    "tpu-v5-lite-device": "v5 lite",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v6e-slice": "v6",
+}
+
+
+def _flag_kind_mismatch(node: NodeInfo) -> None:
+    """Cross-check control plane vs data plane: the node LABEL promises one
+    TPU generation, the probe ENUMERATED another — a mislabeled pool or a
+    wrong image/driver mix.  Informational (``kind_mismatch`` on the probe
+    dict + a stderr note); kubelet/probe grading is untouched."""
+    probe = node.probe or {}
+    kinds = probe.get("device_kinds") or []
+    token = _KIND_TOKENS.get(node.tpu_accelerator or "")
+    if not token or not kinds:
+        return
+    if any(token in str(k).lower() for k in kinds):
+        return
+    probe["kind_mismatch"] = {
+        "label": node.tpu_accelerator,
+        "expected_kind_contains": token,
+        "enumerated": list(kinds),
+    }
+    print(
+        f"⚠️ {node.name}: label {node.tpu_accelerator!r} promises a "
+        f"'{token}' device but the probe enumerated {kinds} — mislabeled "
+        "pool or wrong image?",
+        file=sys.stderr,
+    )
 
 
 def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
@@ -201,6 +245,7 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
         node = by_name.get(hostname)
         if node is not None and node.probe is None:
             node.probe = data
+            _flag_kind_mismatch(node)
     if getattr(args, "probe_results_required", False):
         # Coverage enforcement: every TPU node must carry a FRESH report.
         # A host whose emitter wedged (stale report skipped above) or never

@@ -448,7 +448,7 @@ def ring_probe(
         error = None
         if not ok:
             # Localization pass: after ONE hop, receiver r must hold origin
-            # r-1's constant payload; a wrong row names link (r-1)→r.  The
+            # r-1's payload verbatim; a wrong row names link (r-1)→r.  The
             # full-ring walk detects (every payload crosses every link); the
             # single hop attributes.
             one_hop = jax.jit(sm(_one_hop, mesh=mesh, in_specs=(), out_specs=P()))
