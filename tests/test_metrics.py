@@ -319,3 +319,75 @@ class TestInstanceTypeInference:
         assert s["expected_hosts"] == 16
         assert s["expected_chips"] == 64
         assert s["complete"] is False
+
+
+class TestTrendSummary:
+    """--trend FILE: post-incident analysis of the --log-jsonl record."""
+
+    def _log(self, tmp_path, entries):
+        p = tmp_path / "trend.jsonl"
+        p.write_text("\n".join(json.dumps(e) for e in entries) + "\n")
+        return str(p)
+
+    def _entries(self):
+        # 10:00 ok, 10:01 ok, 10:02 degraded, 10:03 degraded, 10:04 ok,
+        # 10:05 monitor error — availability 3/6, two outages (120s, open 0s).
+        t0 = 1_700_000_000
+        codes = [0, 0, 3, 3, 0, 1]
+        return [
+            {
+                "ts": t0 + i * 60,
+                "exit_code": c,
+                "total_chips": 256,
+                "ready_chips": 256 if c == 0 else 192,
+            }
+            for i, c in enumerate(codes)
+        ]
+
+    def test_json_summary(self, tmp_path, capsys):
+        path = self._log(tmp_path, self._entries())
+        assert cli.main(["--trend", path, "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["rounds"] == 6
+        assert s["availability_pct"] == 50.0
+        assert s["window_s"] == 300.0
+        assert s["transitions_total"] == 3  # 0→3, 3→0, 0→1
+        assert [t["to"] for t in s["transitions"]] == [3, 0, 1]
+        assert s["longest_outage_s"] == 120.0  # 10:02 → 10:04
+        assert s["last_exit_code"] == 1
+        # Chip availability: 3 rounds at 100%, 3 at 75% → 87.5%.
+        assert s["chip_availability_pct"] == 87.5
+
+    def test_human_summary(self, tmp_path, capsys):
+        path = self._log(tmp_path, self._entries())
+        assert cli.main(["--trend", path]) == 0
+        out = capsys.readouterr().out
+        assert "6 rounds over 300.0s" in out
+        assert "availability: 50.0% of rounds at exit 0" in out
+        assert "exit 0 → 3" in out
+        assert "longest outage 120.0s" in out
+
+    def test_malformed_lines_skipped_and_counted(self, tmp_path, capsys):
+        entries = self._entries()
+        p = tmp_path / "trend.jsonl"
+        lines = [json.dumps(e) for e in entries]
+        lines.insert(2, "{torn write")
+        p.write_text("\n".join(lines) + "\n")
+        assert cli.main(["--trend", str(p), "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["rounds"] == 6
+        assert s["skipped_lines"] == 1
+
+    def test_missing_or_empty_log_exits_1(self, tmp_path, capsys):
+        assert cli.main(["--trend", str(tmp_path / "nope.jsonl")]) == 1
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert cli.main(["--trend", str(empty)]) == 1
+
+    def test_runs_alone(self, capsys):
+        import pytest as _pytest
+
+        with _pytest.raises(SystemExit) as exc:
+            cli.parse_args(["--trend", "f.jsonl", "--probe"])
+        assert exc.value.code == 2
+        assert "--trend runs alone" in capsys.readouterr().err

@@ -785,7 +785,7 @@ class TestKindMismatchWarning:
         import time
 
         reports = tmp_path / "reports"
-        reports.mkdir()
+        reports.mkdir(exist_ok=True)
         (reports / "gke-tpu-x-0.json").write_text(
             json.dumps(
                 {
@@ -813,9 +813,26 @@ class TestKindMismatchWarning:
         code, payload, err = self._run(tmp_path, capsys, kinds=["TPU v4"])
         assert code == 0  # informational: grading untouched
         mm = payload["nodes"][0]["probe"]["kind_mismatch"]
-        assert mm["expected_kind_contains"] == "v5 lite"
+        assert mm["expected_generation"] == "v5e"
         assert mm["enumerated"] == ["TPU v4"]
+        assert mm["enumerated_generations"] == ["v4"]
         assert "mislabeled pool or wrong image" in err
+
+    def test_spelling_variants_both_accepted(self, tmp_path, capsys):
+        # libtpu versions disagree on the kind string ("TPU v5 lite" vs
+        # "TPU v5e"); both must match the v5e label — a runtime renaming
+        # must never flag a correctly configured fleet.
+        for kinds in (["TPU v5 lite"], ["TPU v5e"]):
+            code, payload, err = self._run(tmp_path, capsys, kinds=kinds)
+            assert code == 0
+            assert "kind_mismatch" not in payload["nodes"][0]["probe"], kinds
+
+    def test_vague_kind_string_stays_silent(self, tmp_path, capsys):
+        # "TPU v5" names no known generation (could be v5e or v5p): too
+        # vague to contradict the label, so no flag.
+        code, payload, err = self._run(tmp_path, capsys, kinds=["TPU v5"])
+        assert code == 0
+        assert "kind_mismatch" not in payload["nodes"][0]["probe"]
 
     def test_in_process_probe_mismatch_shows_on_local_probe_surface(
         self, monkeypatch, capsys

@@ -147,40 +147,63 @@ def _run_probe(
         result.local_probe = probed.to_dict()
 
 
-# GKE accelerator label → substring the enumerated PJRT device_kind must
-# contain.  Only KNOWN label families participate; unknown labels (new
-# generations, custom pools) stay silent rather than guess — and a mismatch
-# is a WARNING, never a failure grade: the strings come from two independent
-# vendors' surfaces and must not be able to cordon a fleet by renaming.
-_KIND_TOKENS = {
-    "tpu-v4-podslice": "v4",
-    "tpu-v5-lite-podslice": "v5 lite",
-    "tpu-v5-lite-device": "v5 lite",
-    "tpu-v5p-slice": "v5p",
-    "tpu-v6e-slice": "v6",
+# TPU generation detection, shared by labels and PJRT device_kind strings.
+# Spelling varies across libtpu versions ("TPU v5 lite" vs "TPU v5e"), so a
+# generation is a SET of alias substrings.  Only KNOWN generations
+# participate; unknown or too-vague strings (a bare "TPU v5" names no
+# generation here) stay silent rather than guess — a mismatch is a WARNING,
+# never a failure grade: the strings come from two independent vendors'
+# surfaces and must not be able to cordon a fleet by renaming.
+_GENERATION_ALIASES = {
+    "v4": ("v4",),
+    "v5e": ("v5 lite", "v5e", "v5lite"),
+    "v5p": ("v5p",),
+    "v6e": ("v6",),
 }
+_LABEL_GENERATION = {
+    "tpu-v4-podslice": "v4",
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v5-lite-device": "v5e",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v6e-slice": "v6e",
+}
+
+
+def _generations_of(kind: str) -> set:
+    k = str(kind).lower()
+    return {
+        gen
+        for gen, aliases in _GENERATION_ALIASES.items()
+        if any(a in k for a in aliases)
+    }
 
 
 def _flag_kind_mismatch(node: NodeInfo) -> None:
     """Cross-check control plane vs data plane: the node LABEL promises one
     TPU generation, the probe ENUMERATED another — a mislabeled pool or a
-    wrong image/driver mix.  Informational (``kind_mismatch`` on the probe
-    dict + a stderr note); kubelet/probe grading is untouched."""
+    wrong image/driver mix.  Flags only when the enumerated kind CLEARLY
+    names a different known generation (vague strings resolve to nothing
+    and stay silent).  Informational (``kind_mismatch`` on the probe dict +
+    a stderr note); kubelet/probe grading is untouched."""
     probe = node.probe or {}
     kinds = probe.get("device_kinds") or []
-    token = _KIND_TOKENS.get(node.tpu_accelerator or "")
-    if not token or not kinds:
+    expected = _LABEL_GENERATION.get(node.tpu_accelerator or "")
+    if not expected or not kinds:
         return
-    if any(token in str(k).lower() for k in kinds):
+    seen: set = set()
+    for k in kinds:
+        seen |= _generations_of(k)
+    if not seen or expected in seen:
         return
     probe["kind_mismatch"] = {
         "label": node.tpu_accelerator,
-        "expected_kind_contains": token,
+        "expected_generation": expected,
         "enumerated": list(kinds),
+        "enumerated_generations": sorted(seen),
     }
     print(
         f"⚠️ {node.name}: label {node.tpu_accelerator!r} promises a "
-        f"'{token}' device but the probe enumerated {kinds} — mislabeled "
+        f"{expected} device but the probe enumerated {kinds} — mislabeled "
         "pool or wrong image?",
         file=sys.stderr,
     )
@@ -751,6 +774,112 @@ def _recover_last_code(args) -> Optional[int]:
         if isinstance(code, int):
             return code
     return None
+
+
+def trend_summary(path: str, json_mode: bool = False) -> int:
+    """``--trend FILE``: summarize a ``--log-jsonl`` trend log.
+
+    The post-incident questions the log exists to answer — when did the
+    fleet degrade, for how long, how available was it — computed from the
+    per-round entries: availability (fraction of rounds at exit 0), every
+    state TRANSITION with its timestamp, the longest non-0 stretch, and
+    chip-level availability (mean ready/total chips).  Malformed lines are
+    skipped with a count (a crash mid-append must not sink the analysis);
+    an unreadable or empty log exits 1.
+    """
+    try:
+        with open(path) as f:
+            raw_lines = f.read().splitlines()
+    except OSError as exc:
+        print(f"trend log {path} unreadable: {exc}", file=sys.stderr)
+        return 1
+    rounds = []
+    skipped = 0
+    for line in raw_lines:
+        if not line.strip():
+            continue
+        try:
+            e = json.loads(line)
+            rounds.append((float(e["ts"]), int(e["exit_code"]), e))
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            skipped += 1
+    if not rounds:
+        print(f"trend log {path} has no usable rounds", file=sys.stderr)
+        return 1
+    rounds.sort(key=lambda r: r[0])
+    ok_rounds = sum(1 for _, code, _ in rounds if code == EXIT_OK)
+    transitions = []
+    last_code = None
+    for ts, code, _ in rounds:
+        if last_code is not None and code != last_code:
+            transitions.append({"ts": round(ts, 3), "from": last_code, "to": code})
+        last_code = code
+    # Longest stretch of consecutive non-0 rounds, measured wall-clock from
+    # the first bad round to the next good one (or the last entry).
+    longest_outage_s = 0.0
+    outage_start = None
+    for ts, code, _ in rounds:
+        if code != EXIT_OK and outage_start is None:
+            outage_start = ts
+        elif code == EXIT_OK and outage_start is not None:
+            longest_outage_s = max(longest_outage_s, ts - outage_start)
+            outage_start = None
+    if outage_start is not None:
+        longest_outage_s = max(longest_outage_s, rounds[-1][0] - outage_start)
+    chip_ratios = [
+        e["ready_chips"] / e["total_chips"]
+        for _, _, e in rounds
+        if isinstance(e.get("total_chips"), (int, float)) and e["total_chips"]
+        and isinstance(e.get("ready_chips"), (int, float))
+    ]
+    summary = {
+        "rounds": len(rounds),
+        "skipped_lines": skipped,
+        "window_s": round(rounds[-1][0] - rounds[0][0], 1),
+        "availability_pct": round(100.0 * ok_rounds / len(rounds), 2),
+        "chip_availability_pct": (
+            round(100.0 * sum(chip_ratios) / len(chip_ratios), 2)
+            if chip_ratios
+            else None
+        ),
+        "transitions": transitions[-20:],
+        "transitions_total": len(transitions),
+        "longest_outage_s": round(longest_outage_s, 1),
+        "last_exit_code": rounds[-1][1],
+        "last_ts": round(rounds[-1][0], 3),
+    }
+    if json_mode:
+        print(json.dumps(summary, ensure_ascii=False, indent=2))
+        return 0
+    import datetime
+
+    def _fmt(ts: float) -> str:
+        return datetime.datetime.fromtimestamp(ts).strftime("%Y-%m-%d %H:%M:%S")
+
+    print(
+        f"{len(rounds)} rounds over {summary['window_s']}s "
+        f"({_fmt(rounds[0][0])} → {_fmt(rounds[-1][0])})"
+        + (f", {skipped} malformed lines skipped" if skipped else "")
+    )
+    print(
+        f"availability: {summary['availability_pct']}% of rounds at exit 0"
+        + (
+            f"; chip availability {summary['chip_availability_pct']}%"
+            if summary["chip_availability_pct"] is not None
+            else ""
+        )
+    )
+    print(
+        f"state transitions: {len(transitions)}; "
+        f"longest outage {summary['longest_outage_s']}s; "
+        f"current state: exit {summary['last_exit_code']}"
+    )
+    shown = summary["transitions"]  # one truncation rule for both surfaces
+    if len(transitions) > len(shown):
+        print(f"  … {len(transitions) - len(shown)} earlier transitions omitted")
+    for t in shown:
+        print(f"  {_fmt(t['ts'])}  exit {t['from']} → {t['to']}")
+    return 0
 
 
 def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] = None) -> None:

@@ -98,6 +98,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="with --watch: serve Prometheus metrics on this port (0 = ephemeral)")
     p.add_argument("--log-jsonl", metavar="FILE",
                    help="append one JSON line per check round to FILE (trend log)")
+    p.add_argument("--trend", metavar="FILE",
+                   help="summarize a --log-jsonl trend log (availability, state "
+                   "transitions, longest outage) and exit — post-incident "
+                   "analysis; runs alone")
 
     probe = p.add_argument_group("Chip probe (data-plane liveness)")
     probe.add_argument("--probe", action="store_true",
@@ -197,6 +201,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
         p.error("--probe-results-required requires --probe-results DIR")
+    if args.trend and (
+        args.emit_probe
+        or args.probe
+        or args.watch is not None
+        or args.probe_results
+        or args.cordon_failed
+        or args.uncordon_recovered
+        or args.report_fresh
+        or args.log_jsonl
+        or args.slack_webhook
+        or args.slack_only_on_error
+        or args.strict_slices
+        or args.expected_chips
+    ):
+        # Same silent-no-op rule as --report-fresh below: a summary-only mode
+        # must not absorb check/emit/notify/quarantine flags the operator
+        # thinks ran.
+        p.error("--trend runs alone (only --json may accompany it)")
     if args.report_fresh and (
         args.emit_probe
         or args.probe
@@ -259,6 +281,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
     try:
+        if getattr(args, "trend", None):
+            return checker.trend_summary(args.trend, json_mode=args.json)
         if getattr(args, "report_fresh", None):
             return checker.report_fresh(
                 args.report_fresh, args.probe_results_max_age
