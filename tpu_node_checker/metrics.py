@@ -19,7 +19,12 @@ Exported metric families:
   bus and per-link ICI bandwidth, workload step time);
 * ``tpu_node_checker_probe_hosts{state="reported|ok|failed|missing"}`` — the
   ``--probe-results`` fleet roll-up, plus
-  ``tpu_node_checker_probe_host_unhealthy{host,state}`` naming each sick host.
+  ``tpu_node_checker_probe_host_unhealthy{host,state}`` naming each sick host;
+* ``tpu_node_checker_multislice_{complete,ready_chips,slices}{group}`` — the
+  DCN-joined multislice roll-up, when grouping labels are present;
+* ``tpu_node_checker_{cordoned,uncordoned}_nodes`` and
+  ``tpu_node_checker_cordon_skipped_over_cap`` — the quarantine lifecycle
+  (nonzero skipped-over-cap means humans must look NOW).
 """
 
 from __future__ import annotations
