@@ -130,6 +130,19 @@ class TestRenderMetrics:
         text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
         assert "tpu_node_checker_probe_hosts" not in text
 
+    def test_kind_mismatch_nodes_family(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["nodes"][3]["probe"] = {
+            "ok": True,
+            "kind_mismatch": {"expected_generation": "v5e"},
+        }
+        text = render_metrics(result)
+        assert "tpu_node_checker_kind_mismatch_nodes 1" in text
+
+    def test_no_mismatch_no_kind_family(self):
+        text = render_metrics(self._result(fx.tpu_v5e_256_slice()))
+        assert "tpu_node_checker_kind_mismatch_nodes" not in text
+
     def test_probe_summary_per_host_series_capped(self):
         # A fleet-wide emitter outage must not mint one series per node.
         result = self._result(fx.tpu_v5e_256_slice())

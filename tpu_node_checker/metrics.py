@@ -24,7 +24,9 @@ Exported metric families:
   DCN-joined multislice roll-up, when grouping labels are present;
 * ``tpu_node_checker_{cordoned,uncordoned}_nodes`` and
   ``tpu_node_checker_cordon_skipped_over_cap`` — the quarantine lifecycle
-  (nonzero skipped-over-cap means humans must look NOW).
+  (nonzero skipped-over-cap means humans must look NOW);
+* ``tpu_node_checker_kind_mismatch_nodes`` — nodes whose probed TPU
+  generation contradicts their GKE accelerator label.
 """
 
 from __future__ import annotations
@@ -205,6 +207,23 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
                     "1 per named bad ICI link (receiver-side hop i->i+1).",
                     [({"link": str(l)}, 1.0) for l in bad_links],
                 )
+    mismatched = sum(
+        1
+        for n in payload.get("nodes", [])
+        if isinstance(n.get("probe"), dict) and n["probe"].get("kind_mismatch")
+    )
+    if mismatched:
+        # Label-vs-enumerated-generation contradictions (informational in the
+        # check itself) become a trendable series so a mislabeled pool is
+        # alertable without JSON parsing.  No series when clean; a count
+        # only — the node names live in the JSON payload.
+        family(
+            "tpu_node_checker_kind_mismatch_nodes",
+            "gauge",
+            "Nodes whose probed TPU generation contradicts their GKE "
+            "accelerator label (mislabeled pool / wrong image).",
+            [({}, mismatched)],
+        )
     summary = payload.get("probe_summary")
     if summary is not None:
         # Fleet chip-health roll-up under the DaemonSet pattern
