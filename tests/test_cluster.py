@@ -335,9 +335,28 @@ class TestStdlibSession:
         # Exactly one request reached the server — nothing was re-sent.
         assert len(seen) == 1
 
-    def test_tls_opener_built_once(self):
+    def test_tls_opener_built_once_and_http_skips_tls(self):
         s = cluster._StdlibSession()
-        assert s._get_opener() is s._get_opener()
+        assert s._get_opener(True) is s._get_opener(True)
+        # Plain-http opener must not build an SSL context at all (the system
+        # CA load costs ~20 ms — a per-check tax http endpoints must not pay).
+        calls = []
+        orig = s._context
+        s._context = lambda: calls.append(1) or orig()
+        s._get_opener(False)
+        assert calls == []
+
+    def test_uppercase_scheme_uses_real_tls_opener(self, http_server):
+        # RFC 3986: the scheme is case-insensitive.  "HTTPS://…" must route
+        # to the CA-loaded opener, not the bare fail-closed one — and
+        # "HTTP://…" must still work against a plain server.
+        base, seen = http_server
+        s = cluster._StdlibSession()
+        resp = s.get(base.replace("http://", "HTTP://") + "/x", timeout=5)
+        resp.raise_for_status()
+        built = s._get_opener(True)
+        # The https-keyed opener is the _context()-built one (sanity).
+        assert s._get_opener(True) is built
 
     def test_kube_client_defaults_to_stdlib_session(self):
         cfg = cluster.ClusterConfig(server="https://api:6443", token="t")

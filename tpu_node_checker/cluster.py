@@ -89,7 +89,7 @@ class _StdlibSession:
         self.verify = True
         self.cert: Optional[Tuple[str, str]] = None
         self.auth: Optional[Tuple[str, str]] = None
-        self._opener = None
+        self._openers: dict = {}
 
     def _context(self):
         import ssl
@@ -106,7 +106,7 @@ class _StdlibSession:
             ctx.load_cert_chain(self.cert[0], self.cert[1])
         return ctx
 
-    def _get_opener(self):
+    def _get_opener(self, https: bool):
         """Opener with redirects DISABLED and the TLS context cached.
 
         Never following redirects (3xx surfaces as an error via
@@ -114,22 +114,36 @@ class _StdlibSession:
         default urllib redirect handler re-sends the original headers —
         Authorization included — to wherever the redirect points, leaking
         the cluster token off-host; the Kubernetes API never legitimately
-        redirects these calls.  The context is built once per session
-        (verify/cert are fixed at KubeClient construction), so watch-mode
-        rounds issuing several PATCHes don't re-read and re-parse the CA
-        bundle and client cert per call.
+        redirects these calls.  The context is built once per session AND
+        only for https targets: ``ssl.create_default_context()`` loads the
+        system CA store (~20 ms), which plain-http endpoints (local test
+        servers, port-forwards) must not pay per check.
         """
-        if self._opener is None:
+        if https not in self._openers:
             import urllib.request
 
             class _NoRedirect(urllib.request.HTTPRedirectHandler):
                 def redirect_request(self, *args, **kwargs):
                     return None  # default handlers turn the 3xx into HTTPError
 
-            self._opener = urllib.request.build_opener(
-                _NoRedirect(), urllib.request.HTTPSHandler(context=self._context())
-            )
-        return self._opener
+            handlers = [_NoRedirect()]
+            if https:
+                handlers.append(urllib.request.HTTPSHandler(context=self._context()))
+            else:
+                # build_opener would otherwise add a DEFAULT HTTPSHandler,
+                # whose init loads the system CA store anyway — hand it a
+                # bare context instead: costs nothing to build, and fails
+                # CLOSED (no CAs loaded) if an https URL ever reached the
+                # http opener.
+                import ssl
+
+                handlers.append(
+                    urllib.request.HTTPSHandler(
+                        context=ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                    )
+                )
+            self._openers[https] = urllib.request.build_opener(*handlers)
+        return self._openers[https]
 
     def _request(self, method, url, *, params=None, data=None, headers=None, timeout=None):
         import urllib.error
@@ -145,7 +159,10 @@ class _StdlibSession:
         body = data.encode() if isinstance(data, str) else data
         req = urllib.request.Request(url, data=body, headers=hdrs, method=method)
         try:
-            with self._get_opener().open(req, timeout=timeout) as raw:
+            # Scheme per RFC 3986 is case-insensitive; startswith("https")
+            # would route "HTTPS://…" to the no-CA opener and fail closed.
+            https = urllib.parse.urlsplit(url).scheme.lower() == "https"
+            with self._get_opener(https).open(req, timeout=timeout) as raw:
                 return _Response(raw.status, raw.read(), url)
         except urllib.error.HTTPError as exc:
             # An HTTP error IS a response (3xx included, redirects refused);
