@@ -370,6 +370,27 @@ class TestTrendSummary:
         assert s["last_exit_code"] == 1
         # Chip availability: 3 rounds at 100%, 3 at 75% → 87.5%.
         assert s["chip_availability_pct"] == 87.5
+        # Occupancy: 5 intervals of 60s charged to the EARLIER state, plus
+        # one median interval (60s) for the final round (exit 1) — an outage
+        # still in progress at the end of the log must carry weight.
+        assert s["state_seconds"] == {"0": 180.0, "1": 60.0, "3": 120.0}
+        assert s["time_weighted_availability_pct"] == 50.0
+
+    def test_slice_availability(self, tmp_path, capsys):
+        t0 = 1_700_000_000
+        entries = [
+            {"ts": t0, "exit_code": 0, "slices": 4, "slices_complete": 4},
+            {"ts": t0 + 60, "exit_code": 3, "slices": 4, "slices_complete": 2},
+        ]
+        path = self._log(tmp_path, entries)
+        assert cli.main(["--trend", path, "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["slice_availability_pct"] == 75.0  # mean of 100% and 50%
+        assert s["chip_availability_pct"] is None  # no chip fields logged
+        # A log ENDING degraded must not report inflated time-weighted
+        # availability: the trailing exit-3 round carries a median interval.
+        assert s["state_seconds"] == {"0": 60.0, "3": 60.0}
+        assert s["time_weighted_availability_pct"] == 50.0
 
     def test_human_summary(self, tmp_path, capsys):
         path = self._log(tmp_path, self._entries())

@@ -832,14 +832,49 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         if isinstance(e.get("total_chips"), (int, float)) and e["total_chips"]
         and isinstance(e.get("ready_chips"), (int, float))
     ]
+    slice_ratios = [
+        e["slices_complete"] / e["slices"]
+        for _, _, e in rounds
+        if isinstance(e.get("slices"), (int, float)) and e["slices"]
+        and isinstance(e.get("slices_complete"), (int, float))
+    ]
+    # Wall-time in each exit state: each interval between rounds is charged
+    # to the EARLIER round's state.  Round-count availability misleads when
+    # intervals vary (a slow workload-probe round should weigh its full
+    # duration).  The FINAL round has no successor, but charging it zero
+    # would hide exactly the outage that matters most — one still in
+    # progress at the end of the log — so it is charged one median interval.
+    import statistics
+
+    state_seconds: dict = {}
+    intervals = [b[0] - a[0] for a, b in zip(rounds, rounds[1:])]
+    for (_, code, _), dt in zip(rounds, intervals):
+        state_seconds[code] = state_seconds.get(code, 0.0) + dt
+    if intervals:
+        final_code = rounds[-1][1]
+        state_seconds[final_code] = state_seconds.get(
+            final_code, 0.0
+        ) + statistics.median(intervals)
+    occupancy_total = sum(state_seconds.values())
     summary = {
         "rounds": len(rounds),
         "skipped_lines": skipped,
         "window_s": round(rounds[-1][0] - rounds[0][0], 1),
         "availability_pct": round(100.0 * ok_rounds / len(rounds), 2),
+        "time_weighted_availability_pct": (
+            round(100.0 * state_seconds.get(EXIT_OK, 0.0) / occupancy_total, 2)
+            if occupancy_total > 0
+            else None
+        ),
+        "state_seconds": {str(k): round(v, 1) for k, v in sorted(state_seconds.items())},
         "chip_availability_pct": (
             round(100.0 * sum(chip_ratios) / len(chip_ratios), 2)
             if chip_ratios
+            else None
+        ),
+        "slice_availability_pct": (
+            round(100.0 * sum(slice_ratios) / len(slice_ratios), 2)
+            if slice_ratios
             else None
         ),
         "transitions": transitions[-20:],
@@ -864,8 +899,18 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
     print(
         f"availability: {summary['availability_pct']}% of rounds at exit 0"
         + (
+            f" ({summary['time_weighted_availability_pct']}% time-weighted)"
+            if summary["time_weighted_availability_pct"] is not None
+            else ""
+        )
+        + (
             f"; chip availability {summary['chip_availability_pct']}%"
             if summary["chip_availability_pct"] is not None
+            else ""
+        )
+        + (
+            f"; slice availability {summary['slice_availability_pct']}%"
+            if summary["slice_availability_pct"] is not None
             else ""
         )
     )
