@@ -113,7 +113,7 @@ users: [{{name: bench, user: {{token: bench-token}}}}]
                     "level": "compute",
                     "hostname": host,
                     "schema": 1,
-                    "written_at": time.time() + 3600,  # fresh for the whole run
+                    "written_at": time.time(),  # honest: bench runs well inside max-age
                     "device_count": 4,
                 },
                 f,

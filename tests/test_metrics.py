@@ -401,6 +401,96 @@ class TestTrendSummary:
         assert "exit 0 → 3" in out
         assert "longest outage 120.0s" in out
 
+    def test_timestamps_render_in_utc(self, tmp_path, capsys):
+        # 1_700_000_000 = 2023-11-14 22:13:20 UTC.  Local-time rendering
+        # would shift this by the host TZ and misalign incident timelines.
+        path = self._log(tmp_path, self._entries())
+        assert cli.main(["--trend", path]) == 0
+        out = capsys.readouterr().out
+        assert "2023-11-14 22:13:20Z" in out
+
+    def test_transition_names_causes(self, tmp_path, capsys):
+        # A degraded round's logged causes ride on the transition line, so
+        # --trend answers WHICH slice caused the outage, not only when.
+        t0 = 1_700_000_000
+        entries = [
+            {"ts": t0, "exit_code": 0},
+            {"ts": t0 + 60, "exit_code": 3,
+             "causes": ["slice pool-a: 14/16 hosts ready", "probe-failed: h3"]},
+            {"ts": t0 + 120, "exit_code": 0},
+        ]
+        path = self._log(tmp_path, entries)
+        assert cli.main(["--trend", path, "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["transitions"][0]["causes"] == [
+            "slice pool-a: 14/16 hosts ready", "probe-failed: h3"
+        ]
+        assert "causes" not in s["transitions"][1]  # recovery needs none
+        assert cli.main(["--trend", path]) == 0
+        out = capsys.readouterr().out
+        assert "(slice pool-a: 14/16 hosts ready; probe-failed: h3)" in out
+
+    def test_monitor_error_transition_carries_error(self, tmp_path, capsys):
+        t0 = 1_700_000_000
+        entries = [
+            {"ts": t0, "exit_code": 0},
+            {"ts": t0 + 60, "exit_code": 1, "error": "API unreachable"},
+        ]
+        path = self._log(tmp_path, entries)
+        assert cli.main(["--trend", path, "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["transitions"][0]["causes"] == ["monitor error: API unreachable"]
+
+    def test_degraded_round_logs_causes_end_to_end(self, tmp_path, capsys):
+        # one_shot on a degraded fixture must write a causes list that names
+        # the incomplete slice — the log's payload had the names all along.
+        log = tmp_path / "log.jsonl"
+        code = checker.one_shot(
+            args_for("--strict-slices", "--log-jsonl", str(log)),
+            nodes=fx.tpu_v5p_64_slice(not_ready=2),
+        )
+        assert code == 3
+        entry = json.loads(log.read_text().splitlines()[-1])
+        assert any("slice" in c and "hosts ready" in c for c in entry["causes"])
+        capsys.readouterr()
+
+    def test_capacity_shortfall_logs_cause(self, tmp_path, capsys):
+        # --expected-chips outage: every PRESENT node is Ready and every
+        # present slice complete (the missing nodepool is invisible), so the
+        # capacity assertion itself must supply the cause line.
+        log = tmp_path / "log.jsonl"
+        code = checker.one_shot(
+            args_for(
+                "--expected-chips", "google.com/tpu=256",
+                "--log-jsonl", str(log),
+            ),
+            nodes=fx.tpu_v5e_single_host(),
+        )
+        assert code == 3
+        entry = json.loads(log.read_text().splitlines()[-1])
+        assert any("expected ≥256 google.com/tpu chips" in c for c in entry["causes"])
+        capsys.readouterr()
+
+    def test_no_accel_nodes_logs_cause(self, tmp_path, capsys):
+        log = tmp_path / "log.jsonl"
+        code = checker.one_shot(
+            args_for("--log-jsonl", str(log)), nodes=fx.cpu_only_cluster()
+        )
+        assert code == 2
+        entry = json.loads(log.read_text().splitlines()[-1])
+        assert entry["causes"] == ["no accelerator nodes"]
+        capsys.readouterr()
+
+    def test_healthy_round_logs_no_causes(self, tmp_path, capsys):
+        log = tmp_path / "log.jsonl"
+        code = checker.one_shot(
+            args_for("--log-jsonl", str(log)), nodes=fx.tpu_v5e_single_host()
+        )
+        assert code == 0
+        entry = json.loads(log.read_text().splitlines()[-1])
+        assert "causes" not in entry
+        capsys.readouterr()
+
     def test_malformed_lines_skipped_and_counted(self, tmp_path, capsys):
         entries = self._entries()
         p = tmp_path / "trend.jsonl"

@@ -104,11 +104,26 @@ class TestRetryStateMachine:
 
     def test_retry_delay_passed_to_sleep(self):
         sleeps = []
-        post = make_post([500, 200])
+        post = make_post(
+            [requests.exceptions.ConnectionError("Connection reset by peer"), 200]
+        )
         notify.send_slack_message(
             self.URL, "m", post=post, sleep=sleeps.append, retry_delay=7.5
         )
         assert sleeps == [7.5]
+
+    def test_non_200_retries_immediately_without_sleep(self):
+        # Reference parity (check-gpu-node.py:83-84): non-200 falls through
+        # the loop with NO sleep — retry_delay pacing belongs only to the
+        # connection-error branch (:92).  A 500-ing webhook must not add
+        # max_retries × retry_delay seconds to a watch round.
+        sleeps = []
+        post = make_post([500, 500, 500, 500])
+        assert not notify.send_slack_message(
+            self.URL, "m", post=post, sleep=sleeps.append, retry_delay=30.0
+        )
+        assert len(post.calls) == 4
+        assert sleeps == []
 
     def test_retry_count_zero_single_attempt(self):
         post = make_post([500])

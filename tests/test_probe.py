@@ -151,6 +151,19 @@ class TestComputeLevels:
         assert r.details.get("chaos_injected") == {"axis": "t1"}
         assert "TNC_CHAOS_AXIS" in (r.error or "")
 
+    def test_chaos_var_with_incapable_level_fails_loudly(self, monkeypatch):
+        # ADVICE r03: a chaos var set with --probe-level enumerate/compute
+        # used to be a silent no-op — the collective block (the only reader)
+        # never ran, no stamp, probe graded ok: the exact
+        # inject-nothing-silently failure the guards exist to prevent.
+        monkeypatch.setenv("TNC_CHAOS_COLLECTIVE_LEG", "psum")
+        for level in ("enumerate", "compute"):
+            r = run_local_probe(level=level, timeout_s=300)
+            assert not r.ok, level
+            assert r.details.get("chaos_injected") == {"collective_leg": "psum"}
+            assert "TNC_CHAOS_COLLECTIVE_LEG" in (r.error or "")
+            assert "never runs the collective legs" in (r.error or "")
+
     def test_malformed_chaos_var_fails_loudly_with_stamp(self, monkeypatch):
         # A bad injection value must grade failed WITH the chaos stamp and a
         # message naming the env var — otherwise the failure reads as a

@@ -185,6 +185,87 @@ class TestProbeResultsAggregation:
         assert all("probe" not in n for n in payload["nodes"])
         assert "Skipping stale probe report" in captured.err
 
+    def test_future_dated_report_skipped_with_skew_warning(self, tmp_path, capsys):
+        # A report written "in the future" (emitter clock skew) has negative
+        # age and would otherwise stay fresh FOREVER — defeating max-age, the
+        # exact protection it exists to provide.  Beyond the 60 s allowance
+        # it must be refused, loudly.
+        import time
+
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5p-3.json").write_text(
+            json.dumps({"ok": True, "hostname": "gke-tpu-v5p-3",
+                        "written_at": time.time() + 3600})
+        )
+        code = checker.one_shot(
+            args_for("--probe-results", str(reports), "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert code == 0
+        captured = capsys.readouterr()
+        payload = json.loads(captured.out)
+        assert all("probe" not in n for n in payload["nodes"])
+        assert "future-dated" in captured.err
+        assert payload["probe_summary"]["reports_skipped"] == {"future_skew": 1}
+
+    def test_small_clock_skew_tolerated(self, tmp_path, capsys):
+        # NTP-scale skew (a few seconds ahead) must still attach: rejecting
+        # it would flap healthy fleets whose clocks disagree by nothing.
+        import time
+
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5p-3.json").write_text(
+            json.dumps({"ok": True, "hostname": "gke-tpu-v5p-3",
+                        "written_at": time.time() + 5})
+        )
+        result = checker.run_check(
+            args_for("--probe-results", str(reports), "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert result.payload["probe_summary"]["hosts_reported"] == 1
+        assert "reports_skipped" not in result.payload["probe_summary"]
+
+    def test_non_numeric_written_at_skips_one_report_not_the_round(
+        self, tmp_path, capsys
+    ):
+        # A foreign emitter writing ISO-8601 timestamps must cost exactly its
+        # own report — the round (and every other report) proceeds.
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5p-3.json").write_text(
+            json.dumps({"ok": True, "hostname": "gke-tpu-v5p-3",
+                        "written_at": "2026-07-30T12:00:00Z"})
+        )
+        self._write_report(reports, "gke-tpu-v5p-4", ok=True)
+        result = checker.run_check(
+            args_for("--probe-results", str(reports), "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        assert result.exit_code == 0
+        summary = result.payload["probe_summary"]
+        assert summary["hosts_reported"] == 1  # only the well-formed one
+        assert summary["reports_skipped"] == {"unreadable": 1}
+        assert "Skipping unreadable probe report" in capsys.readouterr().err
+
+    def test_nan_written_at_skipped_as_unreadable(self, tmp_path, capsys):
+        # json accepts bare NaN; float() passes it through; NaN then fails
+        # BOTH freshness comparisons open — the report would vouch forever.
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5p-3.json").write_text(
+            '{"ok": true, "hostname": "gke-tpu-v5p-3", "written_at": NaN}'
+        )
+        result = checker.run_check(
+            args_for("--probe-results", str(reports), "--json"),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        summary = result.payload["probe_summary"]
+        assert summary["hosts_reported"] == 0
+        assert summary["reports_skipped"] == {"unreadable": 1}
+        assert "non-finite" in capsys.readouterr().err
+
     def test_file_report_never_overwrites_fresh_probe(self, tmp_path, monkeypatch, capsys):
         # Fresh in-process probe says FAILED; an ok=true file for the same
         # host must not resurrect the node.
@@ -690,6 +771,25 @@ class TestReportFreshLiveness:
         no_anchor = self._write(tmp_path, body={"ok": True})
         assert cli.main(["--report-fresh", no_anchor]) == 1
 
+    def test_future_dated_report_exits_1(self, tmp_path, capsys):
+        # Clock-skewed (negative-age) reports would otherwise read fresh
+        # forever; the liveness probe must fail them like stale ones.
+        path = self._write(tmp_path, age_s=-3600.0)
+        assert cli.main(["--report-fresh", path]) == 1
+        assert "future-dated" in capsys.readouterr().err
+
+    def test_small_skew_still_fresh(self, tmp_path, capsys):
+        path = self._write(tmp_path, age_s=-5.0)
+        assert cli.main(["--report-fresh", path]) == 0
+
+    def test_nan_written_at_is_unreadable_not_fresh(self, tmp_path, capsys):
+        # NaN compares False against BOTH the skew and max-age bounds, so it
+        # would grade "fresh" forever — it must fail like any unreadable
+        # anchor instead.
+        path = self._write(tmp_path, body='{"ok": true, "written_at": NaN}')
+        assert cli.main(["--report-fresh", path]) == 1
+        assert "non-finite" in capsys.readouterr().err
+
     @pytest.mark.parametrize(
         "extra",
         [
@@ -875,3 +975,29 @@ class TestKindMismatchWarning:
         )
         assert code == 0
         assert "kind_mismatch" not in payload["nodes"][0]["probe"]
+
+    def test_v6_family_aliases_are_specific(self, tmp_path, capsys):
+        # The v6e aliases must be as specific as the v5 set: "TPU v6e" and
+        # "TPU v6 lite" match a tpu-v6e-slice label, but a bare "TPU v6" (or
+        # a future "TPU v6p") resolves to NO generation — the never-guess
+        # policy; a substring 'v6' alias would let any v6-family variant
+        # silently satisfy the v6e label.
+        for kinds in (["TPU v6e"], ["TPU v6 lite"]):
+            code, payload, err = self._run(
+                tmp_path, capsys, kinds=kinds, label="tpu-v6e-slice"
+            )
+            assert code == 0
+            assert "kind_mismatch" not in payload["nodes"][0]["probe"], kinds
+        for kinds in (["TPU v6"], ["TPU v6p"]):
+            code, payload, err = self._run(
+                tmp_path, capsys, kinds=kinds, label="tpu-v6e-slice"
+            )
+            assert code == 0  # vague/unknown: silent, never a guess
+            assert "kind_mismatch" not in payload["nodes"][0]["probe"], kinds
+        # A clearly-different known generation still flags.
+        code, payload, err = self._run(
+            tmp_path, capsys, kinds=["TPU v4"], label="tpu-v6e-slice"
+        )
+        assert payload["nodes"][0]["probe"]["kind_mismatch"][
+            "expected_generation"
+        ] == "v6e"

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import fnmatch
 import json
+import math
 import os
 import sys
 import time
@@ -46,6 +47,12 @@ EXIT_OK = 0
 EXIT_ERROR = 1
 EXIT_NO_ACCEL_NODES = 2
 EXIT_NONE_READY = 3
+
+# How far in the FUTURE a probe report's written_at may sit before it is
+# rejected as clock skew.  NTP keeps fleet clocks within milliseconds; 60 s
+# tolerates a mis-stepped host without letting a future-dated report defeat
+# --probe-results-max-age (negative age stays "fresh" forever otherwise).
+CLOCK_SKEW_ALLOWANCE_S = 60.0
 
 
 @dataclass
@@ -158,7 +165,10 @@ _GENERATION_ALIASES = {
     "v4": ("v4",),
     "v5e": ("v5 lite", "v5e", "v5lite"),
     "v5p": ("v5p",),
-    "v6e": ("v6",),
+    # As specific as the v5 set: a bare "v6" (or a hypothetical future "v6p")
+    # resolves to nothing rather than satisfying a tpu-v6e-slice label —
+    # the never-guess policy that keeps vague strings silent.
+    "v6e": ("v6 lite", "v6e", "v6lite"),
 }
 _LABEL_GENERATION = {
     "tpu-v4-podslice": "v4",
@@ -209,7 +219,7 @@ def _flag_kind_mismatch(node: NodeInfo) -> None:
     )
 
 
-def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
+def _attach_probe_results(args, accel: List[NodeInfo]) -> dict:
     """Attach per-host probe reports from ``--probe-results DIR``.
 
     The multi-host pattern: a DaemonSet on the TPU pool runs
@@ -219,21 +229,32 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
 
     Safety rules (a report must never *improve* a node's grade wrongly):
 
-    * malformed files are skipped with a note;
+    * malformed files — unparseable JSON *or* a non-numeric ``written_at``
+      from a foreign emitter — are skipped with a note, never fatal to the
+      round;
     * reports older than ``--probe-results-max-age`` (by embedded
       ``written_at``, falling back to file mtime) are skipped — a wedged
       DaemonSet pod that stops rewriting its file must not keep vouching for
       dead chips;
+    * reports dated more than ``CLOCK_SKEW_ALLOWANCE_S`` in the *future* are
+      skipped too: negative age would otherwise defeat max-age forever, so a
+      dead emitter on a fast-clocked host could keep vouching for dead chips
+      indefinitely — the exact failure the staleness rule exists to prevent;
     * a node already carrying a *fresh in-process* probe verdict (``--probe``
       on this host) is never overwritten by a file.
+
+    Returns skip counts by reason (``unreadable``/``schema``/``stale``/
+    ``future_skew``) so the fleet roll-up and metrics can surface a sick
+    emitter population, not just drop its reports silently.
     """
     import glob
     import os
     import time as _time
 
+    skipped = {"unreadable": 0, "schema": 0, "stale": 0, "future_skew": 0}
     directory = getattr(args, "probe_results", None)
     if not directory:
-        return
+        return skipped
     max_age = getattr(args, "probe_results_max_age", None) or 900.0
     now = _time.time()
     by_name = {n.name: n for n in accel}
@@ -241,9 +262,16 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
         try:
             with open(path) as f:
                 data = json.load(f)
-            written_at = data.get("written_at") or os.stat(path).st_mtime
-        except (OSError, json.JSONDecodeError) as exc:
+            # ValueError/TypeError: a foreign emitter's written_at (e.g. an
+            # ISO-8601 string) must skip THIS report, not sink the round.
+            written_at = float(data.get("written_at") or os.stat(path).st_mtime)
+            if not math.isfinite(written_at):
+                # NaN compares False against BOTH the skew and max-age
+                # bounds — it would read as "fresh" forever otherwise.
+                raise ValueError(f"non-finite written_at {written_at!r}")
+        except (OSError, json.JSONDecodeError, TypeError, ValueError) as exc:
             print(f"Skipping unreadable probe report {path}: {exc}", file=sys.stderr)
+            skipped["unreadable"] += 1
             continue
         schema = data.get("schema")
         if schema is not None and schema != REPORT_SCHEMA_VERSION:
@@ -256,13 +284,24 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
                 f"{REPORT_SCHEMA_VERSION} (emitter/aggregator version skew?)",
                 file=sys.stderr,
             )
+            skipped["schema"] += 1
             continue
-        age = now - float(written_at)
+        age = now - written_at
+        if age < -CLOCK_SKEW_ALLOWANCE_S:
+            print(
+                f"Skipping future-dated probe report {path} (written "
+                f"{-age:.0f}s ahead of this host's clock; skew beyond "
+                f"{CLOCK_SKEW_ALLOWANCE_S:.0f}s — emitter clock broken?)",
+                file=sys.stderr,
+            )
+            skipped["future_skew"] += 1
+            continue
         if age > max_age:
             print(
                 f"Skipping stale probe report {path} (age {age:.0f}s > {max_age:.0f}s)",
                 file=sys.stderr,
             )
+            skipped["stale"] += 1
             continue
         hostname = data.get("hostname") or os.path.splitext(os.path.basename(path))[0]
         node = by_name.get(hostname)
@@ -282,6 +321,7 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> None:
                     "hostname": node.name,
                     "error": f"no fresh probe report in {directory}",
                 }
+    return skipped
 
 
 def _resolve_client(args, client):
@@ -483,7 +523,7 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     if getattr(args, "probe", False):
         with timer.phase("probe"):
             _run_probe(args, accel, result, slices)
-    _attach_probe_results(args, accel)
+    reports_skipped = _attach_probe_results(args, accel)
 
     # Effective readiness: kubelet Ready minus unschedulable/probe-failed hosts.
     effective_ready = [n for n in ready if n.effectively_ready]
@@ -569,6 +609,13 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
                     if n.probe is not None and n.probe.get("level") == "missing"
                 ),
             }
+            if any(reports_skipped.values()):
+                # Reports present but refused (stale / future-dated /
+                # unreadable / version skew): a sick emitter population is
+                # its own incident, distinct from hosts that never wrote.
+                payload["probe_summary"]["reports_skipped"] = {
+                    k: v for k, v in reports_skipped.items() if v
+                }
         if expected_n is not None:
             payload["expected_chips"] = expected_n
             if expected_key is not None:
@@ -620,10 +667,24 @@ def report_fresh(path: str, max_age: float) -> int:
             # AttributeError covers valid-JSON-but-not-an-object roots
             # ([1,2], "x"): still "unreadable", not a traceback.
             written_at = float(json.load(f).get("written_at"))
+        if not math.isfinite(written_at):
+            # NaN would pass both the skew and max-age comparisons — a
+            # wedged emitter writing NaN must fail its liveness probe.
+            raise ValueError(f"non-finite written_at {written_at!r}")
     except (OSError, json.JSONDecodeError, TypeError, ValueError, AttributeError) as exc:
         print(f"probe report {path} unreadable: {exc}", file=sys.stderr)
         return 1
     age = time.time() - written_at
+    if age < -CLOCK_SKEW_ALLOWANCE_S:
+        # Same skew rule as the aggregator: a future-dated report is a broken
+        # clock (or emitter), not a fresh report — and its negative age would
+        # otherwise pass this liveness check forever.
+        print(
+            f"probe report {path} future-dated: written {-age:.0f}s ahead of "
+            f"this host's clock (skew beyond {CLOCK_SKEW_ALLOWANCE_S:.0f}s)",
+            file=sys.stderr,
+        )
+        return 1
     if age > max_age:
         print(
             f"probe report {path} stale: age {age:.0f}s > {max_age:.0f}s",
@@ -810,9 +871,18 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
     ok_rounds = sum(1 for _, code, _ in rounds if code == EXIT_OK)
     transitions = []
     last_code = None
-    for ts, code, _ in rounds:
+    for ts, code, e in rounds:
         if last_code is not None and code != last_code:
-            transitions.append({"ts": round(ts, 3), "from": last_code, "to": code})
+            t = {"ts": round(ts, 3), "from": last_code, "to": code}
+            # The entering round's recorded causes (or monitor error) ride
+            # along, so a transition line names the slice/host that caused
+            # it — the question a post-incident --trend exists to answer.
+            causes = e.get("causes")
+            if isinstance(causes, list) and causes:
+                t["causes"] = [str(c) for c in causes[:_CAUSES_CAP]]
+            elif code == EXIT_ERROR and e.get("error"):
+                t["causes"] = [f"monitor error: {e['error']}"]
+            transitions.append(t)
         last_code = code
     # Longest stretch of consecutive non-0 rounds, measured wall-clock from
     # the first bad round to the next good one (or the last entry).
@@ -889,7 +959,12 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
     import datetime
 
     def _fmt(ts: float) -> str:
-        return datetime.datetime.fromtimestamp(ts).strftime("%Y-%m-%d %H:%M:%S")
+        # UTC, explicitly marked: an incident timeline must read identically
+        # from a pod and from an operator laptop in any timezone (ops
+        # convention; the bench's provenance stamps already use gmtime).
+        return datetime.datetime.fromtimestamp(
+            ts, datetime.timezone.utc
+        ).strftime("%Y-%m-%d %H:%M:%SZ")
 
     print(
         f"{len(rounds)} rounds over {summary['window_s']}s "
@@ -923,8 +998,67 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
     if len(transitions) > len(shown):
         print(f"  … {len(transitions) - len(shown)} earlier transitions omitted")
     for t in shown:
-        print(f"  {_fmt(t['ts'])}  exit {t['from']} → {t['to']}")
+        suffix = ""
+        if t.get("causes"):
+            suffix = "  (" + "; ".join(t["causes"]) + ")"
+        print(f"  {_fmt(t['ts'])}  exit {t['from']} → {t['to']}{suffix}")
     return 0
+
+
+# Cap on the per-round ``causes`` list in the trend log: enough to name the
+# blast radius, small enough that a month of rounds on a big fleet stays a
+# tail-readable log (the same capping policy as the Slack per-node bullets).
+_CAUSES_CAP = 6
+
+
+def _round_causes(payload: dict) -> List[str]:
+    """Compact, capped "what was wrong" summary for one degraded round.
+
+    The trend log records *counts* per round; post-incident, the question
+    operators actually ask is *which slice* (or host) caused the outage —
+    the payload had the names and the log used to drop them.  Ordered by
+    actionability: incomplete slices, then probe-failed / unreported hosts,
+    then sick individual nodes.
+    """
+    causes: List[str] = []
+    if not payload.get("nodes"):
+        causes.append("no accelerator nodes")
+    if payload.get("expected_chips") is not None and not payload.get(
+        "expected_chips_met"
+    ):
+        # The capacity-assertion outage (--expected-chips): a nodepool scaled
+        # to zero leaves every PRESENT node Ready and every present slice
+        # complete — nothing below would name a cause at all.
+        key = payload.get("expected_chips_key")
+        what = f"{key} chips" if key else "chips"
+        causes.append(
+            f"expected ≥{payload['expected_chips']} {what}, "
+            f"have {payload.get('expected_chips_have')}"
+        )
+    for s in payload.get("slices", []):
+        if not s.get("complete"):
+            expected = s.get("expected_hosts") or s.get("hosts")
+            causes.append(
+                f"slice {s.get('id')}: {s.get('ready_hosts')}/{expected} hosts ready"
+            )
+    summary = payload.get("probe_summary") or {}
+    for h in summary.get("hosts_failed", []):
+        causes.append(f"probe-failed: {h}")
+    for h in summary.get("hosts_missing", []):
+        causes.append(f"no probe report: {h}")
+    for n in payload.get("nodes", []):
+        if not n.get("ready"):
+            causes.append(f"not-ready: {n.get('name')}")
+        elif not n.get("schedulable", True):
+            causes.append(f"no allocatable devices: {n.get('name')}")
+        elif not summary and isinstance(n.get("probe"), dict) and not n["probe"].get("ok"):
+            # Single-host --probe runs have no fleet summary; name the host
+            # here instead (under --probe-results the summary already did).
+            causes.append(f"probe-failed: {n.get('name')}")
+    if len(causes) > _CAUSES_CAP:
+        omitted = len(causes) - (_CAUSES_CAP - 1)
+        causes = causes[: _CAUSES_CAP - 1] + [f"+{omitted} more"]
+    return causes
 
 
 def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] = None) -> None:
@@ -933,6 +1067,9 @@ def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] 
     A durable trend record for post-incident analysis — when did the slice
     degrade, how long was the API unreachable — that the print-based surface
     (the reference's only observability, SURVEY §5.5) cannot answer.
+    Degraded rounds additionally record capped ``causes`` naming the worst
+    incomplete slices / failed hosts, so ``--trend`` can answer *which*
+    slice took the fleet down, not only *when*.
     """
     path = getattr(args, "log_jsonl", None)
     if not path:
@@ -950,6 +1087,10 @@ def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] 
             slices=len(p.get("slices", [])),
             duration_ms=p.get("timings_ms", {}).get("total"),
         )
+        if result.exit_code != EXIT_OK:
+            causes = _round_causes(p)
+            if causes:
+                entry["causes"] = causes
     else:
         entry.update(exit_code=EXIT_ERROR, error=error)
     try:

@@ -241,6 +241,18 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
                 ({"state": "missing"}, len(summary.get("hosts_missing", []))),
             ],
         )
+        skipped = summary.get("reports_skipped")
+        if skipped:
+            # Refused reports by reason — a rising "future_skew" or "stale"
+            # series means emitters (or their clocks) are sick even though
+            # the aggregator keeps running.
+            family(
+                "tpu_node_checker_probe_reports_skipped",
+                "gauge",
+                "Probe report files refused this round, by reason "
+                "(stale, future_skew = clock skew, unreadable, schema).",
+                [({"reason": r}, n) for r, n in sorted(skipped.items())],
+            )
         unhealthy = [("failed", h) for h in summary.get("hosts_failed", [])] + [
             ("missing", h) for h in summary.get("hosts_missing", [])
         ]

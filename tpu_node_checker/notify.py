@@ -8,8 +8,10 @@ Re-implements ``send_slack_message`` (check-gpu-node.py:47-111),
 * retry **only** on connection errors whose message contains
   ``"Connection reset by peer"`` or ``"Connection aborted"`` (:86-99), up to
   ``max_retries`` times with ``retry_delay`` seconds between attempts;
-* HTTP non-200 responses also retry (the reference's loop falls through,
-  :83-84);
+* HTTP non-200 responses also retry, but **immediately** — the reference's
+  loop falls through with no sleep (:83-84; the ``retry_delay`` pacing lives
+  only in the connection-error branch, :92), so a 500-ing webhook costs
+  milliseconds, not ``max_retries × retry_delay`` seconds of a watch round;
 * any other exception fails immediately (:101-109);
 * success after a retry logs the attempt count (:80-82);
 * delivery failure is never fatal to the check itself (:269-271).
@@ -104,6 +106,10 @@ def send_slack_message(
                 f"(attempt {attempt}/{attempts}).",
                 file=sys.stderr,
             )
+            # Non-200 retries immediately (check-gpu-node.py:83-84): the
+            # server answered, so there is no transport to wait out — the
+            # retry_delay pacing belongs to the connection-error branch only.
+            continue
         except (requests.exceptions.ConnectionError, requests.exceptions.Timeout) as exc:
             if not _is_retryable(exc):
                 print(f"Slack delivery failed: {exc}", file=sys.stderr)

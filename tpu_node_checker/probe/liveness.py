@@ -129,6 +129,34 @@ try:
         out["distributed_psum"] = total
         out["distributed_psum_ok"] = abs(total - expected) < 1e-6
         out["ok"] = out["ok"] and out["distributed_psum_ok"]
+    # Full-stack chaos hooks (cf. the per-probe inject_fault_* args): env
+    # driven so the WHOLE child path — probe, report schema, aggregator,
+    # metrics — can be rehearsed against a named fault on healthy hardware.
+    # Read UNCONDITIONALLY, whatever the level: a chaos var set with a level
+    # that never runs the injected surface must fail loudly here, or the
+    # rehearsal "passes" while testing nothing (the same rule as typo'd leg
+    # names and axis-without-topology below).  Stamped BEFORE validating: a
+    # malformed injection must still show in the report, or its probe
+    # failure reads as a hardware fault (and --cordon-failed would act on
+    # it) with nothing tying it to the injection.
+    _CHAOS_VARS = {
+        "collective_leg": "TNC_CHAOS_COLLECTIVE_LEG",
+        "ring_link": "TNC_CHAOS_RING_LINK",
+        "axis": "TNC_CHAOS_AXIS",
+    }
+    chaos = {}
+    for key, var in _CHAOS_VARS.items():
+        if os.environ.get(var):
+            chaos[key] = os.environ[var]
+    if chaos:
+        out["chaos_injected"] = chaos
+        if level not in ("collective", "workload"):
+            raise ValueError(
+                f"{', '.join(sorted(_CHAOS_VARS[k] for k in chaos))} set but "
+                f"probe level {level!r} never runs the collective legs — the "
+                "injection would silently test nothing; use --probe-level "
+                "collective (or workload), or unset the chaos vars"
+            )
     if level in ("compute", "collective", "workload") and out["ok"]:
         from tpu_node_checker.ops import (
             hbm_bandwidth_probe,
@@ -214,26 +242,9 @@ try:
             out["ok"] = out["ok"] and soak.ok
     if level in ("collective", "workload") and out["ok"]:
         from tpu_node_checker.parallel import collective_probe, ring_probe
-        # Full-stack chaos hooks (cf. the per-probe inject_fault_* args): env
-        # driven so the WHOLE child path — probe, report schema, aggregator,
-        # metrics — can be rehearsed against a named fault on healthy
-        # hardware.  Any injection is stamped into the report: a probe that
-        # failed because an operator left a chaos var set must say so.
-        chaos = {}
-        if os.environ.get("TNC_CHAOS_COLLECTIVE_LEG"):
-            chaos["collective_leg"] = os.environ["TNC_CHAOS_COLLECTIVE_LEG"]
-        if os.environ.get("TNC_CHAOS_RING_LINK"):
-            chaos["ring_link"] = os.environ["TNC_CHAOS_RING_LINK"]
-        if os.environ.get("TNC_CHAOS_AXIS"):
-            chaos["axis"] = os.environ["TNC_CHAOS_AXIS"]
-        if chaos:
-            # Stamp BEFORE parsing/validating: a malformed chaos var must
-            # still show up in the report, or the resulting probe failure
-            # reads as a hardware fault (and --cordon-failed would act on
-            # it) with nothing tying it to the injection.  Typo'd leg/axis
-            # names fail loudly downstream (the probes validate their
-            # inject_fault_* args), never inject-nothing-silently.
-            out["chaos_injected"] = chaos
+        # chaos was read (and stamped) unconditionally above; typo'd leg/axis
+        # names fail loudly downstream (the probes validate their
+        # inject_fault_* args), never inject-nothing-silently.
         if "ring_link" in chaos:
             try:
                 chaos["ring_link"] = int(chaos["ring_link"])
