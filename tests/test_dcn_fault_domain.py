@@ -1,0 +1,191 @@
+"""DCN fault-domain localization (VERDICT r03 #2).
+
+A multislice job joins several ICI tori over the data-center network; a
+fault on the slice boundary and a fault inside a torus are different cables
+and different repairs.  The probe builds a hybrid mesh — one leading ``dcn``
+axis over slices × the per-slice ICI axes — and runs the same per-axis psum
+legs over it, so the verdict names "dcn" vs "ici axis k", plus a psum pinned
+to the dcn axis for a cross-slice bandwidth figure.
+
+CPU devices carry no ``slice_index``, so the multislice shape is rehearsed
+with ``TNC_CHAOS_SLICES=N`` (a contiguous partition, stamped via
+``chaos_injected`` like every chaos hook) — exactly what an operator uses to
+rehearse the DCN path on a single real slice.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_node_checker.parallel import (
+    axis_bandwidth_probe,
+    hybrid_mesh,
+    per_axis_probe,
+)
+from tpu_node_checker.probe.liveness import run_local_probe
+
+
+class TestHybridMesh:
+    def test_partition_with_matching_topology(self):
+        m = hybrid_mesh(num_slices=2, topology="2x2")
+        assert tuple(m.axis_names) == ("dcn", "t0", "t1")
+        assert m.devices.shape == (2, 2, 2)
+
+    def test_partition_without_topology_is_flat_per_slice(self):
+        m = hybrid_mesh(num_slices=2, topology=None)
+        assert tuple(m.axis_names) == ("dcn", "d")
+        assert m.devices.shape == (2, 4)
+
+    def test_mismatched_topology_falls_back_flat(self):
+        # "4x4" promises 16 chips/slice; 4 present → flat intra-slice axis,
+        # never a wrong-shaped torus.
+        m = hybrid_mesh(num_slices=2, topology="4x4")
+        assert tuple(m.axis_names) == ("dcn", "d")
+
+    def test_rejects_non_multislice_device_sets(self):
+        with pytest.raises(ValueError, match="not a multislice"):
+            hybrid_mesh(num_slices=None)  # CPU devices have no slice_index
+        with pytest.raises(ValueError, match=">= 2"):
+            hybrid_mesh(num_slices=1)
+        with pytest.raises(ValueError, match="partition"):
+            hybrid_mesh(num_slices=3)  # 8 % 3 != 0
+
+    def test_groups_by_real_slice_index_when_present(self):
+        import jax
+
+        class FakeDev:
+            # Minimal device stand-in: hybrid_mesh only reads these two.
+            def __init__(self, id, slice_index):
+                self.id, self.slice_index = id, slice_index
+
+        devs = [FakeDev(i, i // 2) for i in range(8)]  # 4 slices of 2
+        m = hybrid_mesh(devices=devs)
+        assert m.devices.shape == (4, 2)
+        assert [d.slice_index for d in m.devices[:, 0].flat] == [0, 1, 2, 3]
+        del jax
+
+    def test_unequal_slices_rejected(self):
+        class FakeDev:
+            def __init__(self, id, slice_index):
+                self.id, self.slice_index = id, slice_index
+
+        devs = [FakeDev(i, 0 if i < 3 else 1) for i in range(8)]
+        with pytest.raises(ValueError, match="unequal"):
+            hybrid_mesh(devices=devs)
+
+
+class TestDcnProbes:
+    def test_per_axis_over_hybrid_localizes_dcn(self):
+        m = hybrid_mesh(num_slices=2, topology="2x2")
+        r = per_axis_probe(mesh=m, inject_fault_axis="dcn")
+        assert not r.ok
+        assert r.details["axis_ok"] == {"dcn": False, "t0": True, "t1": True}
+        assert "DCN slice boundary" in r.error
+
+    def test_ici_fault_does_not_blame_dcn(self):
+        m = hybrid_mesh(num_slices=2, topology="2x2")
+        r = per_axis_probe(mesh=m, inject_fault_axis="t1")
+        assert not r.ok
+        assert r.details["axis_ok"] == {"dcn": True, "t0": True, "t1": False}
+        assert "t1" in r.error and "DCN" not in r.error
+
+    def test_axis_bandwidth_probe_verifies_and_measures(self):
+        m = hybrid_mesh(num_slices=2, topology="2x2")
+        r = axis_bandwidth_probe(m, "dcn", payload=1 << 14)
+        assert r.ok, r.error
+        assert r.details["axis"] == "dcn"
+        assert r.details["axis_size"] == 2
+        assert r.details["busbw_gbps"] is not None and r.details["busbw_gbps"] > 0
+
+    def test_axis_bandwidth_probe_unknown_axis(self):
+        m = hybrid_mesh(num_slices=2)
+        r = axis_bandwidth_probe(m, "nope")
+        assert not r.ok and "nope" in r.error
+
+    def test_exactness_at_large_payload(self):
+        # The mod-256 payload keeps every reduction an exact f32 integer
+        # even at multi-MiB payloads — a plain position index would round.
+        m = hybrid_mesh(num_slices=2, topology="2x2")
+        r = axis_bandwidth_probe(m, "dcn", payload=1 << 20)
+        assert r.ok, r.error
+
+
+class TestDcnInProbeChild:
+    """End-to-end through the subprocess child on the CPU mesh."""
+
+    def test_chaos_slices_runs_dcn_legs_and_passes(self, monkeypatch):
+        monkeypatch.setenv("TNC_CHAOS_SLICES", "2")
+        r = run_local_probe(level="collective", timeout_s=300, topology="2x2")
+        assert r.ok, r.error
+        assert r.details["chaos_injected"] == {"slices": 2}
+        assert r.details["fault_domain_ok"] == {
+            "dcn": True, "t0": True, "t1": True,
+        }
+        assert r.details["fault_domain_topology"] == "2x2x2"
+        assert r.details.get("dcn_busbw_gbps") is not None
+
+    def test_chaos_dcn_fault_is_named(self, monkeypatch):
+        # The VERDICT's done-criterion: fake two slices, inject
+        # TNC_CHAOS_AXIS=dcn, and the report names the DCN axis.
+        monkeypatch.setenv("TNC_CHAOS_SLICES", "2")
+        monkeypatch.setenv("TNC_CHAOS_AXIS", "dcn")
+        r = run_local_probe(level="collective", timeout_s=300, topology="2x2")
+        assert not r.ok
+        assert r.details["chaos_injected"] == {"slices": 2, "axis": "dcn"}
+        assert r.details["fault_domain_ok"]["dcn"] is False
+        assert r.details["fault_domain_ok"]["t0"] is True
+        assert "DCN slice boundary" in (r.error or "")
+
+    def test_chaos_ici_axis_fault_inside_multislice_names_the_axis(self, monkeypatch):
+        monkeypatch.setenv("TNC_CHAOS_SLICES", "2")
+        monkeypatch.setenv("TNC_CHAOS_AXIS", "t0")
+        r = run_local_probe(level="collective", timeout_s=300, topology="2x2")
+        assert not r.ok
+        assert r.details["fault_domain_ok"] == {
+            "dcn": True, "t0": False, "t1": True,
+        }
+        assert "t0" in (r.error or "")
+
+    def test_chaos_dcn_axis_without_multislice_fails_loudly(self, monkeypatch):
+        # Injecting a DCN fault with no second slice would test nothing.
+        monkeypatch.setenv("TNC_CHAOS_AXIS", "dcn")
+        r = run_local_probe(level="collective", timeout_s=300, topology="2x4")
+        assert not r.ok
+        assert "TNC_CHAOS_AXIS=dcn" in (r.error or "")
+        assert "TNC_CHAOS_SLICES" in (r.error or "")
+
+    def test_malformed_slice_count_fails_loudly(self, monkeypatch):
+        monkeypatch.setenv("TNC_CHAOS_SLICES", "two")
+        r = run_local_probe(level="collective", timeout_s=300)
+        assert not r.ok
+        assert "TNC_CHAOS_SLICES" in (r.error or "")
+        assert r.details.get("chaos_injected") == {"slices": "two"}
+
+    def test_single_slice_count_fails_loudly(self, monkeypatch):
+        # TNC_CHAOS_SLICES=1 would skip the whole DCN block — the rehearsal
+        # would pass while testing nothing.
+        monkeypatch.setenv("TNC_CHAOS_SLICES", "1")
+        r = run_local_probe(level="collective", timeout_s=300)
+        assert not r.ok
+        assert "at least 2" in (r.error or "")
+        assert r.details.get("chaos_injected") == {"slices": 1}
+
+
+class TestDcnMetrics:
+    def test_fault_domain_and_dcn_bandwidth_families(self):
+        from tpu_node_checker.checker import CheckResult
+        from tpu_node_checker.metrics import render_metrics
+
+        result = CheckResult(exit_code=0)
+        result.payload = {
+            "total_nodes": 1, "ready_nodes": 1, "slices": [],
+            "local_probe": {
+                "ok": False, "level": "collective",
+                "fault_domain_ok": {"dcn": False, "t0": True},
+                "dcn_busbw_gbps": 12.5,
+            },
+            "timings_ms": {"total": 1.0},
+        }
+        text = render_metrics(result)
+        assert 'tpu_node_checker_probe_fault_domain_ok{axis="dcn"} 0.0' in text
+        assert 'tpu_node_checker_probe_fault_domain_ok{axis="t0"} 1.0' in text
+        assert "tpu_node_checker_probe_dcn_busbw_gbps 12.5" in text

@@ -1,0 +1,275 @@
+"""Per-device-kind performance floors (probe/floors.py).
+
+The gap these tests pin (VERDICT r03 #1): the probe *measured*
+matmul_tflops / int8_tops / hbm_gbps / ring_link_gbps but nothing *graded*
+them, so a thermally-throttled chip at 10 % of peak passed every numerics
+gate.  Floors grade each figure against an operator-tunable fraction of the
+generation's published peak; TNC_CHAOS_THROTTLE rehearses the failure on
+healthy hardware; TNC_PERF_EXPECT overrides the table (and is the CPU-mesh
+test path, since the built-in table grades only real TPU silicon).
+"""
+
+import json
+
+import pytest
+
+from tpu_node_checker.probe.floors import (
+    CHIP_SPECS,
+    DEFAULT_FLOOR_FRACTION,
+    FLOOR_METRICS,
+    floor_failure_message,
+    grade_floors,
+)
+from tpu_node_checker.probe.liveness import run_local_probe
+
+
+class TestGradeFloors:
+    def test_v5e_at_full_speed_passes(self):
+        spec = CHIP_SPECS["v5e"]
+        measured = {m: spec[m] * 0.8 for m in spec}
+        v = grade_floors(["TPU v5 lite"], "tpu", measured)
+        assert v["ok"] is True
+        assert v["generation"] == "v5e"
+        assert v["failed"] == []
+        assert v["fraction"] == DEFAULT_FLOOR_FRACTION
+        assert v["ratios"]["matmul_tflops"] == pytest.approx(0.8, abs=1e-3)
+
+    def test_throttled_chip_fails_naming_the_metric(self):
+        spec = CHIP_SPECS["v5e"]
+        measured = {m: spec[m] * 0.8 for m in spec}
+        measured["matmul_tflops"] = spec["matmul_tflops"] * 0.1  # throttled MXU
+        v = grade_floors(["TPU v5e"], "tpu", measured)
+        assert v["ok"] is False
+        assert v["failed"] == ["matmul_tflops"]
+        msg = floor_failure_message(v)
+        assert msg.startswith("perf_floor: ")
+        assert "matmul_tflops" in msg and "v5e" in msg
+
+    def test_fraction_is_tunable(self):
+        spec = CHIP_SPECS["v5p"]
+        measured = {"matmul_tflops": spec["matmul_tflops"] * 0.5}
+        assert grade_floors(["TPU v5p"], "tpu", measured, fraction=0.4)["ok"]
+        assert not grade_floors(["TPU v5p"], "tpu", measured, fraction=0.6)["ok"]
+
+    def test_zero_fraction_disables(self):
+        v = grade_floors(["TPU v5e"], "tpu", {"matmul_tflops": 0.001}, fraction=0)
+        assert "skipped" in v and "disabled" in v["skipped"]
+
+    def test_off_tpu_skipped_with_reason(self):
+        v = grade_floors(["cpu"], "cpu", {"matmul_tflops": 0.1})
+        assert "skipped" in v and "cpu" in v["skipped"]
+
+    def test_unknown_or_mixed_kinds_skip_never_guess(self):
+        # Vague ("TPU v6"), unknown, and mixed-generation kind lists must
+        # skip: grading against the wrong spec sheet could floor-fail (or
+        # pass) a fleet on a rename.
+        for kinds in (["TPU v6"], ["TPU v99"], ["TPU v4", "TPU v5e"], [], None):
+            v = grade_floors(kinds, "tpu", {"matmul_tflops": 500.0})
+            assert "skipped" in v, kinds
+
+    def test_only_overlapping_metrics_grade(self):
+        # v2 has no int8 spec; a measured int8 figure must not fail it, and
+        # an unmeasured ring must not fail anything.
+        v = grade_floors(
+            ["TPU v2"], "tpu", {"matmul_tflops": 40.0, "int8_tops": 0.001}
+        )
+        assert v["ok"] is True
+        assert set(v["ratios"]) == {"matmul_tflops"}
+
+    def test_non_finite_and_non_numeric_measurements_ignored(self):
+        v = grade_floors(
+            ["TPU v5e"],
+            "tpu",
+            {"matmul_tflops": float("nan"), "hbm_gbps": "fast", "int8_tops": 380.0},
+        )
+        assert set(v["ratios"]) == {"int8_tops"}
+        assert v["ok"] is True
+
+    def test_explicit_expectations_grade_any_platform(self):
+        v = grade_floors(
+            None, "cpu", {"matmul_tflops": 0.05},
+            expectations={"matmul_tflops": 0.05},
+        )
+        assert v["ok"] is True and v["generation"] == "custom"
+        v = grade_floors(
+            None, "cpu", {"matmul_tflops": 0.001},
+            expectations={"matmul_tflops": 1e9},
+        )
+        assert v["ok"] is False and v["failed"] == ["matmul_tflops"]
+
+    def test_expectations_with_no_known_metric_skip(self):
+        v = grade_floors(None, "cpu", {"matmul_tflops": 1.0},
+                         expectations={"bogus": 5})
+        assert "skipped" in v
+
+    def test_throttle_fails_a_healthy_chip(self):
+        spec = CHIP_SPECS["v6e"]
+        measured = {m: spec[m] * 0.9 for m in spec}
+        v = grade_floors(["TPU v6e"], "tpu", measured, throttle="hbm_gbps")
+        assert v["ok"] is False
+        assert v["failed"] == ["hbm_gbps"]
+        assert v["throttled"] == ["hbm_gbps"]
+        # 0.9 / 20 = 0.045 of peak
+        assert v["ratios"]["hbm_gbps"] == pytest.approx(0.045, abs=1e-3)
+
+    def test_throttle_all(self):
+        spec = CHIP_SPECS["v4"]
+        measured = {m: spec[m] * 0.9 for m in spec}
+        v = grade_floors(["TPU v4"], "tpu", measured, throttle="all")
+        assert v["ok"] is False
+        assert v["failed"] == sorted(spec)
+        assert v["throttled"] == sorted(spec)
+
+    def test_throttle_never_injects_silently(self):
+        # Unknown metric name, grading skipped (off-tpu / disabled), or
+        # metric not measured: each must raise, not pass while testing
+        # nothing.
+        with pytest.raises(ValueError, match="TNC_CHAOS_THROTTLE"):
+            grade_floors(["TPU v5e"], "tpu", {"matmul_tflops": 100.0},
+                         throttle="warp_speed")
+        with pytest.raises(ValueError, match="skipped"):
+            grade_floors(["cpu"], "cpu", {"matmul_tflops": 0.1},
+                         throttle="matmul_tflops")
+        with pytest.raises(ValueError, match="skipped"):
+            grade_floors(["TPU v5e"], "tpu", {"matmul_tflops": 100.0},
+                         fraction=0, throttle="matmul_tflops")
+        with pytest.raises(ValueError, match="not measured"):
+            grade_floors(["TPU v5e"], "tpu", {"matmul_tflops": 100.0},
+                         throttle="ring_link_gbps")
+
+    def test_pathological_dispatch_overhead_skips_table_grading(self):
+        # Remote/tunneled PJRT transports add tens of ms per call; the
+        # wall-clock figures then measure the transport, not the chip —
+        # grading the table against them would floor-fail healthy silicon.
+        spec = CHIP_SPECS["v5e"]
+        measured = {"matmul_tflops": spec["matmul_tflops"] * 0.02}
+        v = grade_floors(["TPU v5e"], "tpu", measured, dispatch_overhead_ms=65.0)
+        assert "skipped" in v and "dispatch overhead" in v["skipped"]
+        # In-pod dispatch (µs) grades normally.
+        v = grade_floors(["TPU v5e"], "tpu", measured, dispatch_overhead_ms=0.05)
+        assert v["ok"] is False
+
+    def test_explicit_expectations_bypass_dispatch_gate(self):
+        # TNC_PERF_EXPECT means the operator calibrated for their transport.
+        v = grade_floors(
+            ["TPU v5e"], "tpu", {"matmul_tflops": 3.8},
+            expectations={"matmul_tflops": 4.0},
+            dispatch_overhead_ms=65.0,
+        )
+        assert v["ok"] is True and v["generation"] == "custom"
+
+    def test_every_generation_spec_is_sane(self):
+        for gen, spec in CHIP_SPECS.items():
+            assert spec.keys() <= set(FLOOR_METRICS), gen
+            assert all(v > 0 for v in spec.values()), gen
+
+
+class TestFloorsInProbeChild:
+    """End-to-end through the subprocess child on the CPU mesh."""
+
+    def test_off_tpu_grading_is_stamped_skipped(self):
+        # CPU platform, no explicit expectations: the verdict must say WHY
+        # floors did not grade — visible, never silent.
+        r = run_local_probe(level="compute", timeout_s=300)
+        assert r.ok, r.error
+        floor = r.details.get("perf_floor")
+        assert floor and "cpu" in floor["skipped"]
+
+    def test_expectation_override_grades_and_fails(self, monkeypatch):
+        monkeypatch.setenv("TNC_PERF_EXPECT", json.dumps({"matmul_tflops": 1e9}))
+        r = run_local_probe(level="compute", timeout_s=300)
+        assert not r.ok
+        assert "perf_floor" in (r.error or "")
+        assert "matmul_tflops" in (r.error or "")
+        floor = r.details["perf_floor"]
+        assert floor["failed"] == ["matmul_tflops"]
+        assert floor["generation"] == "custom"
+
+    def test_chaos_throttle_fails_healthy_host_with_named_metric(self, monkeypatch):
+        # Learn this machine's real figure, then expect exactly it: the
+        # un-throttled chip passes (measured ≈ expected > 0.4×expected) and
+        # the throttled rehearsal (÷20) fails naming the metric.
+        base = run_local_probe(level="compute", timeout_s=300)
+        assert base.ok, base.error
+        measured = base.details["matmul_tflops"]
+        monkeypatch.setenv(
+            "TNC_PERF_EXPECT", json.dumps({"matmul_tflops": measured})
+        )
+        clean = run_local_probe(level="compute", timeout_s=300)
+        assert clean.ok, clean.error
+        assert clean.details["perf_floor"]["ok"] is True
+        monkeypatch.setenv("TNC_CHAOS_THROTTLE", "matmul_tflops")
+        throttled = run_local_probe(level="compute", timeout_s=300)
+        assert not throttled.ok
+        floor = throttled.details["perf_floor"]
+        assert floor["failed"] == ["matmul_tflops"]
+        assert floor["throttled"] == ["matmul_tflops"]
+        assert "perf_floor" in (throttled.error or "")
+        assert throttled.details["chaos_injected"] == {"throttle": "matmul_tflops"}
+
+    def test_throttle_at_enumerate_level_fails_loudly(self, monkeypatch):
+        monkeypatch.setenv("TNC_CHAOS_THROTTLE", "matmul_tflops")
+        r = run_local_probe(level="enumerate", timeout_s=300)
+        assert not r.ok
+        assert r.details.get("chaos_injected") == {"throttle": "matmul_tflops"}
+        assert "TNC_CHAOS_THROTTLE" in (r.error or "")
+
+    def test_perf_floor_zero_disables_via_flag_plumbing(self, monkeypatch):
+        monkeypatch.setenv("TNC_PERF_EXPECT", json.dumps({"matmul_tflops": 1e9}))
+        r = run_local_probe(level="compute", timeout_s=300, perf_floor=0)
+        assert r.ok, r.error
+        assert "disabled" in r.details["perf_floor"]["skipped"]
+
+
+class TestFloorsCliAndMetrics:
+    def test_flag_combinations_validated(self, capsys):
+        from tpu_node_checker import cli
+
+        for argv in (
+            ["--perf-floor", "0.4"],  # no probe source
+            ["--probe", "--perf-floor", "0.4"],  # enumerate level
+            ["--probe", "--probe-level", "compute", "--perf-floor", "-1"],
+        ):
+            with pytest.raises(SystemExit) as exc:
+                cli.parse_args(argv)
+            assert exc.value.code == 2, argv
+            capsys.readouterr()
+        args = cli.parse_args(
+            ["--probe", "--probe-level", "compute", "--perf-floor", "0.5"]
+        )
+        assert args.perf_floor == 0.5
+
+    def test_metrics_export_floor_families(self):
+        from tpu_node_checker.checker import CheckResult
+        from tpu_node_checker.metrics import render_metrics
+
+        result = CheckResult(exit_code=0)
+        result.payload = {
+            "total_nodes": 1, "ready_nodes": 1, "slices": [],
+            "local_probe": {
+                "ok": False, "level": "compute",
+                "perf_floor": {
+                    "generation": "v5e", "fraction": 0.4,
+                    "ratios": {"matmul_tflops": 0.1, "hbm_gbps": 0.8},
+                    "failed": ["matmul_tflops"], "ok": False,
+                },
+            },
+            "timings_ms": {"total": 1.0},
+        }
+        text = render_metrics(result)
+        assert 'tpu_node_checker_probe_perf_floor_ok{generation="v5e"} 0.0' in text
+        assert 'tpu_node_checker_probe_perf_floor_ratio{metric="matmul_tflops"} 0.1' in text
+        assert 'tpu_node_checker_probe_perf_floor_ratio{metric="hbm_gbps"} 0.8' in text
+
+    def test_skipped_grading_exports_no_floor_families(self):
+        from tpu_node_checker.checker import CheckResult
+        from tpu_node_checker.metrics import render_metrics
+
+        result = CheckResult(exit_code=0)
+        result.payload = {
+            "total_nodes": 1, "ready_nodes": 1, "slices": [],
+            "local_probe": {"ok": True, "level": "compute",
+                            "perf_floor": {"skipped": "platform 'cpu'"}},
+            "timings_ms": {"total": 1.0},
+        }
+        assert "perf_floor" not in render_metrics(result)

@@ -1,0 +1,197 @@
+"""Planned-disruption awareness (VERDICT r03 #5).
+
+The reference collects taints but never interprets them
+(check-gpu-node.py:207), so a GKE maintenance drain and a hardware fault
+read identically.  These tests pin the interpretation: autoscaler /
+impending-termination taints and spot labels become ``planned`` context on
+nodes and slices — annotated across table, JSON, Slack, and metrics —
+without ever changing a grade (exit codes are untouched: a drained slice is
+still unusable for an SPMD job).
+"""
+
+import json
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, report
+from tpu_node_checker.detect import extract_node_info, group_slices
+
+
+def args_for(*argv):
+    return cli.parse_args(list(argv))
+
+
+def _tpu_node(name, ready=True, taints=None, labels=None):
+    base_labels = {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "4x4",
+        "cloud.google.com/gke-nodepool": "v5e-pool",
+    }
+    base_labels.update(labels or {})
+    return fx.make_node(
+        name,
+        ready=ready,
+        allocatable={"google.com/tpu": "4"},
+        labels=base_labels,
+        taints=taints,
+    )
+
+
+MAINT_TAINT = {
+    "key": "cloud.google.com/impending-node-termination",
+    "value": None,
+    "effect": "NoSchedule",
+}
+SCALE_TAINT = {
+    "key": "ToBeDeletedByClusterAutoscaler",
+    "value": "123",
+    "effect": "NoSchedule",
+}
+CANDIDATE_TAINT = {
+    "key": "DeletionCandidateOfClusterAutoscaler",
+    "value": "123",
+    "effect": "PreferNoSchedule",
+}
+
+
+class TestDetect:
+    def test_taints_become_planned_disruptions(self):
+        n = extract_node_info(_tpu_node("h", taints=[MAINT_TAINT, SCALE_TAINT]))
+        assert n.planned_disruptions == (
+            "impending-termination",
+            "autoscaler-scale-down",
+        )
+        assert n.planned_word == "maintenance"  # termination outranks
+
+    def test_autoscaler_only_is_scale_down(self):
+        n = extract_node_info(_tpu_node("h", taints=[CANDIDATE_TAINT]))
+        assert n.planned_disruptions == ("autoscaler-scale-down-candidate",)
+        assert n.planned_word == "scale-down"
+
+    def test_spot_label_is_interruptible(self):
+        n = extract_node_info(
+            _tpu_node("h", labels={"cloud.google.com/gke-spot": "true"})
+        )
+        assert n.interruptible is True
+        assert n.planned_disruptions == ()
+        assert n.to_dict()["planned"] == {
+            "disruptions": [],
+            "interruptible": True,
+        }
+
+    def test_ordinary_taints_are_not_planned(self):
+        n = extract_node_info(
+            _tpu_node(
+                "h",
+                taints=[{"key": "node.kubernetes.io/not-ready",
+                         "value": None, "effect": "NoExecute"}],
+            )
+        )
+        assert n.planned_disruptions == ()
+        assert "planned" not in n.to_dict()
+
+    def test_grading_is_untouched(self):
+        # Planned context must NEVER change readiness: a draining Ready node
+        # still counts Ready, a draining NotReady node still fails.
+        n = extract_node_info(_tpu_node("h", ready=True, taints=[MAINT_TAINT]))
+        assert n.ready and n.effectively_ready
+        n = extract_node_info(_tpu_node("h", ready=False, taints=[MAINT_TAINT]))
+        assert not n.ready
+
+
+class TestSliceContext:
+    def _slice(self, sick_taints, all_sick_planned=True):
+        nodes = [_tpu_node(f"h{i}") for i in range(3)]
+        nodes.append(
+            _tpu_node("h3", ready=False, taints=sick_taints)
+        )
+        return group_slices([extract_node_info(n) for n in nodes])[0]
+
+    def test_all_sick_hosts_planned_annotates(self):
+        s = self._slice([MAINT_TAINT])
+        assert not s.complete
+        assert s.planned_context == "maintenance"
+        assert s.to_dict()["planned_context"] == "maintenance"
+
+    def test_unplanned_sick_host_stays_bare_degraded(self):
+        # A real fault may hide behind a drain: one sick host with no
+        # planned signal keeps the slice an incident.
+        s = self._slice(None)
+        assert s.planned_context is None
+        assert "planned_context" not in s.to_dict()
+
+    def test_complete_slice_has_no_context(self):
+        nodes = [_tpu_node(f"h{i}", taints=[MAINT_TAINT]) for i in range(4)]
+        s = group_slices([extract_node_info(n) for n in nodes])[0]
+        assert s.complete and s.planned_context is None
+
+    def test_missing_hosts_defeat_the_annotation(self):
+        # A drained host that got DELETED cannot explain anything: 3 of 4
+        # expected hosts present, all Ready → incomplete, no context.
+        nodes = [_tpu_node(f"h{i}") for i in range(3)]
+        s = group_slices([extract_node_info(n) for n in nodes])[0]
+        assert not s.complete
+        assert s.planned_context is None
+
+
+class TestSurfaces:
+    def _cluster(self):
+        nodes = [_tpu_node(f"h{i}") for i in range(3)]
+        nodes.append(_tpu_node("h3", ready=False, taints=[MAINT_TAINT]))
+        return nodes
+
+    def test_table_annotates_status(self, capsys):
+        code = checker.one_shot(args_for(), nodes=self._cluster())
+        assert code == 0  # grading untouched: 3 Ready hosts
+        out = capsys.readouterr().out
+        assert "NotReady (maintenance)" in out
+        assert "DEGRADED (maintenance)" in out  # slice table
+
+    def test_json_carries_planned(self, capsys):
+        code = checker.one_shot(args_for("--json"), nodes=self._cluster())
+        assert code == 0
+        payload = json.loads(capsys.readouterr().out)
+        sick = [n for n in payload["nodes"] if n["name"] == "h3"][0]
+        assert sick["planned"]["disruptions"] == ["impending-termination"]
+        assert payload["slices"][0]["planned_context"] == "maintenance"
+
+    def test_slack_annotates_degraded_and_summarizes(self):
+        infos = [extract_node_info(n) for n in self._cluster()]
+        slices = group_slices(infos)
+        ready = [n for n in infos if n.effectively_ready]
+        msg = report.format_slack_message(infos, ready, slices, healthy=False)
+        assert "DEGRADED (maintenance)" in msg
+        assert "planned disruption" in msg
+        assert "maintenance" in msg
+
+    def test_unplanned_outage_slack_has_no_maintenance_words(self):
+        nodes = [_tpu_node(f"h{i}") for i in range(3)]
+        nodes.append(_tpu_node("h3", ready=False))
+        infos = [extract_node_info(n) for n in nodes]
+        slices = group_slices(infos)
+        ready = [n for n in infos if n.effectively_ready]
+        msg = report.format_slack_message(infos, ready, slices, healthy=False)
+        assert "maintenance" not in msg
+        assert "planned disruption" not in msg
+
+    def test_metrics_family(self):
+        result = checker.run_check(args_for("--json"), nodes=self._cluster())
+        from tpu_node_checker.metrics import render_metrics
+
+        text = render_metrics(result)
+        assert (
+            'tpu_node_checker_planned_disruption_nodes{reason="impending-termination"} 1'
+            in text
+        )
+
+    def test_trend_causes_note_planned(self, tmp_path, capsys):
+        log = tmp_path / "log.jsonl"
+        nodes = [_tpu_node(f"h{i}", ready=(i < 2)) for i in range(4)]
+        for n in nodes[2:]:
+            n["spec"]["taints"] = [MAINT_TAINT]
+        code = checker.one_shot(
+            args_for("--strict-slices", "--log-jsonl", str(log)), nodes=nodes
+        )
+        assert code == 3
+        entry = json.loads(log.read_text().splitlines()[-1])
+        assert any("(maintenance)" in c for c in entry["causes"])
+        capsys.readouterr()

@@ -162,7 +162,7 @@ class TestComputeLevels:
             assert not r.ok, level
             assert r.details.get("chaos_injected") == {"collective_leg": "psum"}
             assert "TNC_CHAOS_COLLECTIVE_LEG" in (r.error or "")
-            assert "never runs the collective legs" in (r.error or "")
+            assert "never runs the injected surface" in (r.error or "")
 
     def test_malformed_chaos_var_fails_loudly_with_stamp(self, monkeypatch):
         # A bad injection value must grade failed WITH the chaos stamp and a

@@ -33,6 +33,15 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 from tpu_node_checker import notify, report
+
+# TPU generation detection is shared with probe.floors (per-generation perf
+# expectations) so label cross-checks and floor grading cannot drift.  A
+# label/kind mismatch here is a WARNING, never a failure grade.
+from tpu_node_checker.generations import (
+    GENERATION_ALIASES as _GENERATION_ALIASES,
+    LABEL_GENERATION as _LABEL_GENERATION,
+    generations_of as _generations_of,
+)
 from tpu_node_checker.detect import (
     NodeInfo,
     SliceInfo,
@@ -143,6 +152,7 @@ def _run_probe(
         num_processes=getattr(args, "probe_num_processes", None),
         process_id=getattr(args, "probe_process_id", None),
         dist_init_timeout_s=getattr(args, "probe_rendezvous_timeout", None),
+        perf_floor=getattr(args, "perf_floor", None),
     )
     if local is not None:
         local.probe = probed.to_dict()
@@ -154,38 +164,6 @@ def _run_probe(
         result.local_probe = probed.to_dict()
 
 
-# TPU generation detection, shared by labels and PJRT device_kind strings.
-# Spelling varies across libtpu versions ("TPU v5 lite" vs "TPU v5e"), so a
-# generation is a SET of alias substrings.  Only KNOWN generations
-# participate; unknown or too-vague strings (a bare "TPU v5" names no
-# generation here) stay silent rather than guess — a mismatch is a WARNING,
-# never a failure grade: the strings come from two independent vendors'
-# surfaces and must not be able to cordon a fleet by renaming.
-_GENERATION_ALIASES = {
-    "v4": ("v4",),
-    "v5e": ("v5 lite", "v5e", "v5lite"),
-    "v5p": ("v5p",),
-    # As specific as the v5 set: a bare "v6" (or a hypothetical future "v6p")
-    # resolves to nothing rather than satisfying a tpu-v6e-slice label —
-    # the never-guess policy that keeps vague strings silent.
-    "v6e": ("v6 lite", "v6e", "v6lite"),
-}
-_LABEL_GENERATION = {
-    "tpu-v4-podslice": "v4",
-    "tpu-v5-lite-podslice": "v5e",
-    "tpu-v5-lite-device": "v5e",
-    "tpu-v5p-slice": "v5p",
-    "tpu-v6e-slice": "v6e",
-}
-
-
-def _generations_of(kind: str) -> set:
-    k = str(kind).lower()
-    return {
-        gen
-        for gen, aliases in _GENERATION_ALIASES.items()
-        if any(a in k for a in aliases)
-    }
 
 
 def _flag_kind_mismatch(node: NodeInfo) -> None:
@@ -717,6 +695,7 @@ def emit_probe(args) -> int:
         num_processes=getattr(args, "probe_num_processes", None),
         process_id=getattr(args, "probe_process_id", None),
         dist_init_timeout_s=getattr(args, "probe_rendezvous_timeout", None),
+        perf_floor=getattr(args, "perf_floor", None),
     )
     doc = probed.to_dict()
     doc["schema"] = REPORT_SCHEMA_VERSION  # aggregator contract version
@@ -1038,8 +1017,10 @@ def _round_causes(payload: dict) -> List[str]:
     for s in payload.get("slices", []):
         if not s.get("complete"):
             expected = s.get("expected_hosts") or s.get("hosts")
+            note = f" ({s['planned_context']})" if s.get("planned_context") else ""
             causes.append(
-                f"slice {s.get('id')}: {s.get('ready_hosts')}/{expected} hosts ready"
+                f"slice {s.get('id')}: {s.get('ready_hosts')}/{expected} "
+                f"hosts ready{note}"
             )
     summary = payload.get("probe_summary") or {}
     for h in summary.get("hosts_failed", []):

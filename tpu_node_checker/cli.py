@@ -152,6 +152,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        "collective level and above, runs one psum per dimension so a "
                        "fault localizes to the sick ICI axis (auto-derived from the "
                        "node's gke-tpu-topology label with --probe-distributed)")
+    probe.add_argument("--perf-floor", type=float, default=None, metavar="FRACTION",
+                       help="at compute level and above, grade measured MXU TFLOP/s, "
+                       "int8 TOPS, HBM GB/s and per-link ICI GB/s against this "
+                       "fraction of the device kind's published peak (default 0.4; "
+                       "0 disables) — a throttled chip fails with a perf_floor "
+                       "verdict naming the metric; $TNC_PERF_EXPECT (JSON "
+                       "{metric: expected}) overrides the built-in table")
     probe.add_argument("--probe-results-max-age", type=float, default=900.0,
                        metavar="SECONDS",
                        help="ignore probe reports older than this (default 900s) so a "
@@ -275,6 +282,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             p.error("--probe-soak requires --probe or --emit-probe")
         if args.probe_level == "enumerate":
             p.error("--probe-soak requires --probe-level compute (or higher)")
+    if args.perf_floor is not None:
+        # Same silent-no-op rules: floors only grade figures a compute-level
+        # probe produces.
+        if args.perf_floor < 0:
+            p.error("--perf-floor must be >= 0 (0 disables)")
+        if not (args.probe or args.emit_probe):
+            p.error("--perf-floor requires --probe or --emit-probe")
+        if args.probe_level == "enumerate":
+            p.error("--perf-floor requires --probe-level compute (or higher)")
     return args
 
 

@@ -58,6 +58,24 @@ MULTISLICE_GROUP_LABELS = (
 # it without ever touching a human's cordon.
 QUARANTINE_ANNOTATION = "tpu-node-checker.io/quarantined"
 
+# Taints that announce a PLANNED disruption (the reference collects taints but
+# never interprets them, check-gpu-node.py:207 — so a maintenance drain and a
+# hardware fault read identically).  Interpreting these separates "GKE is
+# taking this node, as scheduled" from "this node broke": same exit code,
+# very different 2am response.
+PLANNED_DISRUPTION_TAINTS = {
+    # Cluster-autoscaler scale-down lifecycle (upstream taint keys).
+    "ToBeDeletedByClusterAutoscaler": "autoscaler-scale-down",
+    "DeletionCandidateOfClusterAutoscaler": "autoscaler-scale-down-candidate",
+    # GKE stamps this ahead of host maintenance / spot reclaim.
+    "cloud.google.com/impending-node-termination": "impending-termination",
+}
+# Interruptible-capacity labels: the node can vanish at any time by design.
+INTERRUPTIBLE_LABELS = (
+    "cloud.google.com/gke-spot",
+    "cloud.google.com/gke-preemptible",
+)
+
 _INSTANCE_CHIPS_RE = re.compile(r"-(\d+)t$")
 
 
@@ -160,12 +178,26 @@ class NodeInfo:
     tpu_accelerator: Optional[str] = None  # e.g. "tpu-v5-lite-podslice"
     tpu_topology: Optional[str] = None  # e.g. "16x16"
     nodepool: Optional[str] = None
+    # Planned-disruption context (never a grade): taint-derived reasons
+    # (PLANNED_DISRUPTION_TAINTS values) and the spot/preemptible flag.
+    planned_disruptions: Tuple[str, ...] = ()
+    interruptible: bool = False
     # Data-plane probe result, attached later by the probe layer (None = not probed):
     probe: Optional[dict] = None
 
     @property
     def is_tpu(self) -> bool:
         return "tpu" in self.families
+
+    @property
+    def planned_word(self) -> Optional[str]:
+        """Human word for the disruption class: ``maintenance`` (GKE host
+        maintenance / impending termination) or ``scale-down`` (autoscaler)."""
+        if not self.planned_disruptions:
+            return None
+        if "impending-termination" in self.planned_disruptions:
+            return "maintenance"
+        return "scale-down"
 
     @property
     def effectively_ready(self) -> bool:
@@ -201,6 +233,11 @@ class NodeInfo:
             }
         if self.quarantined_by_us:
             d["quarantined_by_us"] = True
+        if self.planned_disruptions or self.interruptible:
+            d["planned"] = {
+                "disruptions": list(self.planned_disruptions),
+                "interruptible": self.interruptible,
+            }
         if self.probe is not None:
             d["probe"] = self.probe
         return d
@@ -240,6 +277,16 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         {"key": t.get("key"), "value": t.get("value"), "effect": t.get("effect")}
         for t in map(_as_dict, _as_list(spec.get("taints")))
     ]
+    # Planned-disruption signals: dedup preserving taint order, so the JSON
+    # surface is stable for any taint ordering the API returns.
+    planned = tuple(
+        dict.fromkeys(
+            PLANNED_DISRUPTION_TAINTS[t["key"]]
+            for t in taints
+            if t["key"] in PLANNED_DISRUPTION_TAINTS
+        )
+    )
+    interruptible = any(labels.get(k) == "true" for k in INTERRUPTIBLE_LABELS)
     name = metadata.get("name")
 
     def _label(key: str) -> Optional[str]:
@@ -263,6 +310,8 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         tpu_accelerator=_label(LABEL_TPU_ACCELERATOR),
         tpu_topology=_label(LABEL_TPU_TOPOLOGY),
         nodepool=_label(LABEL_NODEPOOL),
+        planned_disruptions=planned,
+        interruptible=interruptible,
     )
 
 
@@ -397,8 +446,27 @@ class SliceInfo:
             return self.hosts[0].name
         return self.nodepool or (self.hosts[0].name if self.hosts else "?")
 
+    @property
+    def planned_context(self) -> Optional[str]:
+        """``maintenance`` / ``scale-down`` when EVERY unusable host of an
+        incomplete slice carries a planned-disruption signal — the state is
+        expected, not a fault.  ``None`` when the slice is complete, when any
+        sick host has no planned signal (a real fault may be hiding behind
+        the drain), or when hosts are missing entirely (a drained host that
+        got deleted can no longer explain anything)."""
+        if self.complete:
+            return None
+        expected = self.expected_hosts
+        if expected is not None and len(self.hosts) < expected:
+            return None
+        sick = [h for h in self.hosts if not h.effectively_ready]
+        if not sick or any(not h.planned_disruptions for h in sick):
+            return None
+        words = {h.planned_word for h in sick}
+        return "maintenance" if "maintenance" in words else "scale-down"
+
     def to_dict(self) -> dict:
-        return {
+        d = {
             "id": self.slice_id,
             "accelerator": self.accelerator,
             "topology": self.topology,
@@ -412,6 +480,9 @@ class SliceInfo:
             "complete": self.complete,
             "host_names": [h.name for h in self.hosts],
         }
+        if self.planned_context:
+            d["planned_context"] = self.planned_context
+        return d
 
 
 @dataclass

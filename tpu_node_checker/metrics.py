@@ -17,6 +17,15 @@ Exported metric families:
 * ``tpu_node_checker_probe_*`` — when ``--probe`` ran: pass/fail by level and
   numeric chip telemetry (device count, MXU TFLOP/s, HBM/DMA GB/s, collective
   bus and per-link ICI bandwidth, workload step time);
+* ``tpu_node_checker_probe_perf_floor_ok{generation}`` /
+  ``..._probe_perf_floor_ratio{metric}`` — floor grading of measured perf
+  vs the device kind's published peak (a ratio trending down is thermal
+  degradation in progress);
+* ``tpu_node_checker_probe_fault_domain_ok{axis}`` — multislice hybrid-mesh
+  verdicts (axis ``dcn`` = the slice boundary) and
+  ``..._probe_dcn_busbw_gbps`` — cross-slice bandwidth;
+* ``tpu_node_checker_probe_reports_skipped{reason}`` — refused report files
+  (stale / future_skew / unreadable / schema);
 * ``tpu_node_checker_probe_hosts{state="reported|ok|failed|missing"}`` — the
   ``--probe-results`` fleet roll-up, plus
   ``tpu_node_checker_probe_host_unhealthy{host,state}`` naming each sick host;
@@ -161,6 +170,10 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             ("dma_gbps", "probe_dma_gbps", "DMA-engine stream bandwidth."),
             ("collective_busbw_gbps", "probe_collective_busbw_gbps",
              "Ring all-reduce bus bandwidth lower bound."),
+            ("dcn_busbw_gbps", "probe_dcn_busbw_gbps",
+             "Cross-slice (DCN) psum bus bandwidth lower bound."),
+            ("dispatch_overhead_ms", "probe_dispatch_overhead_ms",
+             "Per-dispatch round-trip overhead (gates floor grading)."),
             ("ring_link_gbps", "probe_ring_link_gbps",
              "Per-hop ICI link bandwidth from the ppermute ring walk."),
             ("workload_step_ms", "probe_workload_step_ms",
@@ -184,6 +197,28 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             if isinstance(value, bool):
                 family(f"tpu_node_checker_{suffix}", "gauge", help_text,
                        [({}, 1.0 if value else 0.0)])
+        floor = probe.get("perf_floor")
+        if isinstance(floor, dict) and isinstance(floor.get("ratios"), dict):
+            # Floor grading (probe/floors.py): the measured/peak ratio per
+            # metric is the trend line that catches gradual thermal
+            # degradation before it crosses the floor.
+            family(
+                "tpu_node_checker_probe_perf_floor_ok",
+                "gauge",
+                "1 when every measured perf figure cleared its device-kind "
+                "floor (--perf-floor fraction of published peak).",
+                [(
+                    {"generation": str(floor.get("generation") or "")},
+                    1.0 if floor.get("ok") else 0.0,
+                )],
+            )
+            family(
+                "tpu_node_checker_probe_perf_floor_ratio",
+                "gauge",
+                "Measured / published-peak ratio per perf metric.",
+                [({"metric": m}, r) for m, r in sorted(floor["ratios"].items())
+                 if isinstance(r, (int, float))],
+            )
         axis_ok = probe.get("ici_axis_ok")
         if isinstance(axis_ok, dict) and axis_ok:
             family(
@@ -191,6 +226,19 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
                 "gauge",
                 "Per-ICI-torus-dimension psum verdict (0 names the sick axis).",
                 [({"axis": a}, 1.0 if ok else 0.0) for a, ok in sorted(axis_ok.items())],
+            )
+        domains = probe.get("fault_domain_ok")
+        if isinstance(domains, dict) and domains:
+            # Multislice hybrid-mesh verdicts: axis "dcn" is the slice
+            # boundary, t* the intra-slice ICI torus — a 0 attributes the
+            # fault to its domain (different cables, different repair).
+            family(
+                "tpu_node_checker_probe_fault_domain_ok",
+                "gauge",
+                "Per-fault-domain psum verdict over the hybrid DCN x ICI "
+                "mesh (axis 'dcn' = the slice boundary; 0 names the sick "
+                "domain).",
+                [({"axis": a}, 1.0 if ok else 0.0) for a, ok in sorted(domains.items())],
             )
         bad_links = probe.get("ring_bad_links")
         if isinstance(bad_links, list):
@@ -207,6 +255,22 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
                     "1 per named bad ICI link (receiver-side hop i->i+1).",
                     [({"link": str(l)}, 1.0) for l in bad_links],
                 )
+    planned: dict = {}
+    for n in payload.get("nodes", []):
+        p = n.get("planned")
+        if isinstance(p, dict):
+            for reason in p.get("disruptions") or []:
+                planned[reason] = planned.get(reason, 0) + 1
+    if planned:
+        # Planned-disruption context: lets alert rules separate "maintenance
+        # drain in progress" from "hardware down" without JSON parsing.
+        family(
+            "tpu_node_checker_planned_disruption_nodes",
+            "gauge",
+            "Nodes carrying a planned-disruption taint, by reason "
+            "(autoscaler scale-down / GKE impending termination).",
+            [({"reason": r}, c) for r, c in sorted(planned.items())],
+        )
     mismatched = sum(
         1
         for n in payload.get("nodes", [])

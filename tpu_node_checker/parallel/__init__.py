@@ -17,10 +17,12 @@ parallelism in :mod:`.moe`.
 from tpu_node_checker.parallel.mesh import (
     MeshSpec,
     build_mesh,
+    hybrid_mesh,
     mesh_from_topology,
 )
 from tpu_node_checker.parallel.collectives import (
     CollectiveResult,
+    axis_bandwidth_probe,
     collective_probe,
     per_axis_probe,
     ring_probe,
@@ -47,8 +49,10 @@ from tpu_node_checker.parallel.moe import (
 __all__ = [
     "MeshSpec",
     "build_mesh",
+    "hybrid_mesh",
     "mesh_from_topology",
     "CollectiveResult",
+    "axis_bandwidth_probe",
     "collective_probe",
     "per_axis_probe",
     "ring_probe",

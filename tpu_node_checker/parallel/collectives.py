@@ -54,6 +54,39 @@ class CollectiveResult:
 _COLLECTIVE_LEGS = ("psum", "all_gather", "reduce_scatter")
 
 
+def _row_major_strides(shape) -> list:
+    """Row-major strides: device (c0, c1, …) ↔ linear index Σ cₖ·strideₖ."""
+    strides = [1] * len(shape)
+    for a in range(len(shape) - 2, -1, -1):
+        strides[a] = strides[a + 1] * shape[a + 1]
+    return strides
+
+
+def _linear_index(axis_names, strides):
+    """(per-axis indices, this device's linear index as f32) — traced code."""
+    import jax
+    import jax.numpy as jnp
+
+    idxs = [jax.lax.axis_index(nm) for nm in axis_names]
+    lin = sum(
+        (idx * s for idx, s in zip(idxs, strides)), jnp.int32(0)
+    ).astype(jnp.float32)
+    return idxs, lin
+
+
+def _expected_axis_psum(lin, idxs, a, shape, strides, col):
+    """Closed form for Σ over axis ``a`` of ``(lin + col)``:
+    ``s_a·(lin − c_a·stride_a) + stride_a·s_a(s_a−1)/2 + s_a·col`` — shared
+    by the per-axis localization and the axis-bandwidth probes so their
+    verification math cannot drift."""
+    s_a, st_a = shape[a], strides[a]
+    return (
+        s_a * (lin - idxs[a].astype(col.dtype) * st_a)
+        + st_a * s_a * (s_a - 1) / 2.0
+        + s_a * col
+    )
+
+
 def collective_probe(
     mesh=None,
     payload: int = 1024,
@@ -255,16 +288,10 @@ def per_axis_probe(
             raise ValueError(
                 f"inject_fault_axis {inject_fault_axis!r} not in mesh axes {axis_names}"
             )
-        # Row-major strides: device (c0, c1, …) carries linear index Σ cₖ·strideₖ.
-        strides = [1] * len(shape)
-        for a in range(len(shape) - 2, -1, -1):
-            strides[a] = strides[a + 1] * shape[a + 1]
+        strides = _row_major_strides(shape)
 
         def _probe():
-            idxs = [jax.lax.axis_index(nm) for nm in axis_names]
-            lin = sum(
-                (idx * s for idx, s in zip(idxs, strides)), jnp.int32(0)
-            ).astype(jnp.float32)
+            idxs, lin = _linear_index(axis_names, strides)
             # Position-varying payload (see module docstring): element e
             # carries lin + e, so intra-payload reordering on a torus
             # link is visible to the exact compare.
@@ -275,14 +302,7 @@ def per_axis_probe(
                 total = jax.lax.psum(local, nm)
                 if nm == inject_fault_axis:
                     total = total + 1.0  # simulated link corruption
-                # Σ over the axis of ((lin with coordinate a set to k) + col):
-                # s_a·(lin − c_a·stride_a) + stride_a·s_a(s_a−1)/2 + s_a·col.
-                s_a, st_a = shape[a], strides[a]
-                expected = (
-                    s_a * (lin - idxs[a].astype(jnp.float32) * st_a)
-                    + st_a * s_a * (s_a - 1) / 2.0
-                    + s_a * col
-                )
+                expected = _expected_axis_psum(lin, idxs, a, shape, strides, col)
                 bad = jnp.sum((jnp.abs(total - expected) > 1e-3).astype(jnp.int32))
                 bad_counts.append(jax.lax.psum(bad, axis_names))
             return tuple(bad_counts)
@@ -305,8 +325,114 @@ def per_axis_probe(
             ok=ok,
             n_devices=n,
             latency_us=latency_us,
-            error=None if ok else f"ICI dimension fault localized to axis {', '.join(bad)}",
+            # "dcn" (hybrid meshes) is the slice boundary, not an ICI torus
+            # dimension — name the domain accordingly.
+            error=None
+            if ok
+            else (
+                "fault localized to "
+                + (
+                    "the DCN slice boundary"
+                    if all(b.startswith("dcn=") for b in bad)
+                    else f"mesh axis {', '.join(bad)}"
+                )
+            ),
             details={"topology": "x".join(str(s) for s in shape), "axis_ok": axis_ok},
+        )
+    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+        return CollectiveResult(
+            ok=False, n_devices=0, latency_us=0.0, error=f"{type(exc).__name__}: {exc}"
+        )
+
+
+def axis_bandwidth_probe(
+    mesh,
+    axis: str,
+    payload: int = 1 << 20,
+    timed_iters: int = 4,
+) -> CollectiveResult:
+    """Bus bandwidth of a psum along ONE named mesh axis.
+
+    The cross-slice companion to ``collective_probe``'s flat ``busbw_gbps``:
+    over a hybrid mesh (:func:`tpu_node_checker.parallel.mesh.hybrid_mesh`)
+    with ``axis="dcn"`` the reduction crosses ONLY the slice boundary, so the
+    figure is the DCN's bus bandwidth (NCCL/XLA busbw convention, lower
+    bound) — beside ``collective_busbw_gbps`` it answers "is the slow fabric
+    the torus or the data-center network?".
+
+    Verification stays exact in float32: elements carry
+    ``linear_index + (position mod 256)``, so every per-axis reduction is an
+    integer far below 2^24 even at a 4 MiB payload — position-varying within
+    a 256-wide window (the module-docstring reordering argument), bounded so
+    large payloads never round.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_node_checker.parallel.mesh import shard_map_fn
+
+        sm = shard_map_fn()
+        axis_names = tuple(mesh.axis_names)
+        if axis not in axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {axis_names}")
+        shape = tuple(mesh.devices.shape)
+        n = int(np.prod(shape))
+        a = axis_names.index(axis)
+        s_a = shape[a]
+        if payload <= 0:
+            raise ValueError(f"payload must be positive, got {payload}")
+        strides = _row_major_strides(shape)
+
+        col = jnp.arange(payload, dtype=jnp.float32) % 256.0
+        lead = (1,) * len(shape)  # one block per device along every mesh axis
+
+        def _leg():
+            _, lin = _linear_index(axis_names, strides)
+            local = lin + col
+            total = jax.lax.psum(local, axis)
+            # Keep the FULL reduction as the program output (a scalar digest
+            # would let XLA dead-code-eliminate most of the transfer), one
+            # block per device so the sharded global assembles per-coordinate.
+            return total.reshape(lead + (payload,))
+
+        def _check(total):
+            idxs, lin = _linear_index(axis_names, strides)
+            expected = _expected_axis_psum(lin, idxs, a, shape, strides, col)
+            bad = jnp.sum(
+                (jnp.abs(total.reshape(payload) - expected) > 1e-3).astype(jnp.int32)
+            )
+            return jax.lax.psum(bad, axis_names)
+
+        # Timed program = the reduction alone; a separate compare program
+        # consumes its sharded output and all-reduces a replicated mismatch
+        # count (multi-host-safe, and the verify never inflates the figure).
+        out_spec = P(*axis_names, None)
+        timed = jax.jit(sm(_leg, mesh=mesh, in_specs=(), out_specs=out_spec))
+        check = jax.jit(
+            sm(_check, mesh=mesh, in_specs=(out_spec,), out_specs=P())
+        )
+
+        first = timed()  # compile + first pass
+        ok = int(check(first)) == 0
+        t0 = time.perf_counter()
+        for _ in range(timed_iters):
+            outs = timed()
+        jax.block_until_ready(outs)
+        latency_us = (time.perf_counter() - t0) / timed_iters * 1e6
+
+        busbw_gbps = None
+        if s_a > 1 and latency_us > 0:
+            busbw_gbps = round(
+                (2 * (s_a - 1) / s_a * payload * 4) / (latency_us * 1e-6) / 1e9, 3
+            )
+        return CollectiveResult(
+            ok=ok,
+            n_devices=n,
+            latency_us=latency_us,
+            error=None if ok else f"psum along axis {axis!r} returned wrong sums",
+            details={"axis": axis, "axis_size": s_a, "busbw_gbps": busbw_gbps},
         )
     except Exception as exc:  # noqa: BLE001 — probes report, never raise
         return CollectiveResult(
@@ -316,11 +442,18 @@ def per_axis_probe(
 
 def ring_probe(
     mesh=None,
-    payload: int = 256,
+    payload: int = 1 << 20,
     inject_fault_link: Optional[int] = None,
     inject_fault_swap: bool = False,
 ) -> CollectiveResult:
     """Walk the device ring with ``ppermute``, one hop per ``lax.scan`` step.
+
+    The default payload is 2^20 float32 elements (4 MiB per hop) so the
+    per-hop wall time dominates dispatch overhead and ``link_gbps`` is a
+    bandwidth-representative lower bound the per-generation perf floors
+    (:mod:`tpu_node_checker.probe.floors`) can grade — a 1 KiB payload
+    measures launch latency, not the link.  Integer exactness holds: every
+    element stays below 2^24 for any plausible ring size.
 
     After n single-step rotations every payload is back at its origin; any
     dead or corrupting link breaks the round trip at the hop that crosses it.

@@ -101,6 +101,87 @@ def device_varying(x, axis: str):
     return x  # pragma: no cover — pre-varying-types jax needs neither
 
 
+def hybrid_mesh(
+    devices: Optional[Sequence] = None,
+    topology: Optional[str] = None,
+    num_slices: Optional[int] = None,
+    dcn_axis: str = "dcn",
+    axis_prefix: str = "t",
+):
+    """Mesh with a leading DCN axis over slices × ICI axes within one slice.
+
+    The multislice analog of :func:`mesh_from_topology` (the
+    ``create_hybrid_device_mesh`` pattern): a DCN-joined job's devices carry
+    ``slice_index``; grouping by it and leading with a ``dcn`` axis makes the
+    slice boundary its own mesh dimension, so the per-axis probe
+    (:func:`tpu_node_checker.parallel.collectives.per_axis_probe`) can
+    attribute a fault to "dcn" vs "ici axis k" — different cables, different
+    repair.
+
+    ``topology`` describes ONE slice; when its product matches the per-slice
+    device count the intra-slice axes take the torus shape, else they stay
+    one flat ``d`` axis (enumeration health is reported separately).
+    ``num_slices`` overrides slice discovery with a contiguous partition —
+    the ``TNC_CHAOS_SLICES`` rehearsal hook for platforms whose devices have
+    no ``slice_index`` (the CPU test mesh).
+
+    Raises when the device set is not multislice (or not evenly divisible):
+    a DCN probe over a non-DCN mesh would "localize" a boundary that does
+    not exist.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if num_slices is not None:
+        if num_slices < 2:
+            raise ValueError(f"num_slices must be >= 2, got {num_slices}")
+        if len(devices) % num_slices:
+            raise ValueError(
+                f"{len(devices)} devices do not partition into {num_slices} "
+                "equal slices"
+            )
+        per = len(devices) // num_slices
+        groups = [devices[i * per : (i + 1) * per] for i in range(num_slices)]
+    else:
+        by_slice: dict = {}
+        for d in devices:
+            s = getattr(d, "slice_index", None)
+            if s is None:
+                raise ValueError(
+                    "devices carry no slice_index — not a multislice job"
+                )
+            by_slice.setdefault(s, []).append(d)
+        if len(by_slice) < 2:
+            raise ValueError(
+                f"only {len(by_slice)} slice(s) present — not a multislice job"
+            )
+        sizes = {len(g) for g in by_slice.values()}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"slices have unequal device counts {sorted(sizes)} — cannot "
+                "form a hybrid mesh"
+            )
+        groups = [
+            sorted(by_slice[s], key=lambda d: d.id) for s in sorted(by_slice)
+        ]
+    per_slice = len(groups[0])
+    dims = parse_topology(topology)
+    total = 1
+    for d in dims or ():
+        total *= d
+    if dims is not None and total == per_slice:
+        shape = (len(groups),) + dims
+        names = (dcn_axis,) + tuple(f"{axis_prefix}{i}" for i in range(len(dims)))
+    else:
+        shape = (len(groups), per_slice)
+        names = (dcn_axis, "d")
+    flat = [d for g in groups for d in g]
+    arr = np.empty(len(flat), dtype=object)
+    arr[:] = flat
+    return Mesh(arr.reshape(shape), names)
+
+
 def mesh_from_topology(
     topology: Optional[str], devices: Optional[Sequence] = None, axis_prefix: str = "t"
 ):

@@ -1,0 +1,229 @@
+"""Per-device-kind performance floors: the numbers finally *grade*.
+
+The probe measures ``matmul_tflops`` / ``int8_tops`` / ``hbm_gbps`` /
+``ring_link_gbps`` but, before this module, nothing compared them to what the
+device kind should deliver — a thermally-throttled chip running at 10 % of
+peak passed every numerics gate (the reference has no perf grading at all;
+its only health signal is the kubelet Ready condition,
+check-gpu-node.py:172-178).  A health checker blind to a half-speed chip
+misses the most common real TPU degradation: thermal throttling, a stuck
+power rail, a degraded ICI link that still delivers bits.
+
+Design:
+
+* :data:`CHIP_SPECS` holds published per-chip peaks per generation (Google
+  Cloud TPU docs / datasheet numbers).  The probe's figures are deliberate
+  *lower bounds* (small problem sizes, wall-clock timing, dispatch overhead
+  included), so grading uses an operator-tunable **fraction** of peak —
+  conservative 0.4 by default: peaks are unreachable, half-speed is sick.
+* Generation comes from the PJRT ``device_kind`` via
+  :mod:`tpu_node_checker.generations` — the same never-guess aliasing the
+  label cross-check uses.  Unknown / vague / mixed kinds skip grading with a
+  stamped reason rather than grading against the wrong spec sheet.
+* ``TNC_PERF_EXPECT`` (JSON ``{"metric": expected, ...}``) overrides the
+  table per-metric — site-specific calibration, new hardware ahead of the
+  table, and the CPU-mesh test path (explicit expectations grade on any
+  platform; the built-in table grades only on real TPU, never in Pallas
+  interpret mode — which on this probe is the same thing as "not TPU").
+* ``TNC_CHAOS_THROTTLE=<metric|all>`` divides the measured figure(s) by 20
+  before grading — the rehearsal hook proving a throttled chip FAILS with a
+  ``perf_floor`` verdict naming the metric.  If grading would be skipped
+  (floors disabled, platform not graded, no expectations) the hook raises:
+  an injection that tests nothing must never pass silently.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Optional, Sequence
+
+from tpu_node_checker.generations import generation_of_kinds
+
+DEFAULT_FLOOR_FRACTION = 0.4
+# Chaos divisor: 20× below peak is under any sane floor fraction (>= 0.05).
+THROTTLE_FACTOR = 0.05
+# Built-in-table grading is meaningless when per-dispatch overhead rivals the
+# probes' on-device time (remote/tunneled PJRT transports add ~tens of ms per
+# call; in-pod dispatch is microseconds).  Above this threshold the wall-clock
+# figures measure the transport, not the chip — skip rather than floor-fail a
+# healthy chip behind a slow link.  TNC_PERF_EXPECT bypasses this: explicit
+# expectations mean the operator calibrated for their transport.
+MAX_DISPATCH_OVERHEAD_MS = 5.0
+
+# Published per-chip peaks by generation.  Units match the probe's measured
+# keys: bf16 TFLOP/s (dense, MXU), int8 TOPS, HBM GB/s, one-way per-link ICI
+# GB/s.  Sources: Google Cloud TPU system-architecture docs (v4: 275 bf16
+# TFLOP/s, 1228 GB/s HBM; v5e: 197 bf16 / 394 int8, 819 GB/s; v5p: 459 bf16,
+# 2765 GB/s; v6e/Trillium: 918 bf16 / 1836 int8, 1640 GB/s) and the published
+# ICI per-link rates (v4: 6×50 GB/s, v5e: 4×50 GB/s, v5p: 6×100 GB/s,
+# v6e: 4×112 GB/s).  v2/v3 carry compute+HBM only (no int8 MXU mode
+# documented; ICI specs predate the per-link convention used here).
+CHIP_SPECS: dict = {
+    "v2": {"matmul_tflops": 45.0, "hbm_gbps": 700.0},
+    "v3": {"matmul_tflops": 123.0, "hbm_gbps": 900.0},
+    "v4": {
+        "matmul_tflops": 275.0,
+        "int8_tops": 275.0,
+        "hbm_gbps": 1228.0,
+        "ring_link_gbps": 50.0,
+    },
+    "v5e": {
+        "matmul_tflops": 197.0,
+        "int8_tops": 394.0,
+        "hbm_gbps": 819.0,
+        "ring_link_gbps": 50.0,
+    },
+    "v5p": {
+        "matmul_tflops": 459.0,
+        "int8_tops": 918.0,
+        "hbm_gbps": 2765.0,
+        "ring_link_gbps": 100.0,
+    },
+    "v6e": {
+        "matmul_tflops": 918.0,
+        "int8_tops": 1836.0,
+        "hbm_gbps": 1640.0,
+        "ring_link_gbps": 112.0,
+    },
+}
+
+# Probe report keys that participate in floor grading.
+FLOOR_METRICS = ("matmul_tflops", "int8_tops", "hbm_gbps", "ring_link_gbps")
+
+
+def grade_floors(
+    device_kinds: Optional[Sequence[str]],
+    platform: Optional[str],
+    measured: Mapping[str, object],
+    fraction: float = DEFAULT_FLOOR_FRACTION,
+    expectations: Optional[Mapping[str, float]] = None,
+    throttle: Optional[str] = None,
+    dispatch_overhead_ms: Optional[float] = None,
+    max_dispatch_ms: float = MAX_DISPATCH_OVERHEAD_MS,
+) -> dict:
+    """Grade measured perf figures against per-generation floors.
+
+    Returns a verdict dict: either ``{"skipped": reason}`` (floors disabled,
+    platform/table cannot grade, nothing measured) or::
+
+        {"generation": ..., "fraction": ..., "expected": {m: peak},
+         "measured": {m: val}, "ratios": {m: measured/peak},
+         "failed": [metrics under fraction*peak], "ok": bool}
+
+    Grading covers only metrics that are BOTH measured (numeric, finite) and
+    expected — a probe level that never ran the ring walk simply has no
+    ``ring_link_gbps`` to grade, and an expectation table without int8 (v2)
+    never fails a chip for it.
+
+    Raises ``ValueError`` for a malformed/never-exercisable ``throttle``
+    injection — the caller stamps and reports it as a loud chaos failure.
+    """
+    if throttle is not None and throttle != "all" and throttle not in FLOOR_METRICS:
+        raise ValueError(
+            f"TNC_CHAOS_THROTTLE {throttle!r} is not one of {FLOOR_METRICS} or 'all'"
+        )
+
+    def _skip(reason: str) -> dict:
+        if throttle is not None:
+            # Never inject silently: a throttle rehearsal that grades nothing
+            # would "pass" while testing nothing.
+            raise ValueError(
+                f"TNC_CHAOS_THROTTLE={throttle!r} requested but floor grading "
+                f"is skipped ({reason})"
+            )
+        return {"skipped": reason}
+
+    if fraction is None or fraction <= 0:
+        return _skip("disabled (--perf-floor 0)")
+    if expectations is not None:
+        expected = {
+            m: float(v)
+            for m, v in expectations.items()
+            if m in FLOOR_METRICS and isinstance(v, (int, float)) and float(v) > 0
+        }
+        generation = "custom"
+        if not expected:
+            return _skip("TNC_PERF_EXPECT names no known metric")
+    else:
+        if platform != "tpu":
+            # Off-TPU (which for this probe also means Pallas interpret
+            # mode): the built-in table describes TPU silicon only.
+            return _skip(f"platform {platform!r} has no expectation table")
+        if (
+            dispatch_overhead_ms is not None
+            and dispatch_overhead_ms > max_dispatch_ms
+        ):
+            return _skip(
+                f"dispatch overhead {dispatch_overhead_ms:.1f}ms exceeds "
+                f"{max_dispatch_ms:.1f}ms — wall-clock figures measure the "
+                "transport, not the chip (remote/tunneled PJRT?); set "
+                "TNC_PERF_EXPECT with transport-calibrated expectations to "
+                "grade anyway"
+            )
+        generation = generation_of_kinds(device_kinds)
+        if generation is None or generation not in CHIP_SPECS:
+            return _skip(
+                f"device kinds {list(device_kinds or [])!r} resolve to no "
+                "single known generation"
+            )
+        expected = dict(CHIP_SPECS[generation])
+
+    vals = {}
+    for m in FLOOR_METRICS:
+        v = measured.get(m)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and math.isfinite(v):
+            vals[m] = float(v)
+    if not vals:
+        return _skip("no perf measurements in this report")
+
+    throttled = []
+    if throttle is not None:
+        hit = [m for m in vals if throttle in ("all", m)]
+        if not hit:
+            # e.g. TNC_CHAOS_THROTTLE=ring_link_gbps at compute level, where
+            # the ring never ran: the injection would test nothing.
+            raise ValueError(
+                f"TNC_CHAOS_THROTTLE={throttle!r} requested but that metric "
+                f"was not measured (have {sorted(vals)})"
+            )
+        for m in hit:
+            vals[m] *= THROTTLE_FACTOR
+        throttled = sorted(hit)
+
+    ratios, failed = {}, []
+    for m, v in vals.items():
+        peak = expected.get(m)
+        if peak is None or peak <= 0:
+            continue
+        ratios[m] = round(v / peak, 4)
+        if v < fraction * peak:
+            failed.append(m)
+    if not ratios:
+        return _skip("no overlap between measured metrics and expectations")
+
+    verdict = {
+        "generation": generation,
+        "fraction": fraction,
+        "expected": {m: expected[m] for m in sorted(ratios)},
+        "measured": {m: round(vals[m], 3) for m in sorted(ratios)},
+        "ratios": {m: ratios[m] for m in sorted(ratios)},
+        "failed": sorted(failed),
+        "ok": not failed,
+    }
+    if throttled:
+        verdict["throttled"] = throttled
+    return verdict
+
+
+def floor_failure_message(verdict: Mapping) -> str:
+    """One line naming each offending metric with measured vs floor."""
+    frac = verdict.get("fraction")
+    parts = []
+    for m in verdict.get("failed", []):
+        peak = verdict["expected"].get(m)
+        parts.append(
+            f"{m} {verdict['measured'].get(m)} < floor "
+            f"{round(frac * peak, 3)} ({frac:.0%} of {verdict.get('generation')} "
+            f"peak {peak})"
+        )
+    return "perf_floor: " + "; ".join(parts)

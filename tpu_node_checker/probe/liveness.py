@@ -140,22 +140,28 @@ try:
     # failure reads as a hardware fault (and --cordon-failed would act on
     # it) with nothing tying it to the injection.
     _CHAOS_VARS = {
-        "collective_leg": "TNC_CHAOS_COLLECTIVE_LEG",
-        "ring_link": "TNC_CHAOS_RING_LINK",
-        "axis": "TNC_CHAOS_AXIS",
+        "collective_leg": ("TNC_CHAOS_COLLECTIVE_LEG", ("collective", "workload")),
+        "ring_link": ("TNC_CHAOS_RING_LINK", ("collective", "workload")),
+        "axis": ("TNC_CHAOS_AXIS", ("collective", "workload")),
+        "slices": ("TNC_CHAOS_SLICES", ("collective", "workload")),
+        "throttle": ("TNC_CHAOS_THROTTLE", ("compute", "collective", "workload")),
     }
     chaos = {}
-    for key, var in _CHAOS_VARS.items():
+    for key, (var, _lv) in _CHAOS_VARS.items():
         if os.environ.get(var):
             chaos[key] = os.environ[var]
     if chaos:
         out["chaos_injected"] = chaos
-        if level not in ("collective", "workload"):
+        bad = sorted(
+            _CHAOS_VARS[k][0] for k in chaos if level not in _CHAOS_VARS[k][1]
+        )
+        if bad:
             raise ValueError(
-                f"{', '.join(sorted(_CHAOS_VARS[k] for k in chaos))} set but "
-                f"probe level {level!r} never runs the collective legs — the "
-                "injection would silently test nothing; use --probe-level "
-                "collective (or workload), or unset the chaos vars"
+                f"{', '.join(bad)} set but probe level {level!r} never runs "
+                "the injected surface (collective legs need --probe-level "
+                "collective+, the throttle needs compute+) — the injection "
+                "would silently test nothing; raise the level or unset the "
+                "chaos vars"
             )
     if level in ("compute", "collective", "workload") and out["ok"]:
         from tpu_node_checker.ops import (
@@ -163,7 +169,23 @@ try:
             matmul_burn,
             pallas_matmul_probe,
         )
-        burn = matmul_burn()
+        on_tpu = out.get("platform") == "tpu"
+        # Per-dispatch overhead: time round-trips of a trivial jitted op.
+        # Telemetry for triage, and the gate deciding whether wall-clock
+        # throughput figures are chip-representative enough to floor-grade
+        # (remote/tunneled PJRT adds ~tens of ms per call; in-pod is µs).
+        import jax.numpy as _jnp
+        _tiny = jax.jit(lambda v: v + 1.0)
+        _x = _jnp.float32(0.0)
+        float(_tiny(_x))  # compile + warm
+        _t0 = time.perf_counter()
+        for _ in range(3):
+            float(_tiny(_x))
+        dispatch_ms = (time.perf_counter() - _t0) / 3 * 1e3
+        out["dispatch_overhead_ms"] = round(dispatch_ms, 2)
+        # TPU sizing: on-device time must dominate dispatch for the floors
+        # to grade honestly — the MXU eats the defaults in microseconds.
+        burn = matmul_burn(iters=64) if on_tpu else matmul_burn()
         out["matmul_tflops"] = round(burn.tflops, 3)
         out["matmul_ok"] = burn.ok
         hbm = hbm_bandwidth_probe()
@@ -183,7 +205,13 @@ try:
             from tpu_node_checker.ops import int8_matmul_probe
             # Quantized serving path: the MXU's int8 mode is a distinct engine
             # configuration from the bf16 burn; verification is exact-integer.
-            i8 = int8_matmul_probe()
+            # TPU shape: ~0.5 TOP so the int8 figure reflects the engine, not
+            # launch latency (the 512^3 default is ~2 GOP — microseconds).
+            i8 = (
+                int8_matmul_probe(m=1024, k=1024, n=1024, iters=128)
+                if on_tpu
+                else int8_matmul_probe()
+            )
             out["int8_ok"] = i8.ok
             out["int8_tops"] = round(i8.tops, 3)
             i8_gate = i8.ok
@@ -275,17 +303,78 @@ try:
             out["ring_err"] = ring.error
         out["ok"] = out["ok"] and coll.ok and ring.ok
         topo = os.environ.get("TNC_TOPOLOGY")
-        if "axis" in chaos and not (topo and "x" in topo):
-            # Same never-inject-nothing-silently rule as typo'd leg names: an
-            # axis injection with no multi-dim topology means the per-axis
-            # probe will not run at all, and the rehearsal would "pass"
-            # while testing nothing.
-            raise ValueError(
-                f"TNC_CHAOS_AXIS={chaos['axis']!r} requested but no multi-dim "
-                f"topology is set (TNC_TOPOLOGY={topo!r}); the per-axis probe "
-                "will not run"
+        n_slices = out.get("num_slices") or 0
+        if "slices" in chaos:
+            # Rehearsal partition: pretend the local device set is N
+            # DCN-joined slices so the whole DCN fault-domain path — hybrid
+            # mesh, per-domain verdicts, cross-slice bandwidth, metrics — is
+            # drivable on hardware (or the CPU test mesh) with no real
+            # multislice job.  Stamped via chaos_injected like every hook.
+            try:
+                chaos["slices"] = int(chaos["slices"])
+            except ValueError:
+                raise ValueError(
+                    f"TNC_CHAOS_SLICES {chaos['slices']!r} is not an integer "
+                    "slice count"
+                )
+            if chaos["slices"] < 2:
+                # One (or zero) slices is not a multislice: the whole DCN
+                # block below would be skipped and the rehearsal would pass
+                # while testing nothing.
+                raise ValueError(
+                    f"TNC_CHAOS_SLICES={chaos['slices']} cannot rehearse a "
+                    "slice boundary — need at least 2"
+                )
+            n_slices = chaos["slices"]
+        multislice = n_slices > 1
+        if "axis" in chaos:
+            # Never-inject-nothing-silently (cf. typo'd leg names): the
+            # requested axis must belong to a mesh some probe below will
+            # actually build.
+            if chaos["axis"] == "dcn":
+                if not multislice:
+                    raise ValueError(
+                        "TNC_CHAOS_AXIS=dcn requested but this is not a "
+                        "multislice job (one slice; set TNC_CHAOS_SLICES=N "
+                        "to rehearse) — the DCN fault-domain probe will "
+                        "not run"
+                    )
+            elif not multislice and not (topo and "x" in topo):
+                raise ValueError(
+                    f"TNC_CHAOS_AXIS={chaos['axis']!r} requested but no "
+                    f"multi-dim topology is set (TNC_TOPOLOGY={topo!r}); "
+                    "the per-axis probe will not run"
+                )
+        if multislice:
+            # DCN-joined multislice: the slice boundary is its own fault
+            # domain.  A hybrid mesh (dcn × per-slice ICI axes) runs the
+            # same per-axis legs, so a fault attributes to "dcn" vs "ici
+            # axis k" — different cables, different repair — and a psum
+            # pinned to the dcn axis yields the cross-slice bus bandwidth
+            # beside collective_busbw_gbps.  (The flat per-topology path
+            # below is skipped: the label describes ONE slice, not the
+            # joined device set.)
+            from tpu_node_checker.parallel import (
+                axis_bandwidth_probe,
+                hybrid_mesh,
+                per_axis_probe,
             )
-        if topo and "x" in topo:
+            hmesh = hybrid_mesh(
+                topology=topo,
+                num_slices=chaos.get("slices"),
+            )
+            dom = per_axis_probe(mesh=hmesh, inject_fault_axis=chaos.get("axis"))
+            out["fault_domain_ok"] = (dom.details or {}).get("axis_ok")
+            out["fault_domain_topology"] = (dom.details or {}).get("topology")
+            if not dom.ok:
+                out["ok"] = False
+                out["error"] = dom.error
+            dbw = axis_bandwidth_probe(hmesh, "dcn")
+            out["dcn_busbw_gbps"] = (dbw.details or {}).get("busbw_gbps")
+            if not dbw.ok:
+                out["ok"] = False
+                out["dcn_err"] = dbw.error
+        elif topo and "x" in topo:
             # Multi-dim topology label: probe each ICI torus dimension
             # separately so a fault names the sick axis.  Runs regardless of
             # the flat verdict — localization matters MOST when the flat
@@ -297,6 +386,49 @@ try:
             if not ax.ok:
                 out["ok"] = False
                 out["error"] = ax.error
+    if level in ("compute", "collective", "workload"):
+        # Performance floors: grade the measured figures against what this
+        # device kind should deliver (tpu_node_checker.probe.floors) — a
+        # throttled chip that aces every numerics gate must still fail.
+        # Runs regardless of the flat verdict: perf ratios matter MOST next
+        # to another failure, and a skipped grading is stamped, not silent.
+        from tpu_node_checker.probe.floors import (
+            DEFAULT_FLOOR_FRACTION,
+            FLOOR_METRICS,
+            floor_failure_message,
+            grade_floors,
+        )
+        frac = DEFAULT_FLOOR_FRACTION
+        if os.environ.get("TNC_PERF_FLOOR"):
+            frac = float(os.environ["TNC_PERF_FLOOR"])
+        expect = None
+        if os.environ.get("TNC_PERF_EXPECT"):
+            expect = json.loads(os.environ["TNC_PERF_EXPECT"])
+        max_disp = float(
+            os.environ.get("TNC_PERF_FLOOR_MAX_DISPATCH_MS") or 0
+        ) or None
+        measured = {m: out.get(m) for m in FLOOR_METRICS}
+        if any(v is not None for v in measured.values()) or chaos.get("throttle"):
+            kw = {}
+            if max_disp is not None:
+                kw["max_dispatch_ms"] = max_disp
+            verdict = grade_floors(
+                out.get("device_kinds"),
+                out.get("platform"),
+                measured,
+                fraction=frac,
+                expectations=expect,
+                throttle=chaos.get("throttle"),
+                dispatch_overhead_ms=out.get("dispatch_overhead_ms"),
+                **kw,
+            )
+            out["perf_floor"] = verdict
+            if not verdict.get("ok", True):
+                out["ok"] = False
+                msg = floor_failure_message(verdict)
+                out["error"] = (
+                    f"{out['error']}; {msg}" if out.get("error") else msg
+                )
     if level == "workload" and out["ok"]:
         import jax as _jax
         from tpu_node_checker.models import BurninConfig, workload_probe
@@ -395,6 +527,7 @@ def run_local_probe(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     dist_init_timeout_s: Optional[float] = None,
+    perf_floor: Optional[float] = None,
 ) -> ProbeResult:
     """Probe this host's chips in a child process; never raises.
 
@@ -413,6 +546,10 @@ def run_local_probe(
     ``dist_init_timeout_s`` bounds the rendezvous itself so an unreachable
     coordinator yields a structured child-side error before the parent's
     kill-timer has to fire.
+
+    ``perf_floor`` overrides the floor-grading fraction
+    (:mod:`tpu_node_checker.probe.floors`; 0 disables) applied to the
+    measured perf figures at compute level and above.
     """
     if level not in LEVELS:
         raise ValueError(f"unknown probe level {level!r}; expected one of {LEVELS}")
@@ -447,6 +584,10 @@ def run_local_probe(
         child_env["TNC_TOPOLOGY"] = topology
     if soak_s > 0:
         child_env["TNC_SOAK_S"] = str(soak_s)
+    if perf_floor is not None:
+        # Floor fraction override (0 disables); the child defaults to the
+        # conservative DEFAULT_FLOOR_FRACTION when unset.
+        child_env["TNC_PERF_FLOOR"] = str(perf_floor)
     try:
         proc = subprocess.run(
             [python or sys.executable, "-c", _CHILD_SCRIPT, level],

@@ -33,10 +33,13 @@ def _render_columns(headers: Sequence[str], rows: Sequence[Sequence[str]]) -> st
 
 def _status(n: NodeInfo) -> str:
     """Kubelet readiness, annotated when the device plugin is dead (node is
-    Ready but allocatable advertises zero devices)."""
-    if not n.ready:
-        return "NotReady"
-    return "Ready" if n.schedulable else "Ready/NoAlloc"
+    Ready but allocatable advertises zero devices) and when the node is
+    under a PLANNED disruption (maintenance drain / autoscaler scale-down)
+    — "GKE is taking this node, as scheduled" and "this node broke" must
+    not read identically."""
+    base = "NotReady" if not n.ready else ("Ready" if n.schedulable else "Ready/NoAlloc")
+    word = n.planned_word
+    return f"{base} ({word})" if word else base
 
 
 def format_node_table(nodes: Sequence[NodeInfo]) -> str:
@@ -60,6 +63,14 @@ def format_node_table(nodes: Sequence[NodeInfo]) -> str:
     return _render_columns(["NAME", "READY", "ACCEL", "KEYS", "TPU", "PROBE"], rows)
 
 
+def _degraded(s: SliceInfo) -> str:
+    """Slice degraded-state word, annotated when every sick host is under a
+    planned disruption: ``DEGRADED (maintenance)`` is expected downtime,
+    bare ``DEGRADED`` is an incident."""
+    ctx = s.planned_context
+    return f"DEGRADED ({ctx})" if ctx else "DEGRADED"
+
+
 def format_slice_table(slices: Sequence[SliceInfo]) -> str:
     """Per-slice readiness summary — no reference analog (slice grouping is new)."""
     if not slices:
@@ -77,7 +88,7 @@ def format_slice_table(slices: Sequence[SliceInfo]) -> str:
                 s.topology or "-",
                 hosts,
                 chips,
-                "complete" if s.complete else "DEGRADED",
+                "complete" if s.complete else _degraded(s),
             ]
         )
     return _render_columns(
@@ -236,6 +247,17 @@ def format_slack_message(
         if n.probe is not None and not n.probe.get("ok"):
             line += " — chip probe FAILED"
         lines.append(line)
+    planned_sick = [
+        n for n in accel if not n.effectively_ready and n.planned_disruptions
+    ]
+    if planned_sick:
+        # Triage context, pushed rather than discovered: these nodes are
+        # down by schedule (maintenance drain / autoscaler), not by fault.
+        words = sorted({n.planned_word for n in planned_sick})
+        lines.append(
+            f"🔧 {len(planned_sick)} unavailable node(s) under planned "
+            f"disruption ({', '.join(words)}) — expected downtime, not a fault"
+        )
     if omitted_problems:
         lines.append(f"• … {omitted_problems} more problem nodes omitted")
     if omitted_healthy:
@@ -247,7 +269,7 @@ def format_slack_message(
     )
     for s in listed_slices:
         expected = s.expected_chips or s.chips
-        state = "complete" if s.complete else "DEGRADED"
+        state = "complete" if s.complete else _degraded(s)
         lines.append(
             f"• slice `{s.nodepool or s.accelerator or '?'}` "
             f"[{s.accelerator or '?'} {s.topology or '?'}]: "
