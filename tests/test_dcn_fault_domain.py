@@ -122,6 +122,10 @@ class TestDcnInProbeChild:
         }
         assert r.details["fault_domain_topology"] == "2x2x2"
         assert r.details.get("dcn_busbw_gbps") is not None
+        bw = r.details["fault_domain_busbw_gbps"]
+        assert set(bw) == {"dcn", "t0", "t1"}
+        assert all(isinstance(v, (int, float)) and v > 0 for v in bw.values())
+        assert bw["dcn"] == r.details["dcn_busbw_gbps"]
 
     def test_chaos_dcn_fault_is_named(self, monkeypatch):
         # The VERDICT's done-criterion: fake two slices, inject

@@ -124,6 +124,31 @@ class TestSliceContext:
         s = group_slices([extract_node_info(n) for n in nodes])[0]
         assert s.complete and s.planned_context is None
 
+    def test_failed_probe_is_never_excused(self):
+        # A Ready node with dead chips AND a maintenance taint: the drain
+        # does not explain dead silicon — a real fault must not hide
+        # behind it.
+        from tpu_node_checker.detect import extract_node_info as _e
+
+        nodes = [_e(_tpu_node(f"h{i}")) for i in range(3)]
+        sick = _e(_tpu_node("h3", taints=[MAINT_TAINT]))
+        sick.probe = {"ok": False, "level": "compute", "error": "MXU dead"}
+        assert sick.sickness_planned is False
+        s = group_slices(nodes + [sick])[0]
+        assert not s.complete and s.planned_context is None
+
+    def test_soft_candidate_taint_excuses_nothing(self):
+        # DeletionCandidateOfClusterAutoscaler marks an underutilized node
+        # that is still Ready/schedulable; a NotReady node carrying only
+        # that soft mark is a fault, not a drain.
+        n = extract_node_info(
+            _tpu_node("h", ready=False, taints=[CANDIDATE_TAINT])
+        )
+        assert n.sickness_planned is False
+        nodes = [extract_node_info(_tpu_node(f"h{i}")) for i in range(3)]
+        s = group_slices(nodes + [n])[0]
+        assert s.planned_context is None
+
     def test_missing_hosts_defeat_the_annotation(self):
         # A drained host that got DELETED cannot explain anything: 3 of 4
         # expected hosts present, all Ready → incomplete, no context.
@@ -182,6 +207,77 @@ class TestSurfaces:
             'tpu_node_checker_planned_disruption_nodes{reason="impending-termination"} 1'
             in text
         )
+
+    def test_planned_round_flagged_in_state_log(self, tmp_path, capsys):
+        # A degraded round where EVERY sick node is under planned disruption
+        # logs planned=true; one unexplained sick node keeps it unplanned.
+        log = tmp_path / "log.jsonl"
+        nodes = [_tpu_node(f"h{i}") for i in range(3)]
+        nodes.append(_tpu_node("h3", ready=False, taints=[MAINT_TAINT]))
+        code = checker.one_shot(
+            args_for("--strict-slices", "--log-jsonl", str(log)), nodes=nodes
+        )
+        assert code == 3
+        assert json.loads(log.read_text().splitlines()[-1])["planned"] is True
+        nodes.append(_tpu_node("h4", ready=False))  # unexplained fault
+        checker.one_shot(
+            args_for("--strict-slices", "--log-jsonl", str(log)), nodes=nodes
+        )
+        assert "planned" not in json.loads(log.read_text().splitlines()[-1])
+        capsys.readouterr()
+
+    def test_probe_failed_round_never_planned(self, tmp_path, capsys):
+        # A maintenance-tainted host whose probe REPORT says dead chips:
+        # the round must stay unplanned in the trend math.
+        log = tmp_path / "log.jsonl"
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        nodes = [_tpu_node(f"h{i}") for i in range(3)]
+        nodes.append(_tpu_node("h3", taints=[MAINT_TAINT]))
+        (reports / "h3.json").write_text(
+            json.dumps({"ok": False, "hostname": "h3", "level": "compute"})
+        )
+        code = checker.one_shot(
+            args_for(
+                "--strict-slices", "--probe-results", str(reports),
+                "--log-jsonl", str(log),
+            ),
+            nodes=nodes,
+        )
+        assert code == 3
+        assert "planned" not in json.loads(log.read_text().splitlines()[-1])
+        capsys.readouterr()
+
+    def test_candidate_only_round_never_planned(self, tmp_path, capsys):
+        log = tmp_path / "log.jsonl"
+        nodes = [_tpu_node(f"h{i}") for i in range(3)]
+        nodes.append(_tpu_node("h3", ready=False, taints=[CANDIDATE_TAINT]))
+        code = checker.one_shot(
+            args_for("--strict-slices", "--log-jsonl", str(log)), nodes=nodes
+        )
+        assert code == 3
+        assert "planned" not in json.loads(log.read_text().splitlines()[-1])
+        capsys.readouterr()
+
+    def test_trend_splits_planned_outage(self, tmp_path, capsys):
+        t0 = 1_700_000_000
+        entries = [
+            {"ts": t0, "exit_code": 0},
+            {"ts": t0 + 60, "exit_code": 3, "planned": True},
+            {"ts": t0 + 120, "exit_code": 0},
+            {"ts": t0 + 180, "exit_code": 3},  # unplanned
+        ]
+        log = tmp_path / "t.jsonl"
+        log.write_text("\n".join(json.dumps(e) for e in entries) + "\n")
+        assert cli.main(["--trend", str(log), "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        # 3 intervals of 60s + final median 60s: ok=120s, planned bad=60s,
+        # unplanned bad=60s → unplanned availability 120/(240-60) = 66.67%.
+        assert s["planned_outage_s"] == 60.0
+        assert s["unplanned_availability_pct"] == 66.67
+        assert cli.main(["--trend", str(log)]) == 0
+        out = capsys.readouterr().out
+        assert "excluding 60.0s planned maintenance" in out
 
     def test_trend_causes_note_planned(self, tmp_path, capsys):
         log = tmp_path / "log.jsonl"

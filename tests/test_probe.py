@@ -180,6 +180,11 @@ class TestComputeLevels:
         assert r.ok, r.error
         assert r.details.get("ici_topology") == "2x4"
         assert r.details.get("ici_axis_ok") == {"t0": True, "t1": True}
+        # Per-axis bandwidth beside the verdicts: a dimension can be
+        # correct but slow; the figure exists per torus axis.
+        bw = r.details.get("ici_axis_busbw_gbps")
+        assert set(bw) == {"t0", "t1"}
+        assert all(isinstance(v, (int, float)) and v > 0 for v in bw.values())
 
     def test_workload_level(self):
         r = run_local_probe(level="workload", timeout_s=600)

@@ -896,14 +896,18 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
     import statistics
 
     state_seconds: dict = {}
+    planned_outage_s = 0.0
     intervals = [b[0] - a[0] for a, b in zip(rounds, rounds[1:])]
-    for (_, code, _), dt in zip(rounds, intervals):
+    for (_, code, e), dt in zip(rounds, intervals):
         state_seconds[code] = state_seconds.get(code, 0.0) + dt
+        if code != EXIT_OK and e.get("planned"):
+            planned_outage_s += dt
     if intervals:
-        final_code = rounds[-1][1]
-        state_seconds[final_code] = state_seconds.get(
-            final_code, 0.0
-        ) + statistics.median(intervals)
+        final_ts, final_code, final_e = rounds[-1]
+        dt = statistics.median(intervals)
+        state_seconds[final_code] = state_seconds.get(final_code, 0.0) + dt
+        if final_code != EXIT_OK and final_e.get("planned"):
+            planned_outage_s += dt
     occupancy_total = sum(state_seconds.values())
     summary = {
         "rounds": len(rounds),
@@ -916,6 +920,20 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
             else None
         ),
         "state_seconds": {str(k): round(v, 1) for k, v in sorted(state_seconds.items())},
+        # Downtime fully explained by maintenance drains / scale-downs
+        # (rounds logged planned=true), and availability with that time
+        # excused — the SLO most fleets actually report against.
+        "planned_outage_s": round(planned_outage_s, 1),
+        "unplanned_availability_pct": (
+            round(
+                100.0
+                * state_seconds.get(EXIT_OK, 0.0)
+                / (occupancy_total - planned_outage_s),
+                2,
+            )
+            if occupancy_total - planned_outage_s > 0
+            else None
+        ),
         "chip_availability_pct": (
             round(100.0 * sum(chip_ratios) / len(chip_ratios), 2)
             if chip_ratios
@@ -955,6 +973,13 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         + (
             f" ({summary['time_weighted_availability_pct']}% time-weighted)"
             if summary["time_weighted_availability_pct"] is not None
+            else ""
+        )
+        + (
+            f"; {summary['unplanned_availability_pct']}% excluding "
+            f"{summary['planned_outage_s']}s planned maintenance"
+            if summary["planned_outage_s"]
+            and summary["unplanned_availability_pct"] is not None
             else ""
         )
         + (
@@ -1042,6 +1067,48 @@ def _round_causes(payload: dict) -> List[str]:
     return causes
 
 
+def _round_is_planned(payload: dict, exit_code: int) -> bool:
+    """True when a degraded round is FULLY explained by planned disruption.
+
+    Every unusable node must carry a planned-disruption signal and every
+    incomplete slice the matching context; a capacity shortfall, a missing
+    host, or any unexplained sick node keeps the round unplanned — a real
+    fault hiding behind a maintenance drain must not be excused.
+    """
+    if exit_code == EXIT_OK or not payload.get("nodes"):
+        return False
+    from tpu_node_checker.detect import HARD_PLANNED_DISRUPTIONS
+
+    def _excused(n: dict) -> bool:
+        # Mirror of NodeInfo.sickness_planned over the payload dict: a HARD
+        # signal (drain/termination in progress — the soft scale-down
+        # candidate mark excuses nothing) and never a failed chip probe.
+        dis = set((n.get("planned") or {}).get("disruptions") or ())
+        if not dis & HARD_PLANNED_DISRUPTIONS:
+            return False
+        return not (
+            isinstance(n.get("probe"), dict) and not n["probe"].get("ok")
+        )
+
+    sick = [
+        n
+        for n in payload["nodes"]
+        if not n.get("ready")
+        or not n.get("schedulable", True)
+        or (isinstance(n.get("probe"), dict) and not n["probe"].get("ok"))
+    ]
+    if not sick:
+        # Degradation with no named sick node (e.g. --expected-chips
+        # shortfall from a vanished nodepool) cannot be attributed.
+        return False
+    if any(not _excused(n) for n in sick):
+        return False
+    return all(
+        s.get("complete") or s.get("planned_context")
+        for s in payload.get("slices", [])
+    )
+
+
 def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] = None) -> None:
     """``--log-jsonl FILE``: append one line per check round.
 
@@ -1072,6 +1139,10 @@ def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] 
             causes = _round_causes(p)
             if causes:
                 entry["causes"] = causes
+            if _round_is_planned(p, result.exit_code):
+                # Lets --trend split planned-maintenance downtime out of
+                # the availability math.
+                entry["planned"] = True
     else:
         entry.update(exit_code=EXIT_ERROR, error=error)
     try:

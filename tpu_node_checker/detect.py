@@ -75,6 +75,13 @@ INTERRUPTIBLE_LABELS = (
     "cloud.google.com/gke-spot",
     "cloud.google.com/gke-preemptible",
 )
+# The planned signals that can EXCUSE unavailability (trend math, Slack
+# "expected downtime", slice context).  The autoscaler's soft candidate
+# taint is deliberately absent: it marks an underutilized node that is
+# still Ready and schedulable — if such a node is sick, something broke.
+HARD_PLANNED_DISRUPTIONS = frozenset(
+    {"autoscaler-scale-down", "impending-termination"}
+)
 
 _INSTANCE_CHIPS_RE = re.compile(r"-(\d+)t$")
 
@@ -198,6 +205,19 @@ class NodeInfo:
         if "impending-termination" in self.planned_disruptions:
             return "maintenance"
         return "scale-down"
+
+    @property
+    def sickness_planned(self) -> bool:
+        """True when this node's unavailability is *explained* by a planned
+        disruption: a HARD signal (a drain/termination in progress, not a
+        mere scale-down-candidate mark) and no failed chip-probe verdict —
+        dead chips are never "planned"; a real hardware fault must not hide
+        behind a maintenance drain."""
+        if self.effectively_ready:
+            return False
+        if not HARD_PLANNED_DISRUPTIONS.intersection(self.planned_disruptions):
+            return False
+        return not (self.probe is not None and not self.probe.get("ok"))
 
     @property
     def effectively_ready(self) -> bool:
@@ -460,7 +480,7 @@ class SliceInfo:
         if expected is not None and len(self.hosts) < expected:
             return None
         sick = [h for h in self.hosts if not h.effectively_ready]
-        if not sick or any(not h.planned_disruptions for h in sick):
+        if not sick or any(not h.sickness_planned for h in sick):
             return None
         words = {h.planned_word for h in sick}
         return "maintenance" if "maintenance" in words else "scale-down"

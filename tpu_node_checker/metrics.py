@@ -227,6 +227,26 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
                 "Per-ICI-torus-dimension psum verdict (0 names the sick axis).",
                 [({"axis": a}, 1.0 if ok else 0.0) for a, ok in sorted(axis_ok.items())],
             )
+        axis_bw = probe.get("ici_axis_busbw_gbps") or probe.get(
+            "fault_domain_busbw_gbps"
+        )
+        if isinstance(axis_bw, dict):
+            samples = [
+                ({"axis": a}, v)
+                for a, v in sorted(axis_bw.items())
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            ]
+            if samples:
+                # A torus dimension (or the DCN boundary) can be correct but
+                # SLOW; per-axis bandwidth trends catch the degradation the
+                # exact compare cannot see.
+                family(
+                    "tpu_node_checker_probe_axis_busbw_gbps",
+                    "gauge",
+                    "psum bus bandwidth per mesh axis (ICI torus dimensions; "
+                    "'dcn' = the multislice boundary).",
+                    samples,
+                )
         domains = probe.get("fault_domain_ok")
         if isinstance(domains, dict) and domains:
             # Multislice hybrid-mesh verdicts: axis "dcn" is the slice

@@ -171,12 +171,26 @@ def hybrid_mesh(
     for d in dims or ():
         total *= d
     if dims is not None and total == per_slice:
+        # Coordinate-aware placement WITHIN each slice (same rationale as
+        # mesh_from_topology): the torus axes must line up with the physical
+        # ICI dimensions or per-axis fault localization names the wrong
+        # cable group.  Enumeration-order reshape is the fallback (fake/CPU
+        # devices without coords — the rehearsal partition).
+        try:
+            from jax.experimental import mesh_utils
+
+            groups = [
+                np.asarray(mesh_utils.create_device_mesh(dims, devices=g))
+                for g in groups
+            ]
+        except Exception:
+            pass
         shape = (len(groups),) + dims
         names = (dcn_axis,) + tuple(f"{axis_prefix}{i}" for i in range(len(dims)))
     else:
         shape = (len(groups), per_slice)
         names = (dcn_axis, "d")
-    flat = [d for g in groups for d in g]
+    flat = [d for g in groups for d in np.asarray(g, dtype=object).flat]
     arr = np.empty(len(flat), dtype=object)
     arr[:] = flat
     return Mesh(arr.reshape(shape), names)

@@ -327,6 +327,21 @@ try:
                 )
             n_slices = chaos["slices"]
         multislice = n_slices > 1
+
+        def _axis_bw_sweep(mesh_):
+            # Per-axis psum bandwidth over every axis of mesh_: a dimension
+            # can be correct but SLOW (degraded links still delivering
+            # bits) -- the exact compare cannot see that.  (No docstring:
+            # a triple quote here would terminate _CHILD_SCRIPT itself.)
+            from tpu_node_checker.parallel import axis_bandwidth_probe
+            bw_, errs_ = {}, {}
+            for nm in mesh_.axis_names:
+                leg = axis_bandwidth_probe(mesh_, nm)
+                bw_[nm] = (leg.details or {}).get("busbw_gbps")
+                if not leg.ok:
+                    errs_[nm] = leg.error
+            return bw_, errs_
+
         if "axis" in chaos:
             # Never-inject-nothing-silently (cf. typo'd leg names): the
             # requested axis must belong to a mesh some probe below will
@@ -354,11 +369,7 @@ try:
             # beside collective_busbw_gbps.  (The flat per-topology path
             # below is skipped: the label describes ONE slice, not the
             # joined device set.)
-            from tpu_node_checker.parallel import (
-                axis_bandwidth_probe,
-                hybrid_mesh,
-                per_axis_probe,
-            )
+            from tpu_node_checker.parallel import hybrid_mesh, per_axis_probe
             hmesh = hybrid_mesh(
                 topology=topo,
                 num_slices=chaos.get("slices"),
@@ -369,23 +380,35 @@ try:
             if not dom.ok:
                 out["ok"] = False
                 out["error"] = dom.error
-            dbw = axis_bandwidth_probe(hmesh, "dcn")
-            out["dcn_busbw_gbps"] = (dbw.details or {}).get("busbw_gbps")
-            if not dbw.ok:
+            # Per-domain bandwidth: "dcn slow" vs "torus axis k slow" are
+            # different escalations.
+            bw, bw_err = _axis_bw_sweep(hmesh)
+            out["fault_domain_busbw_gbps"] = bw
+            out["dcn_busbw_gbps"] = bw.get("dcn")
+            if bw_err:
                 out["ok"] = False
-                out["dcn_err"] = dbw.error
+                out["axis_busbw_err"] = bw_err
+                if "dcn" in bw_err:
+                    out["dcn_err"] = bw_err["dcn"]
         elif topo and "x" in topo:
             # Multi-dim topology label: probe each ICI torus dimension
             # separately so a fault names the sick axis.  Runs regardless of
             # the flat verdict — localization matters MOST when the flat
             # collectives just failed.
             from tpu_node_checker.parallel import per_axis_probe
-            ax = per_axis_probe(topology=topo, inject_fault_axis=chaos.get("axis"))
+            from tpu_node_checker.parallel.mesh import mesh_from_topology
+            tmesh = mesh_from_topology(topo)
+            ax = per_axis_probe(mesh=tmesh, inject_fault_axis=chaos.get("axis"))
             out["ici_axis_ok"] = (ax.details or {}).get("axis_ok")
             out["ici_topology"] = (ax.details or {}).get("topology")
             if not ax.ok:
                 out["ok"] = False
                 out["error"] = ax.error
+            bw, bw_err = _axis_bw_sweep(tmesh)
+            out["ici_axis_busbw_gbps"] = bw
+            if bw_err:
+                out["ok"] = False
+                out["axis_busbw_err"] = bw_err
     if level in ("compute", "collective", "workload"):
         # Performance floors: grade the measured figures against what this
         # device kind should deliver (tpu_node_checker.probe.floors) — a

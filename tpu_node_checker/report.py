@@ -247,9 +247,7 @@ def format_slack_message(
         if n.probe is not None and not n.probe.get("ok"):
             line += " — chip probe FAILED"
         lines.append(line)
-    planned_sick = [
-        n for n in accel if not n.effectively_ready and n.planned_disruptions
-    ]
+    planned_sick = [n for n in accel if n.sickness_planned]
     if planned_sick:
         # Triage context, pushed rather than discovered: these nodes are
         # down by schedule (maintenance drain / autoscaler), not by fault.
