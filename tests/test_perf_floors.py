@@ -261,6 +261,80 @@ class TestFloorsCliAndMetrics:
         assert 'tpu_node_checker_probe_perf_floor_ratio{metric="matmul_tflops"} 0.1' in text
         assert 'tpu_node_checker_probe_perf_floor_ratio{metric="hbm_gbps"} 0.8' in text
 
+    def test_human_output_renders_floor_verdict(self, capsys, monkeypatch):
+        from tests import fixtures as fx
+        from tpu_node_checker import checker, cli
+        from tpu_node_checker.probe.liveness import ProbeResult
+
+        monkeypatch.setenv("NODE_NAME", "gke-tpu-v5e-0")
+        monkeypatch.setattr(
+            checker,
+            "run_local_probe",
+            lambda **kw: ProbeResult(
+                ok=False, level="compute", hostname="gke-tpu-v5e-0",
+                elapsed_ms=1.0, device_count=4, platform="tpu",
+                device_kinds=["TPU v5e"],
+                error="perf_floor: matmul_tflops 19.7 < floor 78.8",
+                details={"perf_floor": {
+                    "generation": "v5e", "fraction": 0.4,
+                    "expected": {"matmul_tflops": 197.0},
+                    "measured": {"matmul_tflops": 19.7},
+                    "ratios": {"matmul_tflops": 0.1},
+                    "failed": ["matmul_tflops"], "ok": False,
+                }},
+            ),
+            raising=False,
+        )
+        import tpu_node_checker.probe as probe_pkg
+
+        monkeypatch.setattr(
+            probe_pkg, "run_local_probe", checker.run_local_probe, raising=False
+        )
+        code = checker.one_shot(
+            cli.parse_args(["--probe", "--probe-level", "compute"]),
+            nodes=fx.tpu_v5e_single_host(),
+        )
+        assert code == 3  # floor failure demotes effective readiness
+        out = capsys.readouterr().out
+        assert "Perf floors: FAILED" in out
+        assert "matmul_tflops" in out
+
+    def test_fleet_rollup_separates_floor_failures(self, tmp_path):
+        import json as _json
+
+        from tests import fixtures as fx
+        from tpu_node_checker import checker, cli
+        from tpu_node_checker.metrics import render_metrics
+
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        # h0: dead (enumeration failed); h1: slow (floor failed); h2: ok.
+        (reports / "gke-tpu-v5p-0.json").write_text(
+            _json.dumps({"ok": False, "hostname": "gke-tpu-v5p-0",
+                         "level": "compute", "error": "no chips"})
+        )
+        (reports / "gke-tpu-v5p-1.json").write_text(
+            _json.dumps({
+                "ok": False, "hostname": "gke-tpu-v5p-1", "level": "compute",
+                "error": "perf_floor: matmul_tflops ...",
+                "perf_floor": {"ok": False, "failed": ["matmul_tflops"],
+                               "ratios": {"matmul_tflops": 0.1}},
+            })
+        )
+        (reports / "gke-tpu-v5p-2.json").write_text(
+            _json.dumps({"ok": True, "hostname": "gke-tpu-v5p-2",
+                         "level": "compute"})
+        )
+        result = checker.run_check(
+            cli.parse_args(["--probe-results", str(reports), "--json"]),
+            nodes=fx.tpu_v5p_64_slice(),
+        )
+        summary = result.payload["probe_summary"]
+        assert summary["hosts_failed"] == ["gke-tpu-v5p-0", "gke-tpu-v5p-1"]
+        assert summary["hosts_floor_failed"] == ["gke-tpu-v5p-1"]
+        text = render_metrics(result)
+        assert 'tpu_node_checker_probe_hosts{state="floor_failed"} 1' in text
+
     def test_skipped_grading_exports_no_floor_families(self):
         from tpu_node_checker.checker import CheckResult
         from tpu_node_checker.metrics import render_metrics

@@ -78,6 +78,15 @@ class TestDetect:
             "interruptible": True,
         }
 
+    def test_garbage_taint_key_never_crashes(self):
+        # API garbage: an unhashable taint key must not take down the
+        # checker (the reference-era defensive-parsing contract).
+        n = extract_node_info(
+            _tpu_node("h", taints=[{"key": ["weird"], "effect": "NoSchedule"},
+                                   MAINT_TAINT])
+        )
+        assert n.planned_disruptions == ("impending-termination",)
+
     def test_ordinary_taints_are_not_planned(self):
         n = extract_node_info(
             _tpu_node(

@@ -85,6 +85,21 @@ class TestSlackMessage:
         msg = report.format_slack_message(accel, ready, slices)
         assert "56/64 chips, DEGRADED" in msg
 
+    def test_probe_failed_bullet_names_the_reason(self):
+        # "Failed HOW" is the first question on an alert: the bullet carries
+        # the (truncated) probe error, not just a generic FAILED.
+        accel, ready, slices = _analyzed(fx.tpu_v5e_single_host())
+        accel[0].probe = {
+            "ok": False,
+            "level": "compute",
+            "error": "perf_floor: matmul_tflops 19.7 < floor 78.8 " + "x" * 200,
+        }
+        ready = [n for n in accel if n.effectively_ready]
+        msg = report.format_slack_message(accel, ready, slices, healthy=False)
+        assert "chip probe FAILED (perf_floor: matmul_tflops 19.7" in msg
+        assert "…" in msg  # long errors truncate visibly
+        assert "x" * 121 not in msg
+
     def test_large_fleet_lists_only_problem_nodes(self):
         # 64 hosts, 2 NotReady: exhaustive bullets would bury the signal
         # (and push Slack's limits); only the sick hosts are listed.

@@ -587,6 +587,18 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
                     if n.probe is not None and n.probe.get("level") == "missing"
                 ),
             }
+            floor_failed = sorted(
+                n.name
+                for n in probed
+                if not n.probe.get("ok")  # subset-of-hosts_failed invariant
+                and isinstance(n.probe.get("perf_floor"), dict)
+                and n.probe["perf_floor"].get("ok") is False
+            )
+            if floor_failed:
+                # "Dead" and "slow" are different repairs: hosts whose only
+                # failure is the perf floor still enumerate and compute —
+                # they need a thermal/cabling look, not a replacement.
+                payload["probe_summary"]["hosts_floor_failed"] = floor_failed
             if any(reports_skipped.values()):
                 # Reports present but refused (stale / future-dated /
                 # unreadable / version skew): a sick emitter population is
@@ -1223,6 +1235,18 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
                 f"{result.local_probe.get('device_count')} device(s), "
                 f"platform={result.local_probe.get('platform')}"
             )
+            floor = result.local_probe.get("perf_floor")
+            if isinstance(floor, dict):
+                if floor.get("skipped"):
+                    print(f"Perf floors: skipped — {floor['skipped']}")
+                elif floor.get("ok"):
+                    worst = min(floor.get("ratios", {}).values(), default=None)
+                    note = f" (worst ratio {worst}× of peak)" if worst is not None else ""
+                    print(f"Perf floors: cleared at {floor.get('fraction')}× "
+                          f"{floor.get('generation')} peak{note}")
+                else:
+                    from tpu_node_checker.probe.floors import floor_failure_message
+                    print(f"Perf floors: FAILED — {floor_failure_message(floor)}")
         if getattr(args, "debug", False):
             print()
             print("Timings (ms): " + json.dumps(result.payload.get("timings_ms", {})))

@@ -298,12 +298,14 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         for t in map(_as_dict, _as_list(spec.get("taints")))
     ]
     # Planned-disruption signals: dedup preserving taint order, so the JSON
-    # surface is stable for any taint ordering the API returns.
+    # surface is stable for any taint ordering the API returns.  Key must be
+    # a string — an unhashable garbage key (API garbage, fuzzed fixtures)
+    # must not crash the checker.
     planned = tuple(
         dict.fromkeys(
             PLANNED_DISRUPTION_TAINTS[t["key"]]
             for t in taints
-            if t["key"] in PLANNED_DISRUPTION_TAINTS
+            if isinstance(t["key"], str) and t["key"] in PLANNED_DISRUPTION_TAINTS
         )
     )
     interruptible = any(labels.get(k) == "true" for k in INTERRUPTIBLE_LABELS)

@@ -323,6 +323,12 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
                 ({"state": "ok"}, summary.get("hosts_ok", 0)),
                 ({"state": "failed"}, len(summary.get("hosts_failed", []))),
                 ({"state": "missing"}, len(summary.get("hosts_missing", []))),
+                # Subset of "failed" whose probe flunked the perf floor —
+                # throttled, not dead.  Always emitted (0 included) so the
+                # family's states stay consistent and recovery reads as a
+                # return to zero, not a vanished series.
+                ({"state": "floor_failed"},
+                 len(summary.get("hosts_floor_failed", []))),
             ],
         )
         skipped = summary.get("reports_skipped")

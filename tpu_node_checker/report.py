@@ -245,7 +245,13 @@ def format_slack_message(
         keys = ", ".join(f"{k}:{v}" for k, v in sorted(n.breakdown.items()))
         line = f"• `{n.name}`: {_status(n)}, devices: {n.accelerators} ({keys})"
         if n.probe is not None and not n.probe.get("ok"):
+            # "Failed HOW" is the first question on every alert; the error
+            # is truncated so a mass outage still fits Slack's limits.
             line += " — chip probe FAILED"
+            err = n.probe.get("error")
+            if err:
+                err = str(err)
+                line += f" ({err[:120]}{'…' if len(err) > 120 else ''})"
         lines.append(line)
     planned_sick = [n for n in accel if n.sickness_planned]
     if planned_sick:
