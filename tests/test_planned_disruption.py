@@ -193,9 +193,23 @@ class TestSurfaces:
         slices = group_slices(infos)
         ready = [n for n in infos if n.effectively_ready]
         msg = report.format_slack_message(infos, ready, slices, healthy=False)
+        assert msg.startswith(
+            "⚠️ *Accelerator node check: degraded (planned maintenance "
+            "in progress)*"
+        )
         assert "DEGRADED (maintenance)" in msg
         assert "planned disruption" in msg
         assert "maintenance" in msg
+
+    def test_one_unexplained_fault_keeps_the_incident_header(self):
+        nodes = self._cluster()
+        nodes.append(_tpu_node("h4", ready=False))  # no planned signal
+        infos = [extract_node_info(n) for n in nodes]
+        slices = group_slices(infos)
+        ready = [n for n in infos if n.effectively_ready]
+        msg = report.format_slack_message(infos, ready, slices, healthy=False)
+        assert "planned maintenance in progress" not in msg.splitlines()[0]
+        assert "slice incomplete or chip probe failed" in msg.splitlines()[0]
 
     def test_unplanned_outage_slack_has_no_maintenance_words(self):
         nodes = [_tpu_node(f"h{i}") for i in range(3)]

@@ -112,6 +112,75 @@ class TestDistributedRendezvous:
             assert r.details.get("ici_topology") == "4x4"
             assert r.details.get("ici_axis_ok") == {"t0": True, "t1": True}
 
+    def test_two_process_dcn_fault_domain(self, monkeypatch):
+        # The DCN fault domain over a REAL rendezvous: 2 processes x 8 local
+        # devices, rehearsed as 2 slices (CPU devices carry no slice_index),
+        # per-slice torus 2x4.  The hybrid mesh's dcn axis then coincides
+        # with the process boundary — exactly the real multislice layout —
+        # and every rank must see the same replicated per-domain verdicts
+        # and a cross-slice bandwidth figure.
+        monkeypatch.setenv("TNC_CHAOS_SLICES", "2")
+        coord = f"127.0.0.1:{_free_port()}"
+
+        def probe(pid):
+            return run_local_probe(
+                level="collective",
+                timeout_s=600,
+                distributed=True,
+                coordinator=coord,
+                num_processes=2,
+                process_id=pid,
+                dist_init_timeout_s=120,
+                topology="2x4",
+                expected_devices=2 * LOCAL_DEVICES,
+            )
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            r0, r1 = list(pool.map(probe, [0, 1]))
+
+        for rank, r in enumerate((r0, r1)):
+            assert r.ok, f"rank {rank}: {r.error}"
+            assert r.details.get("chaos_injected") == {"slices": 2}
+            assert r.details.get("fault_domain_ok") == {
+                "dcn": True, "t0": True, "t1": True,
+            }
+            assert r.details.get("fault_domain_topology") == "2x2x4"
+            bw = r.details.get("fault_domain_busbw_gbps")
+            assert set(bw) == {"dcn", "t0", "t1"}
+            assert bw["dcn"] and bw["dcn"] > 0
+            assert r.details.get("dcn_busbw_gbps") == bw["dcn"]
+
+    def test_two_process_dcn_fault_named_across_the_rendezvous(self, monkeypatch):
+        # Inject a fault on the slice boundary; BOTH ranks must name "dcn"
+        # (and only dcn) — the localization verdict is replicated, so every
+        # host of a real multislice job reports the same repair target.
+        monkeypatch.setenv("TNC_CHAOS_SLICES", "2")
+        monkeypatch.setenv("TNC_CHAOS_AXIS", "dcn")
+        coord = f"127.0.0.1:{_free_port()}"
+
+        def probe(pid):
+            return run_local_probe(
+                level="collective",
+                timeout_s=600,
+                distributed=True,
+                coordinator=coord,
+                num_processes=2,
+                process_id=pid,
+                dist_init_timeout_s=120,
+                topology="2x4",
+                expected_devices=2 * LOCAL_DEVICES,
+            )
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            r0, r1 = list(pool.map(probe, [0, 1]))
+
+        for rank, r in enumerate((r0, r1)):
+            assert not r.ok, f"rank {rank} should have failed"
+            assert r.details.get("fault_domain_ok") == {
+                "dcn": False, "t0": True, "t1": True,
+            }
+            assert "DCN slice boundary" in (r.error or ""), r.error
+
     def test_two_process_workload_level(self):
         # The strongest grade across processes: the sharded transformer train
         # step (data=8 x model=2 over all 16 global devices), ring attention,

@@ -92,13 +92,17 @@ class TestSlackMessage:
         accel[0].probe = {
             "ok": False,
             "level": "compute",
-            "error": "perf_floor: matmul_tflops 19.7 < floor 78.8 " + "x" * 200,
+            "error": "perf_floor: matmul_tflops 19.7 <\nfloor 78.8 " + "x" * 200,
         }
         ready = [n for n in accel if n.effectively_ready]
         msg = report.format_slack_message(accel, ready, slices, healthy=False)
-        assert "chip probe FAILED (perf_floor: matmul_tflops 19.7" in msg
+        assert "chip probe FAILED (perf_floor: matmul_tflops 19.7 < floor" in msg
         assert "…" in msg  # long errors truncate visibly
         assert "x" * 121 not in msg
+        # Newlines in traceback tails are collapsed — a bullet must stay
+        # one Slack line.
+        bullet = [l for l in msg.splitlines() if "chip probe FAILED" in l][0]
+        assert "floor 78.8" in bullet
 
     def test_large_fleet_lists_only_problem_nodes(self):
         # 64 hosts, 2 NotReady: exhaustive bullets would bury the signal

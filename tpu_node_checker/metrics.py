@@ -26,7 +26,7 @@ Exported metric families:
   ``..._probe_dcn_busbw_gbps`` — cross-slice bandwidth;
 * ``tpu_node_checker_probe_reports_skipped{reason}`` — refused report files
   (stale / future_skew / unreadable / schema);
-* ``tpu_node_checker_probe_hosts{state="reported|ok|failed|missing"}`` — the
+* ``tpu_node_checker_probe_hosts{state="reported|ok|failed|missing|floor_failed"}`` — the
   ``--probe-results`` fleet roll-up, plus
   ``tpu_node_checker_probe_host_unhealthy{host,state}`` naming each sick host;
 * ``tpu_node_checker_multislice_{complete,ready_chips,slices}{group}`` — the

@@ -223,8 +223,23 @@ def format_slack_message(
     """
     if healthy is None:
         healthy = bool(ready)
+    sick = [n for n in accel if not n.effectively_ready]
+    # Header-level planned context, under the same conservative rule as the
+    # trend split: EVERY sick node must carry a hard planned signal and
+    # every incomplete slice the matching context — one unexplained fault
+    # keeps the incident header.
+    planned_only = (
+        bool(sick)
+        and all(n.sickness_planned for n in sick)
+        and all(s.complete or s.planned_context for s in slices)
+    )
     if ready and healthy:
         header = "✅ *Accelerator node check: OK*"
+    elif ready and planned_only:
+        header = (
+            "⚠️ *Accelerator node check: degraded (planned maintenance "
+            "in progress)*"
+        )
     elif ready:
         header = "⚠️ *Accelerator node check: degraded (slice incomplete or chip probe failed)*"
     elif accel:
@@ -250,7 +265,9 @@ def format_slack_message(
             line += " — chip probe FAILED"
             err = n.probe.get("error")
             if err:
-                err = str(err)
+                # Collapse whitespace: a traceback tail with newlines would
+                # break the bullet into unbulleted message lines.
+                err = " ".join(str(err).split())
                 line += f" ({err[:120]}{'…' if len(err) > 120 else ''})"
         lines.append(line)
     planned_sick = [n for n in accel if n.sickness_planned]
