@@ -158,6 +158,40 @@ class TestGradeFloors:
         )
         assert v["ok"] is True and v["generation"] == "custom"
 
+    def test_sustained_tflops_grades_against_bf16_peak(self):
+        # A chip that passes the cold one-shot burn but throttles over the
+        # soak: sustained median is graded against the same bf16 peak.
+        spec = CHIP_SPECS["v5e"]
+        v = grade_floors(
+            ["TPU v5e"], "tpu",
+            {"matmul_tflops": spec["matmul_tflops"] * 0.8,
+             "sustained_tflops": spec["matmul_tflops"] * 0.1},
+        )
+        assert v["ok"] is False
+        assert v["failed"] == ["sustained_tflops"]
+        assert v["expected"]["sustained_tflops"] == spec["matmul_tflops"]
+        msg = floor_failure_message(v)
+        assert "sustained_tflops" in msg
+
+    def test_sustained_alias_never_applies_to_custom_expectations(self):
+        # TNC_PERF_EXPECT naming only matmul_tflops means "grade the cold
+        # burn": the alias must not volunteer sustained grading the
+        # operator never asked for.
+        v = grade_floors(
+            None, "cpu",
+            {"matmul_tflops": 60.0, "sustained_tflops": 0.001},
+            expectations={"matmul_tflops": 50.0},
+        )
+        assert v["ok"] is True
+        assert set(v["ratios"]) == {"matmul_tflops"}
+        # Naming it explicitly still grades it.
+        v = grade_floors(
+            None, "cpu",
+            {"sustained_tflops": 0.001},
+            expectations={"sustained_tflops": 50.0},
+        )
+        assert v["ok"] is False and v["failed"] == ["sustained_tflops"]
+
     def test_every_generation_spec_is_sane(self):
         for gen, spec in CHIP_SPECS.items():
             assert spec.keys() <= set(FLOOR_METRICS), gen

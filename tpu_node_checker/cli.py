@@ -99,9 +99,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--log-jsonl", metavar="FILE",
                    help="append one JSON line per check round to FILE (trend log)")
     p.add_argument("--trend", metavar="FILE",
-                   help="summarize a --log-jsonl trend log (availability, state "
-                   "transitions, longest outage) and exit — post-incident "
-                   "analysis; runs alone")
+                   help="summarize a --log-jsonl trend log (availability — "
+                   "time-weighted and excluding planned maintenance — state "
+                   "transitions with their causes, longest outage) and exit "
+                   "— post-incident analysis; runs alone")
 
     probe = p.add_argument_group("Chip probe (data-plane liveness)")
     probe.add_argument("--probe", action="store_true",

@@ -431,6 +431,15 @@ try:
             os.environ.get("TNC_PERF_FLOOR_MAX_DISPATCH_MS") or 0
         ) or None
         measured = {m: out.get(m) for m in FLOOR_METRICS}
+        if isinstance(out.get("soak"), dict):
+            # Sustained throughput from the soak rounds: a chip can pass the
+            # cold one-shot burn and throttle as the soak heats it.  Only a
+            # REAL median grades — a soak that crashed before producing data
+            # reports 0.0, and "soak errored" must not masquerade as
+            # "chip throttled".
+            _med = out["soak"].get("tflops_median")
+            if isinstance(_med, (int, float)) and _med > 0:
+                measured["sustained_tflops"] = _med
         if any(v is not None for v in measured.values()) or chaos.get("throttle"):
             kw = {}
             if max_disp is not None:
