@@ -248,6 +248,20 @@ class TestFloorsInProbeChild:
         assert r.details.get("chaos_injected") == {"throttle": "matmul_tflops"}
         assert "TNC_CHAOS_THROTTLE" in (r.error or "")
 
+    def test_soak_median_graded_as_sustained(self, monkeypatch):
+        # End-to-end wiring: a short soak's tflops_median feeds floor
+        # grading as sustained_tflops when the expectations name it.
+        monkeypatch.setenv(
+            "TNC_PERF_EXPECT", json.dumps({"sustained_tflops": 1e9})
+        )
+        monkeypatch.setenv("TNC_SOAK_MIN_RATIO", "0")  # CPU jitter
+        r = run_local_probe(level="compute", timeout_s=400, soak_s=1.0)
+        assert not r.ok
+        floor = r.details["perf_floor"]
+        assert floor["failed"] == ["sustained_tflops"]
+        assert floor["measured"]["sustained_tflops"] > 0
+        assert "sustained_tflops" in (r.error or "")
+
     def test_perf_floor_zero_disables_via_flag_plumbing(self, monkeypatch):
         monkeypatch.setenv("TNC_PERF_EXPECT", json.dumps({"matmul_tflops": 1e9}))
         r = run_local_probe(level="compute", timeout_s=300, perf_floor=0)
