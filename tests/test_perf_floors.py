@@ -17,8 +17,10 @@ from tpu_node_checker.probe.floors import (
     CHIP_SPECS,
     DEFAULT_FLOOR_FRACTION,
     FLOOR_METRICS,
+    HBM_CAPACITY_GB,
     floor_failure_message,
     grade_floors,
+    grade_hbm_capacity,
 )
 from tpu_node_checker.probe.liveness import run_local_probe
 
@@ -196,6 +198,78 @@ class TestGradeFloors:
         for gen, spec in CHIP_SPECS.items():
             assert spec.keys() <= set(FLOOR_METRICS), gen
             assert all(v > 0 for v in spec.values()), gen
+
+
+class TestHbmCapacity:
+    """Capacity grading: a chip exposing half its HBM is sick even when
+    every throughput and numerics gate passes — and unlike timing floors,
+    bytes_limit is transport-insensitive."""
+
+    def _mem(self, *gb, ids=None):
+        return [
+            {"id": ids[i] if ids else i, "bytes_in_use": 0,
+             "bytes_limit": int(g * 1e9)}
+            for i, g in enumerate(gb)
+        ]
+
+    def test_healthy_chips_pass_with_runtime_reservation(self):
+        # A ~7% runtime carve-out off the 16 GB nominal must pass.
+        v = grade_hbm_capacity(["TPU v5e"], "tpu", self._mem(14.9, 15.1, 15.0, 14.9))
+        assert v["ok"] is True
+        assert v["generation"] == "v5e"
+        assert v["min_gb"] == 14.9
+        assert v["failed_devices"] == []
+
+    def test_half_hbm_chip_fails_naming_the_device(self):
+        v = grade_hbm_capacity(["TPU v5e"], "tpu", self._mem(15.5, 8.0, 15.6, 15.5))
+        assert v["ok"] is False
+        assert v["failed_devices"] == [{"id": 1, "gb": 8.0}]
+
+    def test_zero_limit_chip_fails_not_slips_through(self):
+        # The worst case — a chip exposing NO HBM while its peers are
+        # healthy — must fail at 0, not vanish from the parse.
+        v = grade_hbm_capacity(
+            ["TPU v5e"], "tpu",
+            self._mem(15.5, 15.6) + [{"id": 2, "bytes_limit": 0},
+                                     {"id": 3, "bytes_limit": None}],
+        )
+        assert v["ok"] is False
+        assert v["failed_devices"] == [{"id": 2, "gb": 0.0}, {"id": 3, "gb": 0.0}]
+        assert v["min_gb"] == 0.0
+
+    def test_v2_v3_capacity_is_per_core_device(self):
+        # On v2/v3 a JAX device is a TensorCore with HALF the chip's HBM;
+        # a healthy v2 core (~7.5 GB of its 8 GB) must pass.
+        v = grade_hbm_capacity(["TPU v2"], "tpu", self._mem(7.5, 7.5))
+        assert v["ok"] is True, v
+        v = grade_hbm_capacity(["TPU v3"], "tpu", self._mem(15.0))
+        assert v["ok"] is True, v
+
+    def test_skips_visibly(self):
+        assert "skipped" in grade_hbm_capacity(["cpu"], "cpu", self._mem(16))
+        assert "skipped" in grade_hbm_capacity(["TPU v99"], "tpu", self._mem(16))
+        assert "skipped" in grade_hbm_capacity(["TPU v5e"], "tpu", [])
+        # ALL limits absent (None) = runtime without memory_stats: skip.
+        assert "skipped" in grade_hbm_capacity(
+            ["TPU v5e"], "tpu", [{"id": 0, "bytes_limit": None}]
+        )
+        assert "skipped" in grade_hbm_capacity(
+            ["TPU v5e"], "tpu", self._mem(1.0), fraction=0
+        )
+
+    def test_all_zero_limits_fail_not_skip(self):
+        # Explicit zeros are REPORTS: every chip exposing 0 GB is the worst
+        # uniform fault, not a missing-stats runtime — it must fail.
+        v = grade_hbm_capacity(
+            ["TPU v5e"], "tpu",
+            [{"id": 0, "bytes_limit": 0}, {"id": 1, "bytes_limit": 0}],
+        )
+        assert v["ok"] is False
+        assert len(v["failed_devices"]) == 2
+
+    def test_every_generation_has_capacity(self):
+        assert set(HBM_CAPACITY_GB) == set(CHIP_SPECS)
+        assert all(v > 0 for v in HBM_CAPACITY_GB.values())
 
 
 class TestFloorsInProbeChild:
@@ -382,6 +456,30 @@ class TestFloorsCliAndMetrics:
         assert summary["hosts_floor_failed"] == ["gke-tpu-v5p-1"]
         text = render_metrics(result)
         assert 'tpu_node_checker_probe_hosts{state="floor_failed"} 1' in text
+
+    def test_hbm_capacity_families(self):
+        from tpu_node_checker.checker import CheckResult
+        from tpu_node_checker.metrics import render_metrics
+
+        result = CheckResult(exit_code=0)
+        result.payload = {
+            "total_nodes": 1, "ready_nodes": 1, "slices": [],
+            "local_probe": {
+                "ok": False, "level": "enumerate",
+                "hbm_capacity": {
+                    "generation": "v5e", "expected_gb": 16.0, "fraction": 0.9,
+                    "min_gb": 8.0,
+                    "failed_devices": [{"id": 1, "gb": 8.0}], "ok": False,
+                },
+            },
+            "timings_ms": {"total": 1.0},
+        }
+        text = render_metrics(result)
+        assert 'tpu_node_checker_probe_hbm_capacity_ok{generation="v5e"} 0.0' in text
+        assert "tpu_node_checker_probe_hbm_min_gb 8.0" in text
+        # A skipped stamp emits no capacity families.
+        result.payload["local_probe"]["hbm_capacity"] = {"skipped": "x"}
+        assert "hbm_capacity_ok" not in render_metrics(result)
 
     def test_skipped_grading_exports_no_floor_families(self):
         from tpu_node_checker.checker import CheckResult

@@ -197,6 +197,22 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             if isinstance(value, bool):
                 family(f"tpu_node_checker_{suffix}", "gauge", help_text,
                        [({}, 1.0 if value else 0.0)])
+        cap = probe.get("hbm_capacity")
+        if isinstance(cap, dict) and "min_gb" in cap:
+            family(
+                "tpu_node_checker_probe_hbm_capacity_ok",
+                "gauge",
+                "1 when every device exposes ~nominal HBM for its generation "
+                "(a low bytes_limit is a dead memory channel).",
+                [({"generation": str(cap.get("generation") or "")},
+                  1.0 if cap.get("ok") else 0.0)],
+            )
+            family(
+                "tpu_node_checker_probe_hbm_min_gb",
+                "gauge",
+                "Smallest per-device HBM bytes_limit observed, in decimal GB.",
+                [({}, cap["min_gb"])],
+            )
         floor = probe.get("perf_floor")
         if isinstance(floor, dict) and isinstance(floor.get("ratios"), dict):
             # Floor grading (probe/floors.py): the measured/peak ratio per

@@ -87,6 +87,92 @@ CHIP_SPECS: dict = {
     },
 }
 
+# Nominal HBM capacity per PJRT *device* in decimal GB, by generation — a
+# CAPACITY check, separate from the throughput floors: a chip exposing half
+# its HBM (a dead memory channel) otherwise passes every gate, and unlike
+# wall-clock throughput this number is transport-insensitive, so it grades
+# even where dispatch overhead disqualifies the timing floors.  Units match
+# the spec sheets (decimal GB, compared against bytes_limit/1e9) so the
+# fraction below keeps its full meaning.  On v2/v3 a JAX device is a
+# TensorCore with HALF the chip's HBM (v2: 8 GB/core, v3: 16 GB/core);
+# v4+ are megacore — one device per chip.
+HBM_CAPACITY_GB = {
+    "v2": 8.0,
+    "v3": 16.0,
+    "v4": 32.0,
+    "v5e": 16.0,
+    "v5p": 95.0,
+    "v6e": 32.0,
+}
+# The runtime reserves a slice of HBM, so bytes_limit sits below nominal on
+# healthy chips; 90% of nominal separates "reserved carve-out" from
+# "missing memory channel".
+HBM_CAPACITY_FRACTION = 0.9
+
+
+def grade_hbm_capacity(
+    device_kinds: Optional[Sequence[str]],
+    platform: Optional[str],
+    memory: Sequence[Mapping],
+    fraction: float = HBM_CAPACITY_FRACTION,
+) -> dict:
+    """Grade each device's exposed ``bytes_limit`` against nominal HBM.
+
+    ``memory`` is the probe's per-device list (``{id, bytes_in_use,
+    bytes_limit}``).  Returns ``{"skipped": reason}`` (disabled, off-TPU,
+    unknown generation, no usable limits at all) or::
+
+        {"generation", "expected_gb", "fraction", "min_gb",
+         "failed_devices": [{"id", "gb"}, ...], "ok"}
+
+    A device whose peers report positive limits but which itself reports
+    zero/None is graded FAILED at 0 GB — the worst case (a chip exposing no
+    HBM) must not slip through the parse filter.  Only when *no* device
+    reports a limit is the check skipped (runtime without memory_stats).
+    """
+    if fraction is None or fraction <= 0:
+        return {"skipped": "disabled (TNC_HBM_CAPACITY_FLOOR=0)"}
+    if platform != "tpu":
+        return {"skipped": f"platform {platform!r} has no HBM capacity table"}
+    generation = generation_of_kinds(device_kinds)
+    expected = HBM_CAPACITY_GB.get(generation or "")
+    if expected is None:
+        return {
+            "skipped": (
+                f"device kinds {list(device_kinds or [])!r} resolve to no "
+                "single known generation"
+            )
+        }
+    limits = []
+    any_reported = False
+    for m in memory or []:
+        if not isinstance(m, Mapping):
+            continue
+        raw = m.get("bytes_limit")
+        numeric = isinstance(raw, (int, float)) and not isinstance(raw, bool)
+        if numeric:
+            # An explicit 0 is a REPORT (a chip exposing no HBM — graded,
+            # and failed); only absent/None limits mean the runtime has no
+            # memory_stats to give.
+            any_reported = True
+        gb = float(raw) / 1e9 if numeric and raw > 0 else 0.0
+        limits.append((m.get("id"), gb))
+    if not limits or not any_reported:
+        return {"skipped": "no per-device bytes_limit reported"}
+    floor = fraction * expected
+    failed = [
+        {"id": did, "gb": round(gb, 2)} for did, gb in limits if gb < floor
+    ]
+    return {
+        "generation": generation,
+        "expected_gb": expected,
+        "fraction": fraction,
+        "min_gb": round(min(gb for _, gb in limits), 2),
+        "failed_devices": failed,
+        "ok": not failed,
+    }
+
+
 # Probe report keys that participate in floor grading.
 FLOOR_METRICS = (
     "matmul_tflops",

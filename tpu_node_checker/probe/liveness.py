@@ -57,6 +57,7 @@ import json, sys, time
 level = sys.argv[1]
 out = {"ok": False, "level": level}
 t0 = time.perf_counter()
+hbm_capacity_error = None
 try:
     import os
     import jax
@@ -105,12 +106,38 @@ try:
             mem.append({"id": d.id, "bytes_in_use": int(in_use),
                         "bytes_limit": int(limit) if limit is not None else None})
     if mem:
-        # Telemetry only, no verdict: this child is a fresh PJRT client, so
-        # bytes_in_use reflects its OWN allocations — a chip held by another
-        # job surfaces as an init failure above, not as memory pressure.
-        # bytes_limit still confirms each chip exposes the HBM its device
-        # kind should have.
         out["memory"] = mem
+    # bytes_in_use is telemetry only (this child is a fresh PJRT client, so
+    # it reflects our OWN allocations — a chip held by another job surfaces
+    # as an init failure above, not as memory pressure), but bytes_limit
+    # GRADES: each chip must expose ~nominal HBM for its generation, or a
+    # dead memory channel passes every other gate.  Capacity is
+    # transport-insensitive, so this runs even where dispatch overhead
+    # disqualifies the timing floors.
+    from tpu_node_checker.probe.floors import grade_hbm_capacity
+    # "0" disables (grade_hbm_capacity skips); unset -> default 0.9.
+    _hcf = os.environ.get("TNC_HBM_CAPACITY_FLOOR")
+    _kw = {"fraction": float(_hcf)} if _hcf else {}
+    cap = grade_hbm_capacity(
+        out.get("device_kinds"), out.get("platform"), mem, **_kw
+    )
+    # Stamped even when skipped — including "no memory_stats at all" (mem
+    # empty): "check not applicable here" must be distinguishable from
+    # "check silently not running" (same contract as perf_floor).
+    out["hbm_capacity"] = cap
+    if "skipped" not in cap and not cap["ok"]:
+        # Recorded now, folded into ok at the END of the run: the
+        # compute/collective/workload diagnostics must still execute —
+        # triage needs their figures MOST when a chip is already sick.
+        bad = ", ".join(
+            f"device {f['id']}: {f['gb']} GB" for f in cap["failed_devices"]
+        )
+        hbm_capacity_error = (
+            f"hbm_capacity: {bad} < "
+            f"{round(cap['fraction'] * cap['expected_gb'], 1)} GB "
+            f"({cap['fraction']:.0%} of {cap['generation']} nominal "
+            f"{cap['expected_gb']} GB)"
+        )
     slice_ids = sorted({getattr(d, "slice_index", None) for d in devices} - {None})
     if slice_ids:
         # Multislice (DCN-joined) job: PJRT tags each device with its slice.
@@ -505,6 +532,15 @@ try:
             ep = moe_probe()
             out["moe_ok"] = ep.ok
             out["ok"] = out["ok"] and pp.ok and ep.ok
+    if hbm_capacity_error:
+        # Folded LAST so every downstream diagnostic above still ran with
+        # its figures intact; the verdict and the named device land here.
+        out["ok"] = False
+        out["error"] = (
+            f"{out['error']}; {hbm_capacity_error}"
+            if out.get("error")
+            else hbm_capacity_error
+        )
 except Exception as exc:  # noqa: BLE001 - the whole point is to catch anything
     # ok may already be True from a completed earlier stage (enumeration
     # succeeds, then a collective raises); a crash anywhere is a failed probe.
