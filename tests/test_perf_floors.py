@@ -336,6 +336,19 @@ class TestFloorsInProbeChild:
         assert floor["measured"]["sustained_tflops"] > 0
         assert "sustained_tflops" in (r.error or "")
 
+    def test_malformed_floor_env_vars_name_the_var(self, monkeypatch):
+        # A config typo must read as a config typo, not a hardware fault —
+        # --cordon-failed acts on probe failures.
+        monkeypatch.setenv("TNC_HBM_CAPACITY_FLOOR", "ten")
+        r = run_local_probe(level="enumerate", timeout_s=120)
+        assert not r.ok
+        assert "TNC_HBM_CAPACITY_FLOOR" in (r.error or "")
+        monkeypatch.delenv("TNC_HBM_CAPACITY_FLOOR")
+        monkeypatch.setenv("TNC_PERF_FLOOR", "0.4%")
+        r = run_local_probe(level="compute", timeout_s=300)
+        assert not r.ok
+        assert "TNC_PERF_FLOOR" in (r.error or "")
+
     def test_perf_floor_zero_disables_via_flag_plumbing(self, monkeypatch):
         monkeypatch.setenv("TNC_PERF_EXPECT", json.dumps({"matmul_tflops": 1e9}))
         r = run_local_probe(level="compute", timeout_s=300, perf_floor=0)

@@ -330,6 +330,15 @@ class TestChildCrashGrading:
         (fake / "ops.py").write_text(
             'raise RuntimeError("injected post-enumeration failure")\n'
         )
+        # The enumerate stage imports probe.floors (HBM capacity stamp)
+        # before any ops import; the shadow must satisfy it so the injected
+        # failure lands where this test means it to — at the compute stage.
+        (fake / "probe").mkdir()
+        (fake / "probe" / "__init__.py").write_text("")
+        (fake / "probe" / "floors.py").write_text(
+            "def grade_hbm_capacity(*a, **k):\n"
+            "    return {'skipped': 'shadow package'}\n"
+        )
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(liveness.__file__)))
         )

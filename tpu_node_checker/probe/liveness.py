@@ -56,6 +56,15 @@ _CHILD_SCRIPT = r"""
 import json, sys, time
 level = sys.argv[1]
 out = {"ok": False, "level": level}
+
+
+def _append_error(msg):
+    # Every late-folding verdict uses this: demote ok and chain the message
+    # onto whatever error is already standing.
+    out["ok"] = False
+    out["error"] = f"{out['error']}; {msg}" if out.get("error") else msg
+
+
 t0 = time.perf_counter()
 hbm_capacity_error = None
 try:
@@ -95,16 +104,23 @@ try:
     out["process_index"] = jax.process_index()
     out["process_count"] = jax.process_count()
     out["ok"] = len(devices) > 0
-    mem = []
+    mem = []         # report surface: devices exposing at least one stat
+    mem_graded = []  # grading surface: EVERY local device — a chip whose
+                     # memory_stats() raises must be VISIBLE to the capacity
+                     # check (None limit fails when its peers report real
+                     # ones), not silently absent from it.
     for d in jax.local_devices():
         try:
             s = d.memory_stats() or {}
         except Exception:
             s = {}
         in_use, limit = s.get("bytes_in_use"), s.get("bytes_limit")
-        if in_use is not None:
-            mem.append({"id": d.id, "bytes_in_use": int(in_use),
-                        "bytes_limit": int(limit) if limit is not None else None})
+        entry = {"id": d.id,
+                 "bytes_in_use": int(in_use) if in_use is not None else None,
+                 "bytes_limit": int(limit) if limit is not None else None}
+        mem_graded.append(entry)
+        if in_use is not None or limit is not None:
+            mem.append(entry)
     if mem:
         out["memory"] = mem
     # bytes_in_use is telemetry only (this child is a fresh PJRT client, so
@@ -117,9 +133,16 @@ try:
     from tpu_node_checker.probe.floors import grade_hbm_capacity
     # "0" disables (grade_hbm_capacity skips); unset -> default 0.9.
     _hcf = os.environ.get("TNC_HBM_CAPACITY_FLOOR")
-    _kw = {"fraction": float(_hcf)} if _hcf else {}
+    try:
+        _kw = {"fraction": float(_hcf)} if _hcf else {}
+    except ValueError:
+        # A config typo must read as a config typo, not a hardware fault
+        # (--cordon-failed acts on probe failures).
+        raise ValueError(
+            f"TNC_HBM_CAPACITY_FLOOR {_hcf!r} is not a number"
+        )
     cap = grade_hbm_capacity(
-        out.get("device_kinds"), out.get("platform"), mem, **_kw
+        out.get("device_kinds"), out.get("platform"), mem_graded, **_kw
     )
     # Stamped even when skipped — including "no memory_stats at all" (mem
     # empty): "check not applicable here" must be distinguishable from
@@ -450,7 +473,13 @@ try:
         )
         frac = DEFAULT_FLOOR_FRACTION
         if os.environ.get("TNC_PERF_FLOOR"):
-            frac = float(os.environ["TNC_PERF_FLOOR"])
+            try:
+                frac = float(os.environ["TNC_PERF_FLOOR"])
+            except ValueError:
+                raise ValueError(
+                    f"TNC_PERF_FLOOR {os.environ['TNC_PERF_FLOOR']!r} is "
+                    "not a number"
+                )
         expect = None
         if os.environ.get("TNC_PERF_EXPECT"):
             expect = json.loads(os.environ["TNC_PERF_EXPECT"])
@@ -483,11 +512,7 @@ try:
             )
             out["perf_floor"] = verdict
             if not verdict.get("ok", True):
-                out["ok"] = False
-                msg = floor_failure_message(verdict)
-                out["error"] = (
-                    f"{out['error']}; {msg}" if out.get("error") else msg
-                )
+                _append_error(floor_failure_message(verdict))
     if level == "workload" and out["ok"]:
         import jax as _jax
         from tpu_node_checker.models import BurninConfig, workload_probe
@@ -535,12 +560,7 @@ try:
     if hbm_capacity_error:
         # Folded LAST so every downstream diagnostic above still ran with
         # its figures intact; the verdict and the named device land here.
-        out["ok"] = False
-        out["error"] = (
-            f"{out['error']}; {hbm_capacity_error}"
-            if out.get("error")
-            else hbm_capacity_error
-        )
+        _append_error(hbm_capacity_error)
 except Exception as exc:  # noqa: BLE001 - the whole point is to catch anything
     # ok may already be True from a completed earlier stage (enumeration
     # succeeds, then a collective raises); a crash anywhere is a failed probe.
