@@ -61,7 +61,16 @@ class TestRunLocalProbe:
         if mem is not None:
             for entry in mem:
                 assert isinstance(entry["id"], int)
-                assert isinstance(entry["bytes_in_use"], int)
+                # Either stat may be null (a runtime can expose bytes_limit
+                # without bytes_in_use, or vice versa), but each listed
+                # device reported at least one of them.
+                assert entry["bytes_in_use"] is None or isinstance(
+                    entry["bytes_in_use"], int
+                )
+                assert entry["bytes_limit"] is None or isinstance(
+                    entry["bytes_limit"], int
+                )
+                assert entry["bytes_in_use"] is not None or entry["bytes_limit"] is not None
 
 
 @pytest.mark.slow

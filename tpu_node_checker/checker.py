@@ -43,6 +43,7 @@ from tpu_node_checker.generations import (
     generations_of as _generations_of,
 )
 from tpu_node_checker.detect import (
+    HARD_PLANNED_DISRUPTIONS,
     NodeInfo,
     SliceInfo,
     group_multislices,
@@ -162,8 +163,6 @@ def _run_probe(
         result.local_probe = local.probe
     else:
         result.local_probe = probed.to_dict()
-
-
 
 
 def _flag_kind_mismatch(node: NodeInfo) -> None:
@@ -915,7 +914,7 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         if code != EXIT_OK and e.get("planned"):
             planned_outage_s += dt
     if intervals:
-        final_ts, final_code, final_e = rounds[-1]
+        _, final_code, final_e = rounds[-1]
         dt = statistics.median(intervals)
         state_seconds[final_code] = state_seconds.get(final_code, 0.0) + dt
         if final_code != EXIT_OK and final_e.get("planned"):
@@ -1089,7 +1088,6 @@ def _round_is_planned(payload: dict, exit_code: int) -> bool:
     """
     if exit_code == EXIT_OK or not payload.get("nodes"):
         return False
-    from tpu_node_checker.detect import HARD_PLANNED_DISRUPTIONS
 
     def _excused(n: dict) -> bool:
         # Mirror of NodeInfo.sickness_planned over the payload dict: a HARD

@@ -14,7 +14,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-from tpu_node_checker.detect import parse_topology
+from tpu_node_checker.detect import parse_topology, topology_chip_count
 
 
 @dataclass(frozen=True)
@@ -167,10 +167,7 @@ def hybrid_mesh(
         ]
     per_slice = len(groups[0])
     dims = parse_topology(topology)
-    total = 1
-    for d in dims or ():
-        total *= d
-    if dims is not None and total == per_slice:
+    if dims is not None and topology_chip_count(topology) == per_slice:
         # Coordinate-aware placement WITHIN each slice (same rationale as
         # mesh_from_topology): the torus axes must line up with the physical
         # ICI dimensions or per-axis fault localization names the wrong

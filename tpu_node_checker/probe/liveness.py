@@ -428,8 +428,7 @@ try:
             out["fault_domain_ok"] = (dom.details or {}).get("axis_ok")
             out["fault_domain_topology"] = (dom.details or {}).get("topology")
             if not dom.ok:
-                out["ok"] = False
-                out["error"] = dom.error
+                _append_error(dom.error)
             # Per-domain bandwidth: "dcn slow" vs "torus axis k slow" are
             # different escalations.
             bw, bw_err = _axis_bw_sweep(hmesh)
@@ -452,8 +451,7 @@ try:
             out["ici_axis_ok"] = (ax.details or {}).get("axis_ok")
             out["ici_topology"] = (ax.details or {}).get("topology")
             if not ax.ok:
-                out["ok"] = False
-                out["error"] = ax.error
+                _append_error(ax.error)
             bw, bw_err = _axis_bw_sweep(tmesh)
             out["ici_axis_busbw_gbps"] = bw
             if bw_err:
