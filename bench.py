@@ -71,14 +71,26 @@ def main() -> int:
     kubeconfig = tempfile.NamedTemporaryFile(
         "w", suffix=".kubeconfig", delete=False
     )
+    # kubectl-style block YAML — the representative on-disk shape (and the
+    # one the stdlib miniyaml fast path parses without importing PyYAML).
     kubeconfig.write(
-        f"""
+        f"""\
 apiVersion: v1
 kind: Config
 current-context: bench
-contexts: [{{name: bench, context: {{cluster: bench, user: bench}}}}]
-clusters: [{{name: bench, cluster: {{server: "http://127.0.0.1:{port}"}}}}]
-users: [{{name: bench, user: {{token: bench-token}}}}]
+contexts:
+- name: bench
+  context:
+    cluster: bench
+    user: bench
+clusters:
+- name: bench
+  cluster:
+    server: http://127.0.0.1:{port}
+users:
+- name: bench
+  user:
+    token: bench-token
 """
     )
     kubeconfig.close()

@@ -1,0 +1,223 @@
+"""The stdlib YAML-subset parser (utils/miniyaml.py).
+
+The contract under test: on every input it ACCEPTS, `safe_load_subset` must
+agree exactly with `yaml.safe_load` (the differential tests below), and on
+anything beyond the subset it must raise `UnsupportedYAML` — never silently
+mis-parse — so cluster.py's PyYAML fallback keeps exotic kubeconfigs fully
+correct while kubectl-style configs skip PyYAML's ~55 ms import.
+"""
+
+import pytest
+import yaml
+
+from tpu_node_checker.utils.miniyaml import UnsupportedYAML, safe_load_subset
+
+KUBECTL_STYLE = """\
+apiVersion: v1
+kind: Config
+current-context: gke_proj_zone_cluster
+preferences: {}
+clusters:
+- cluster:
+    certificate-authority-data: LS0tLS1CRUdJTg==
+    server: https://34.1.2.3
+  name: gke_proj_zone_cluster
+contexts:
+- context:
+    cluster: gke_proj_zone_cluster
+    user: gke_user
+  name: gke_proj_zone_cluster
+users:
+- name: gke_user
+  user:
+    exec:
+      apiVersion: client.authentication.k8s.io/v1beta1
+      command: gke-gcloud-auth-plugin
+      args: null
+      provideClusterInfo: true
+"""
+
+
+class TestDifferentialAgainstPyYAML:
+    """Everything the subset accepts must match yaml.safe_load exactly."""
+
+    CASES = [
+        KUBECTL_STYLE,
+        "a: 1\nb: two\nc: 3.5\nd: true\ne: false\nf: null\ng: ~\n",
+        "a: 'single quoted: colon'\nb: \"double \\\"q\\\" and\\ttab\"\n",
+        "top:\n  mid:\n    leaf: v\n  sibling: 2\n",
+        "items:\n- one\n- two\n- 3\n",
+        "list:\n- name: a\n  value: 1\n- name: b\n  value: 2\n",
+        "# leading comment\nkey: value  # trailing comment\n",
+        "empty_map: {}\nempty_list: []\nempty_val:\n",
+        "---\ndoc: with leading marker\n",
+        "nested:\n- - 1\n  - 2\n- - 3\n",
+        "mixed:\n- scalar\n- sub:\n    deep: true\n",
+        "ints: -5\nplus: +7\nfloat: -2.5e3\nnot_num: 1.2.3\n",
+        "weird key name: v\nkey2: a:b\n",
+        "tokenFile: /var/run/secrets/token\ninsecure-skip-tls-verify: true\n",
+        "",
+        "   \n# only comments\n",
+        # YAML 1.1 resolver case-sensitivity: mixed case stays a STRING.
+        "a: tRue\nb: nO\nc: nUll\nd: yes\ne: Off\n",
+        # Unicode digits and NBSP are content, never numbers/whitespace.
+        "a: ٣\nk : 1\n",
+        "hash_in_scalar: x#y\n",
+        "crlf: value\r\n",
+        # Signed dot-floats are STRINGS to PyYAML's 1.1 resolver; unsigned
+        # dot-floats and digit-led signed floats are numbers.
+        "negdot: -.5\nplusdot: +.5\ndot: .5\nsigned: -1.5\n",
+    ]
+
+    @pytest.mark.parametrize("text", CASES)
+    def test_matches_pyyaml(self, text):
+        assert safe_load_subset(text) == yaml.safe_load(text)
+
+    def test_bench_kubeconfig_shape(self):
+        text = KUBECTL_STYLE.replace("https://34.1.2.3", "http://127.0.0.1:5")
+        doc = safe_load_subset(text)
+        assert doc["clusters"][0]["cluster"]["server"] == "http://127.0.0.1:5"
+        assert doc["users"][0]["user"]["exec"]["provideClusterInfo"] is True
+
+
+class TestFuzzRoundtrip:
+    """Property: for ANY document safe_dump writes in block style, the
+    subset parser either refuses (fallback handles it) or agrees exactly
+    with yaml.safe_load.  Silent disagreement is the one forbidden
+    outcome."""
+
+    def test_roundtrip_against_pyyaml(self):
+        from hypothesis import given, settings, strategies as st
+
+        scalars = st.one_of(
+            st.none(),
+            st.booleans(),
+            st.integers(min_value=-10**6, max_value=10**6),
+            st.floats(allow_nan=False, allow_infinity=False, width=32),
+            st.text(max_size=12),
+            # Numeric-looking strings: the scalar-resolver branches are
+            # where silent divergence hides (signed dot-floats, octal,
+            # sexagesimal, dates) — force the generator into them.
+            st.from_regex(
+                r"[+-]?[0-9:._eE+-]{1,10}", fullmatch=True
+            ),
+            st.from_regex(
+                r"[0-9]{4}-[0-9]{2}-[0-9]{2}( [0-9:.]{1,8})?", fullmatch=True
+            ),
+        )
+        docs = st.recursive(
+            scalars,
+            lambda children: st.one_of(
+                st.lists(children, max_size=3),
+                st.dictionaries(st.text(max_size=8), children, max_size=3),
+            ),
+            max_leaves=12,
+        )
+
+        @settings(max_examples=300, deadline=None)
+        @given(docs)
+        def check(doc):
+            text = yaml.safe_dump(doc, default_flow_style=False,
+                                  allow_unicode=True)
+            try:
+                parsed = safe_load_subset(text)
+            except UnsupportedYAML:
+                return  # refusing is always allowed — PyYAML handles it
+            assert parsed == yaml.safe_load(text), text
+
+        check()
+
+
+class TestBailsInsteadOfGuessing:
+    """Anything beyond the subset raises; silent mis-parse is the one
+    failure mode this parser must never have."""
+
+    BAIL = [
+        "a: &anchor 1\nb: *anchor\n",  # anchors/aliases
+        "a: |\n  block\n  scalar\n",  # literal block
+        "a: >\n  folded\n",  # folded block
+        "a: {flow: map}\n",  # non-empty flow mapping
+        "a: [1, 2]\n",  # non-empty flow list
+        "a: !!str tagged\n",  # tags
+        "%YAML 1.2\na: b\n",  # directives
+        "a: 1\n---\nb: 2\n",  # multi-document
+        "? complex key\n: value\n",  # explicit key
+        "\ta: tab indent\n",  # tabs
+        "a: 'unterminated\n",  # quote spanning lines
+        "just a scalar line\n",  # no key, not a list
+        "a: <<: merge\n",
+        "a: x'y # z' w\n",  # quote inside a plain scalar (comment ambiguity)
+        "a: b: c\n",  # colon-space in a plain value (PyYAML parse error)
+        "date: 2026-07-30\n",  # 1.1 timestamp resolution
+        "ts: 2026-07-30 01:02:03\n",  # space-separated timestamp
+        "oct: 010\nsex: 1:30\nsexf: 1:30.5\n",  # exotic numerics
+        "a: -\n",  # bare dash: PyYAML parse error
+        "a: =\n",  # the 1.1 "=" value type: PyYAML constructor error
+    ]
+
+    @pytest.mark.parametrize("text", BAIL)
+    def test_raises_unsupported(self, text):
+        with pytest.raises(UnsupportedYAML):
+            safe_load_subset(text)
+
+
+class TestClusterFallback:
+    """cluster.py must accept BOTH styles: subset fast path and PyYAML
+    fallback for flow-style configs."""
+
+    def _config(self, tmp_path, text):
+        p = tmp_path / "kubeconfig"
+        p.write_text(text)
+        from tpu_node_checker.cluster import load_kubeconfig
+
+        return load_kubeconfig(str(p))
+
+    def test_block_style_fast_path(self, tmp_path):
+        cfg = self._config(
+            tmp_path,
+            "apiVersion: v1\ncurrent-context: c\n"
+            "contexts:\n- name: c\n  context:\n    cluster: cl\n    user: u\n"
+            "clusters:\n- name: cl\n  cluster:\n    server: https://h:6443\n"
+            "users:\n- name: u\n  user:\n    token: tok\n",
+        )
+        assert cfg.server == "https://h:6443"
+        assert cfg.token == "tok"
+
+    def test_flow_style_falls_back_to_pyyaml(self, tmp_path):
+        cfg = self._config(
+            tmp_path,
+            "apiVersion: v1\ncurrent-context: c\n"
+            "contexts: [{name: c, context: {cluster: cl, user: u}}]\n"
+            "clusters: [{name: cl, cluster: {server: 'https://h:6443'}}]\n"
+            "users: [{name: u, user: {token: tok}}]\n",
+        )
+        assert cfg.server == "https://h:6443"
+        assert cfg.token == "tok"
+
+    def test_pyyaml_not_imported_on_fast_path(self, tmp_path):
+        # The point of the subset parser: a kubectl-style config must not
+        # pay PyYAML's import. Run in a fresh interpreter and check
+        # sys.modules.
+        import subprocess
+        import sys
+
+        p = tmp_path / "kubeconfig"
+        p.write_text(
+            "apiVersion: v1\ncurrent-context: c\n"
+            "contexts:\n- name: c\n  context:\n    cluster: cl\n    user: u\n"
+            "clusters:\n- name: cl\n  cluster:\n    server: https://h:6443\n"
+            "users:\n- name: u\n  user:\n    token: tok\n"
+        )
+        code = (
+            "import sys\n"
+            "from tpu_node_checker.cluster import load_kubeconfig\n"
+            f"cfg = load_kubeconfig({str(p)!r})\n"
+            "assert cfg.token == 'tok'\n"
+            "assert 'yaml' not in sys.modules, 'PyYAML imported on fast path'\n"
+            "print('fast path ok')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "fast path ok" in proc.stdout

@@ -19,7 +19,7 @@ import traceback
 from typing import List, Optional
 
 from tpu_node_checker import __version__, checker
-from tpu_node_checker.probe.liveness import LEVELS as PROBE_LEVELS
+from tpu_node_checker.probe.levels import LEVELS as PROBE_LEVELS
 from tpu_node_checker.utils.env import load_dotenv
 
 

@@ -15,6 +15,17 @@ lock, ICI links are down).  This subpackage adds the missing grade of health:
   device mesh).
 """
 
-from tpu_node_checker.probe.liveness import ProbeResult, run_local_probe
+from tpu_node_checker.probe.levels import LEVELS
 
-__all__ = ["ProbeResult", "run_local_probe"]
+__all__ = ["LEVELS", "ProbeResult", "run_local_probe"]
+
+
+def __getattr__(name):
+    # Lazy: the CLI imports this package for LEVELS at argparse time; the
+    # liveness machinery (subprocess/dataclasses, ~8 ms) should cost only
+    # the runs that actually probe.
+    if name in ("ProbeResult", "run_local_probe"):
+        from tpu_node_checker.probe import liveness
+
+        return getattr(liveness, name)
+    raise AttributeError(name)

@@ -36,16 +36,11 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-LEVELS = ("enumerate", "compute", "collective", "workload")
-# Per-level wall-clock budgets: each level compiles and runs strictly more
-# programs (first jit compile on TPU alone is ~20-40 s).
-LEVEL_TIMEOUTS_S = {
-    "enumerate": 30.0,
-    "compute": 180.0,
-    "collective": 300.0,
-    "workload": 600.0,
-}
-DEFAULT_TIMEOUT_S = LEVEL_TIMEOUTS_S["enumerate"]
+from tpu_node_checker.probe.levels import (  # noqa: F401 — re-exported API
+    DEFAULT_TIMEOUT_S,
+    LEVEL_TIMEOUTS_S,
+    LEVELS,
+)
 # Extra kill-timer headroom for --probe-distributed: rendezvous handshake plus
 # the cross-process psum's first XLA compile.
 DISTRIBUTED_EXTRA_TIMEOUT_S = 90.0
