@@ -153,6 +153,10 @@ class TestBailsInsteadOfGuessing:
         "oct: 010\nsex: 1:30\nsexf: 1:30.5\n",  # exotic numerics
         "a: -\n",  # bare dash: PyYAML parse error
         "a: =\n",  # the 1.1 "=" value type: PyYAML constructor error
+        "a: ]\n",  # closing flow indicator: PyYAML parse error
+        "a: }\n",
+        "uf: 1_000.5\n",  # underscored float: 1000.5 to PyYAML's resolver
+        "{}: v\n",  # non-scalar key: refuse, never a bare TypeError
     ]
 
     @pytest.mark.parametrize("text", BAIL)

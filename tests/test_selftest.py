@@ -1,0 +1,168 @@
+"""--selftest: the fault-detection pipeline proving itself.
+
+Monitoring that cannot demonstrate it catches faults is untrustworthy; the
+drill injects one fault per detector class and verifies each is caught AND
+correctly named.  The fast tests here simulate probe outcomes to pin the
+orchestration (including the detector-missed failure path); the end-to-end
+drill on the live CPU mesh is marked slow.
+"""
+
+import json
+
+import pytest
+
+from tpu_node_checker import checker, cli
+from tpu_node_checker.probe.liveness import ProbeResult
+
+
+def _fake_probe(monkeypatch, behavior):
+    """Install a run_local_probe double that reads the chaos env like the
+    real child would and asks ``behavior(env)`` for the report details."""
+    import os
+
+    def fake(level="enumerate", timeout_s=None, topology=None, **kw):
+        env = {k: v for k, v in os.environ.items() if k.startswith("TNC_")}
+        ok, details = behavior(env, level)
+        return ProbeResult(
+            ok=ok, level=level, hostname="h", elapsed_ms=1.0,
+            device_count=8, platform="cpu", details=details,
+        )
+
+    monkeypatch.setattr(checker, "run_local_probe", fake, raising=False)
+    import tpu_node_checker.probe as probe_pkg
+
+    monkeypatch.setattr(probe_pkg, "run_local_probe", fake, raising=False)
+
+
+def _healthy_behavior(env, level):
+    if "TNC_CHAOS_THROTTLE" in env:
+        return False, {
+            "matmul_tflops": 0.01,
+            "perf_floor": {"failed": ["matmul_tflops"], "ok": False},
+            "chaos_injected": {"throttle": "matmul_tflops"},
+            "error": "perf_floor: matmul_tflops",
+        }
+    if "TNC_CHAOS_COLLECTIVE_LEG" in env:
+        return False, {
+            "collective_legs_ok": {
+                "psum_ok": True, "all_gather_ok": False, "reduce_scatter_ok": True,
+            },
+            "collective_err": "collective mismatch",
+        }
+    if "TNC_CHAOS_RING_LINK" in env:
+        return False, {"ring_bad_links": ["0->1"], "ring_err": "ring"}
+    if "TNC_CHAOS_SLICES" in env:
+        return False, {
+            "fault_domain_ok": {"dcn": False, "t0": True},
+            "error": "fault localized to the DCN slice boundary",
+        }
+    return True, {"matmul_tflops": 1.5}
+
+
+class TestSelftestOrchestration:
+    def test_all_detectors_behave(self, monkeypatch, capsys):
+        _fake_probe(monkeypatch, _healthy_behavior)
+        code = cli.main(["--selftest", "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert code == 0
+        assert payload["all_behaved"] is True
+        legs = {x["leg"]: x for x in payload["selftest"]}
+        assert set(legs) == {
+            "baseline", "throttle", "collective_leg", "ring_link", "dcn",
+        }
+        assert all(x["behaved"] for x in payload["selftest"])
+
+    def test_missed_detection_fails_the_drill(self, monkeypatch, capsys):
+        # The one failure mode the drill exists to expose: a fault injected
+        # and NOT caught (probe stays ok) must fail the self-test.
+        def blind(env, level):
+            if "TNC_CHAOS_RING_LINK" in env:
+                return True, {"ring_ok": True}  # detector asleep
+            return _healthy_behavior(env, level)
+
+        _fake_probe(monkeypatch, blind)
+        code = cli.main(["--selftest"])
+        out = capsys.readouterr().out
+        assert code == 3
+        assert "❌ ring_link" in out
+        assert "cannot be trusted" in out
+
+    def test_misnamed_fault_fails_the_drill(self, monkeypatch, capsys):
+        # Caught but misattributed (wrong link named) is still a failure:
+        # an operator acting on the name would repair the wrong cable.
+        def misnaming(env, level):
+            if "TNC_CHAOS_RING_LINK" in env:
+                return False, {"ring_bad_links": ["3->4"]}
+            return _healthy_behavior(env, level)
+
+        _fake_probe(monkeypatch, misnaming)
+        code = cli.main(["--selftest", "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert code == 3
+        legs = {x["leg"]: x for x in payload["selftest"]}
+        assert legs["ring_link"]["behaved"] is False
+        assert "3->4" in legs["ring_link"]["detail"]
+
+    def test_sick_baseline_skips_injections(self, monkeypatch, capsys):
+        def sick(env, level):
+            return False, {"error": "no chips"}
+
+        _fake_probe(monkeypatch, sick)
+        code = cli.main(["--selftest", "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert code == 3
+        assert [x["leg"] for x in payload["selftest"]] == ["baseline"]
+
+    def test_chaos_env_restored_after_drill(self, monkeypatch, capsys):
+        import os
+
+        _fake_probe(monkeypatch, _healthy_behavior)
+        assert cli.main(["--selftest", "--json"]) == 0
+        capsys.readouterr()
+        for var in ("TNC_CHAOS_THROTTLE", "TNC_CHAOS_RING_LINK",
+                    "TNC_CHAOS_COLLECTIVE_LEG", "TNC_CHAOS_SLICES",
+                    "TNC_CHAOS_AXIS", "TNC_PERF_EXPECT"):
+            assert var not in os.environ
+
+    def test_stale_chaos_env_does_not_corrupt_the_drill(
+        self, monkeypatch, capsys
+    ):
+        # An operator's leftover manual-rehearsal export must not make the
+        # drill report healthy detectors as failed.
+        monkeypatch.setenv("TNC_CHAOS_AXIS", "t4")
+        monkeypatch.setenv("TNC_CHAOS_COLLECTIVE_LEG", "psum")
+        monkeypatch.setenv("TNC_PERF_EXPECT", '{"matmul_tflops": 1e9}')
+        _fake_probe(monkeypatch, _healthy_behavior)
+        code = cli.main(["--selftest", "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert code == 0, payload
+        assert payload["all_behaved"] is True
+        # And the operator's own environment survives the drill.
+        import os
+
+        assert os.environ["TNC_CHAOS_AXIS"] == "t4"
+        assert os.environ["TNC_PERF_EXPECT"] == '{"matmul_tflops": 1e9}'
+
+    def test_runs_alone(self, capsys):
+        for extra in (["--probe"], ["--watch", "5"], ["--trend", "f"],
+                      ["--emit-probe", "-"], ["--log-jsonl", "x"],
+                      ["--probe-topology", "2x4"], ["--strict-slices"],
+                      ["--probe-level", "collective"], ["--trace", "t"]):
+            with pytest.raises(SystemExit) as exc:
+                cli.parse_args(["--selftest", *extra])
+            assert exc.value.code == 2, extra
+            capsys.readouterr()
+        args = cli.parse_args(["--selftest", "--json", "--probe-timeout", "60"])
+        assert args.selftest
+
+
+@pytest.mark.slow
+class TestSelftestEndToEnd:
+    def test_full_drill_on_cpu_mesh(self, capsys):
+        # The real thing: every chaos class through real probe children on
+        # the 8-device CPU mesh — caught and named, exit 0.
+        code = cli.main(["--selftest", "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert code == 0, payload
+        assert payload["all_behaved"] is True
+        assert len(payload["selftest"]) == 5

@@ -684,6 +684,176 @@ def report_fresh(path: str, max_age: float) -> int:
     return 0
 
 
+def selftest(args) -> int:
+    """``--selftest``: prove the fault-detection pipeline on this host.
+
+    Monitoring that cannot demonstrate it detects faults is untrustworthy:
+    the chaos hooks exist so every detector can be rehearsed on healthy
+    hardware, and this command packages the full drill — one clean baseline
+    probe, then one injected fault per detector class, each verified to be
+    *caught* and *correctly named*:
+
+    * ``throttle`` — a 20× perf degradation must fail the floor naming the
+      metric (graded against this host's own measured figure, so it works
+      on any platform/transport);
+    * ``collective_leg`` — a corrupted all_gather must fail THAT leg only;
+    * ``ring_link`` — a corrupted ICI link must be named ``0->1``;
+    * ``dcn`` — a fault on a rehearsed slice boundary must localize to the
+      ``dcn`` axis, not an intra-slice one (hosts with ≥4 devices).
+
+    Exit 0 = every rehearsal behaved; 3 = a detector failed to catch (or
+    misnamed) its fault, or the baseline itself is unhealthy — either way
+    this host's monitoring verdicts cannot be trusted until investigated.
+    """
+    from contextlib import contextmanager
+
+    from tpu_node_checker.probe import run_local_probe
+
+    @contextmanager
+    def _env(**overrides):
+        # Each leg runs with a CLEAN injection environment: a stale chaos
+        # var exported during a manual rehearsal must not leak into the
+        # drill and report healthy detectors as failed.
+        cleared = [
+            k
+            for k in os.environ
+            if k.startswith("TNC_CHAOS_")
+            or k in ("TNC_PERF_EXPECT", "TNC_PERF_FLOOR")
+        ]
+        old = {k: os.environ[k] for k in cleared}
+        old.update({k: os.environ.get(k) for k in overrides})
+        for k in cleared:
+            del os.environ[k]
+        os.environ.update({k: str(v) for k, v in overrides.items()})
+        try:
+            yield
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    timeout = getattr(args, "probe_timeout", None)
+    results: List[dict] = []
+
+    def _leg(name, expectation, check, level, topology=None, **env):
+        with _env(**env):
+            r = run_local_probe(level=level, timeout_s=timeout, topology=topology)
+        d = r.to_dict()
+        try:
+            behaved, detail = check(r, d)
+        except Exception as exc:  # noqa: BLE001 — a broken check is a failure
+            behaved, detail = False, f"verification crashed: {exc}"
+        results.append(
+            {
+                "leg": name,
+                "expectation": expectation,
+                "behaved": bool(behaved),
+                "detail": detail,
+            }
+        )
+        return r
+
+    # Baseline: the drill is meaningless on a host that is actually sick.
+    base = _leg(
+        "baseline",
+        "clean compute probe passes",
+        lambda r, d: (r.ok, d.get("error") or f"{r.device_count} device(s) ok"),
+        level="compute",
+    )
+    n_dev = base.device_count
+    measured = base.details.get("matmul_tflops")
+
+    if base.ok and isinstance(measured, (int, float)) and measured > 0:
+        _leg(
+            "throttle",
+            "20x slowdown fails the perf floor naming matmul_tflops",
+            lambda r, d: (
+                not r.ok
+                and d.get("perf_floor", {}).get("failed") == ["matmul_tflops"]
+                and d.get("chaos_injected", {}).get("throttle") == "matmul_tflops",
+                d.get("error") or "not caught",
+            ),
+            level="compute",
+            TNC_CHAOS_THROTTLE="matmul_tflops",
+            # Grade against this host's OWN healthy figure: works on any
+            # platform and through any transport.
+            TNC_PERF_EXPECT=json.dumps({"matmul_tflops": measured}),
+        )
+    if base.ok and n_dev >= 2:
+        _leg(
+            "collective_leg",
+            "corrupted all_gather fails that leg, and only that leg",
+            lambda r, d: (
+                not r.ok
+                and d.get("collective_legs_ok")
+                == {"psum_ok": True, "all_gather_ok": False, "reduce_scatter_ok": True},
+                d.get("collective_err") or d.get("error") or "not caught",
+            ),
+            level="collective",
+            TNC_CHAOS_COLLECTIVE_LEG="all_gather",
+        )
+        _leg(
+            "ring_link",
+            "corrupted ICI link is named 0->1",
+            lambda r, d: (
+                not r.ok and d.get("ring_bad_links") == ["0->1"],
+                f"named {d.get('ring_bad_links')}" if d.get("ring_bad_links") else (d.get("error") or "not caught"),
+            ),
+            level="collective",
+            TNC_CHAOS_RING_LINK="0",
+        )
+    if base.ok and n_dev >= 4 and n_dev % 2 == 0:
+        _leg(
+            "dcn",
+            "slice-boundary fault localizes to the dcn axis only",
+            lambda r, d: (
+                not r.ok
+                and d.get("fault_domain_ok", {}).get("dcn") is False
+                and all(v for k, v in d.get("fault_domain_ok", {}).items() if k != "dcn"),
+                f"domains {d.get('fault_domain_ok')}",
+            ),
+            level="collective",
+            TNC_CHAOS_SLICES="2",
+            TNC_CHAOS_AXIS="dcn",
+        )
+
+    all_behaved = bool(results) and all(x["behaved"] for x in results)
+    skipped = []
+    if not (isinstance(measured, (int, float)) and measured > 0):
+        skipped.append("throttle (no baseline matmul figure)")
+    if n_dev < 2:
+        skipped.append("collective_leg, ring_link (single device)")
+    if not (n_dev >= 4 and n_dev % 2 == 0):
+        skipped.append("dcn (needs >=4 devices, even count)")
+    if getattr(args, "json", False):
+        print(
+            report.dumps(
+                {
+                    "selftest": results,
+                    "skipped_legs": skipped,
+                    "all_behaved": all_behaved,
+                    "exit_code": EXIT_OK if all_behaved else EXIT_NONE_READY,
+                }
+            )
+        )
+    else:
+        for x in results:
+            mark = "✅" if x["behaved"] else "❌"
+            print(f"{mark} {x['leg']}: {x['expectation']} — {x['detail']}")
+        for s in skipped:
+            print(f"⏭️  skipped: {s}")
+        verdict = (
+            "every injected fault was caught and correctly named"
+            if all_behaved
+            else "FAULT-DETECTION DRILL FAILED — verdicts from this host "
+            "cannot be trusted until investigated"
+        )
+        print(f"\nSelf-test: {verdict}.")
+    return EXIT_OK if all_behaved else EXIT_NONE_READY
+
+
 def emit_probe(args) -> int:
     """``--emit-probe FILE``: run the local probe, write its JSON report.
 

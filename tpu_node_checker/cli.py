@@ -167,6 +167,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     probe.add_argument("--probe-results-required", action="store_true",
                        help="with --probe-results: grade any TPU node WITHOUT a fresh "
                        "report as probe-failed (full DaemonSet coverage expected)")
+    probe.add_argument("--selftest", action="store_true",
+                       help="rehearse the fault-detection pipeline on this host: a "
+                       "clean baseline probe, then one injected fault per detector "
+                       "class (perf throttle, collective leg, ICI link, DCN "
+                       "boundary), each verified to be caught AND correctly named; "
+                       "exit 0 = drill passed, 3 = a detector missed — runs alone")
 
     cordon = p.add_argument_group("Auto-quarantine (data-plane failures)")
     cordon.add_argument("--cordon-failed", action="store_true",
@@ -227,6 +233,32 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         # must not absorb check/emit/notify/quarantine flags the operator
         # thinks ran.
         p.error("--trend runs alone (only --json may accompany it)")
+    if args.selftest and (
+        args.emit_probe
+        or args.probe
+        or args.watch is not None
+        or args.probe_results
+        or args.cordon_failed
+        or args.uncordon_recovered
+        or args.report_fresh
+        or args.trend
+        or args.slack_webhook
+        or args.log_jsonl
+        or args.nodes_json
+        or args.label_selector
+        or args.resource_key
+        or args.strict_slices
+        or args.expected_chips
+        or args.multislice_label
+        or args.probe_topology
+        or args.probe_level != "enumerate"
+        or args.trace
+    ):
+        # Same silent-no-op rule as --trend/--report-fresh: a drill-only
+        # mode must not absorb check/emit/notify flags the operator thinks
+        # ran.
+        p.error("--selftest runs alone (only --json and --probe-timeout "
+                "may accompany it)")
     if args.report_fresh and (
         args.emit_probe
         or args.probe
@@ -300,6 +332,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if getattr(args, "trend", None):
             return checker.trend_summary(args.trend, json_mode=args.json)
+        if getattr(args, "selftest", False):
+            return checker.selftest(args)
         if getattr(args, "report_fresh", None):
             return checker.report_fresh(
                 args.report_fresh, args.probe_results_max_age

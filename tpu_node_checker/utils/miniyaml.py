@@ -45,7 +45,7 @@ _FLOAT = re.compile(r"^([+-]?[0-9]+\.[0-9]*|\.[0-9]+)([eE][+-][0-9]+)?$")
 _EXOTIC_NUMERIC = re.compile(
     r"^[+-]?("
     r"0[0-9xXoObB_]\S*"      # 010 octal / 0x1F / 0b1 / 0_1
-    r"|[0-9_]*_[0-9_]*"      # 1_000
+    r"|[0-9_.]*_[0-9_.]*"    # 1_000 / 1_000.5 underscored numbers
     r"|[0-9]+(:[0-9_.]+)+"   # 1:30 / 1:30.5 sexagesimal
     r"|[0-9]{4}-[0-9]{2}-[0-9]{2}.*"  # anything date-led (incl. timestamps)
     r"|\.(inf|Inf|INF)"
@@ -84,7 +84,10 @@ def _scalar(raw: str):
         return {}
     if s == "[]":
         return []
-    if s[0] in "&*!|>{[@`,%" or s.startswith("<<") or s.startswith("- "):
+    if s[0] in "&*!|>{[]}@`,%" or s.startswith("<<") or s.startswith("- "):
+        # "]" / "}" included: PyYAML REJECTS a plain scalar starting with a
+        # closing flow indicator, and this parser must never succeed where
+        # the real one errors.
         raise UnsupportedYAML(f"construct beyond the subset: {raw!r}")
     if s in ("-", "="):
         # PyYAML REJECTS a bare "-" ("sequence entries are not allowed
@@ -176,7 +179,9 @@ def _parse_block(lines, i, indent):
         while i < len(lines) and lines[i][0] == indent and (
             lines[i][1].startswith("- ") or lines[i][1] == "-"
         ):
-            rest = lines[i][1][2:].strip() if lines[i][1] != "-" else ""
+            # ASCII-space strip only (cf. _scalar): Unicode whitespace is
+            # scalar content to PyYAML.
+            rest = lines[i][1][2:].strip(" ") if lines[i][1] != "-" else ""
             if rest and (_KEY.match(rest) or rest.startswith("- ") or rest == "-"):
                 # "- key: value" (item is a mapping with an inline first
                 # entry) or "- - x" (item is a nested list): rewrite the
@@ -204,6 +209,10 @@ def _parse_block(lines, i, indent):
         if not m:
             raise UnsupportedYAML(f"unrecognized line: {content!r}")
         key = _scalar(m.group("key"))
+        if isinstance(key, (dict, list)):
+            # "{}: v" — an unhashable key must refuse (and reach the real
+            # parser via the fallback), not crash with a bare TypeError.
+            raise UnsupportedYAML(f"non-scalar mapping key: {m.group('key')!r}")
         val = m.group("val")
         i += 1
         if val is None or val.strip() == "":
