@@ -15,12 +15,15 @@ from tpu_node_checker import checker, cli
 from tpu_node_checker.probe.liveness import ProbeResult
 
 
-def _fake_probe(monkeypatch, behavior):
+def _fake_probe(monkeypatch, behavior, calls=None):
     """Install a run_local_probe double that reads the chaos env like the
-    real child would and asks ``behavior(env)`` for the report details."""
+    real child would and asks ``behavior(env)`` for the report details.
+    ``calls`` (optional list) records each invocation's ``timeout_s``."""
     import os
 
     def fake(level="enumerate", timeout_s=None, topology=None, **kw):
+        if calls is not None:
+            calls.append(timeout_s)
         env = {k: v for k, v in os.environ.items() if k.startswith("TNC_")}
         ok, details = behavior(env, level)
         return ProbeResult(
@@ -28,7 +31,6 @@ def _fake_probe(monkeypatch, behavior):
             device_count=8, platform="cpu", details=details,
         )
 
-    monkeypatch.setattr(checker, "run_local_probe", fake, raising=False)
     import tpu_node_checker.probe as probe_pkg
 
     monkeypatch.setattr(probe_pkg, "run_local_probe", fake, raising=False)
@@ -142,6 +144,24 @@ class TestSelftestOrchestration:
 
         assert os.environ["TNC_CHAOS_AXIS"] == "t4"
         assert os.environ["TNC_PERF_EXPECT"] == '{"matmul_tflops": 1e9}'
+
+    def test_probe_timeout_reaches_every_leg(self, monkeypatch, capsys):
+        # The drill's one tuning knob: slow transports (first-compile TPU)
+        # need a bigger per-leg budget, and EVERY leg's child must receive
+        # it — all 5 legs, or a broken baseline gate hides behind exit 0.
+        seen = []
+        _fake_probe(monkeypatch, _healthy_behavior, calls=seen)
+        assert cli.main(["--selftest", "--json", "--probe-timeout", "450"]) == 0
+        capsys.readouterr()
+        assert len(seen) == 5
+        assert all(t == 450.0 for t in seen)
+
+    def test_lazy_probe_package_attr(self):
+        import tpu_node_checker.probe as probe_pkg
+
+        assert callable(probe_pkg.run_local_probe)
+        with pytest.raises(AttributeError):
+            probe_pkg.no_such_symbol
 
     def test_runs_alone(self, capsys):
         for extra in (["--probe"], ["--watch", "5"], ["--trend", "f"],
