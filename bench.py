@@ -162,7 +162,10 @@ users:
         "--json",
     ]
     cold = []
-    for i in range(9):
+    # 15 reps: the driver records ONE reading per round, and ambient noise
+    # moves a 9-rep median by ~±15%; the extra six cold runs (~1 s total)
+    # buy a visibly stabler p50.
+    for i in range(15):
         t0 = time.perf_counter()
         proc = subprocess.run(cmd, capture_output=True, text=True, env=child_env)
         cold.append((time.perf_counter() - t0) * 1e3)
