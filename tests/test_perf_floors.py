@@ -151,6 +151,37 @@ class TestGradeFloors:
         v = grade_floors(["TPU v5e"], "tpu", measured, dispatch_overhead_ms=0.05)
         assert v["ok"] is False
 
+    def test_max_dispatch_env_parse(self):
+        # Presence and value parse apart (r4 advisor): absent/empty → None
+        # (built-in 5 ms gate); an explicit 0 → inf, DISABLING the gate —
+        # the old `or 0 ... or None` made that impossible; a typo names the
+        # var like TNC_PERF_FLOOR's parse does.
+        import math
+
+        from tpu_node_checker.probe.floors import max_dispatch_from_env
+
+        assert max_dispatch_from_env(None) is None
+        assert max_dispatch_from_env("  ") is None
+        assert max_dispatch_from_env("12.5") == 12.5
+        assert max_dispatch_from_env("0") == math.inf
+        assert max_dispatch_from_env("-3") == math.inf
+        assert max_dispatch_from_env("inf") == math.inf
+        with pytest.raises(ValueError, match="TNC_PERF_FLOOR_MAX_DISPATCH_MS"):
+            max_dispatch_from_env("fast")
+        # NaN parses as a float but would disable the gate silently (every
+        # `>` comparison is False) — rejected like a typo, not passed through.
+        with pytest.raises(ValueError, match="TNC_PERF_FLOOR_MAX_DISPATCH_MS"):
+            max_dispatch_from_env("nan")
+        # And inf actually disables: tunneled-transport overhead no longer
+        # skips table grading, so a throttled chip still fails the floor.
+        spec = CHIP_SPECS["v5e"]
+        measured = {"matmul_tflops": spec["matmul_tflops"] * 0.02}
+        v = grade_floors(
+            ["TPU v5e"], "tpu", measured,
+            dispatch_overhead_ms=65.0, max_dispatch_ms=math.inf,
+        )
+        assert v["ok"] is False and v["failed"] == ["matmul_tflops"]
+
     def test_explicit_expectations_bypass_dispatch_gate(self):
         # TNC_PERF_EXPECT means the operator calibrated for their transport.
         v = grade_floors(
@@ -198,6 +229,24 @@ class TestGradeFloors:
         for gen, spec in CHIP_SPECS.items():
             assert spec.keys() <= set(FLOOR_METRICS), gen
             assert all(v > 0 for v in spec.values()), gen
+
+    def test_v2_v3_floors_are_per_core_device(self):
+        # On v2/v3 a JAX device is a TensorCore with half the chip's MXUs
+        # and HBM channels (r4 advisor, medium): CHIP_SPECS must store
+        # per-DEVICE peaks — half the published per-chip 45/123 TFLOPs and
+        # 700/900 GB/s — exactly as HBM_CAPACITY_GB halves capacity.  A
+        # healthy core at ~60% of its real per-core peak must pass the 0.4
+        # floor, not get quarantined for being half a chip.
+        assert CHIP_SPECS["v2"] == {"matmul_tflops": 22.5, "hbm_gbps": 350.0}
+        assert CHIP_SPECS["v3"] == {"matmul_tflops": 61.5, "hbm_gbps": 450.0}
+        v = grade_floors(
+            ["TPU v3"], "tpu",
+            {"matmul_tflops": 0.6 * 61.5, "hbm_gbps": 0.6 * 450.0},
+        )
+        assert v["ok"] is True, v
+        # A genuinely throttled core (10% of per-core peak) still fails.
+        v = grade_floors(["TPU v3"], "tpu", {"matmul_tflops": 6.15})
+        assert v["ok"] is False and v["failed"] == ["matmul_tflops"]
 
 
 class TestHbmCapacity:
@@ -348,6 +397,11 @@ class TestFloorsInProbeChild:
         r = run_local_probe(level="compute", timeout_s=300)
         assert not r.ok
         assert "TNC_PERF_FLOOR" in (r.error or "")
+        monkeypatch.delenv("TNC_PERF_FLOOR")
+        monkeypatch.setenv("TNC_PERF_FLOOR_MAX_DISPATCH_MS", "fast")
+        r = run_local_probe(level="compute", timeout_s=300)
+        assert not r.ok
+        assert "TNC_PERF_FLOOR_MAX_DISPATCH_MS" in (r.error or "")
 
     def test_perf_floor_zero_disables_via_flag_plumbing(self, monkeypatch):
         monkeypatch.setenv("TNC_PERF_EXPECT", json.dumps({"matmul_tflops": 1e9}))

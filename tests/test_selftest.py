@@ -134,16 +134,45 @@ class TestSelftestOrchestration:
         monkeypatch.setenv("TNC_CHAOS_AXIS", "t4")
         monkeypatch.setenv("TNC_CHAOS_COLLECTIVE_LEG", "psum")
         monkeypatch.setenv("TNC_PERF_EXPECT", '{"matmul_tflops": 1e9}')
-        _fake_probe(monkeypatch, _healthy_behavior)
+        # Non-chaos probe knobs leak the same way (r4 advisor): a forced
+        # topology, a 10-minute soak, a regrading floor, or a distributed
+        # coordinator would stretch or fail legs just as spuriously.
+        stale = {
+            "TNC_TOPOLOGY": "4x2",
+            "TNC_SOAK_S": "600",
+            "TNC_HBM_CAPACITY_FLOOR": "0.99",
+            "TNC_PERF_FLOOR_MAX_DISPATCH_MS": "0.0001",
+            "TNC_COORDINATOR": "10.0.0.1:9999",
+        }
+        for k, v in stale.items():
+            monkeypatch.setenv(k, v)
+        # TNC_SKIP_* host-accommodation knobs are NOT injection state: they
+        # route around a known toolchain regression, and the drill's
+        # baseline leg must keep honoring them or --selftest fails
+        # fleet-wide on hosts that are healthy by the operator's own config.
+        monkeypatch.setenv("TNC_SKIP_FLASH_ATTENTION", "1")
+        leaked = []
+        skip_seen = []
+
+        def strict(env, level):
+            leaked.extend(k for k in env if k in stale)
+            skip_seen.append("TNC_SKIP_FLASH_ATTENTION" in env)
+            return _healthy_behavior(env, level)
+
+        _fake_probe(monkeypatch, strict)
         code = cli.main(["--selftest", "--json"])
         payload = json.loads(capsys.readouterr().out)
         assert code == 0, payload
         assert payload["all_behaved"] is True
+        assert leaked == [], f"stale probe knobs leaked into drill legs: {leaked}"
+        assert skip_seen and all(skip_seen), "TNC_SKIP_* must survive the clear"
         # And the operator's own environment survives the drill.
         import os
 
         assert os.environ["TNC_CHAOS_AXIS"] == "t4"
         assert os.environ["TNC_PERF_EXPECT"] == '{"matmul_tflops": 1e9}'
+        for k, v in stale.items():
+            assert os.environ[k] == v
 
     def test_probe_timeout_reaches_every_leg(self, monkeypatch, capsys):
         # The drill's one tuning knob: slow transports (first-compile TPU)

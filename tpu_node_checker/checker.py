@@ -711,14 +711,23 @@ def selftest(args) -> int:
 
     @contextmanager
     def _env(**overrides):
-        # Each leg runs with a CLEAN injection environment: a stale chaos
-        # var exported during a manual rehearsal must not leak into the
-        # drill and report healthy detectors as failed.
+        # Each leg runs with a CLEAN probe environment: a stale chaos var
+        # exported during a manual rehearsal must not leak into the drill
+        # and report healthy detectors as failed — and neither must any
+        # other probe-tuning var (TNC_TOPOLOGY forcing a ring shape,
+        # TNC_SOAK_S stretching every leg, TNC_HBM_CAPACITY_FLOOR /
+        # TNC_PERF_FLOOR_MAX_DISPATCH_MS regrading, TNC_COORDINATOR
+        # flipping the child into distributed mode).  Every TNC_* var is a
+        # probe knob, so clear the whole prefix; each leg re-injects only
+        # its own overrides (r4 advisor).  The TNC_SKIP_* host-accommodation
+        # knobs survive: they exist to route AROUND a known toolchain
+        # regression on healthy hosts, and clearing them would make the
+        # baseline leg re-run the very probe the operator skipped — failing
+        # the drill fleet-wide for a reason that is not a detector fault.
         cleared = [
             k
             for k in os.environ
-            if k.startswith("TNC_CHAOS_")
-            or k in ("TNC_PERF_EXPECT", "TNC_PERF_FLOOR")
+            if k.startswith("TNC_") and not k.startswith("TNC_SKIP_")
         ]
         old = {k: os.environ[k] for k in cleared}
         old.update({k: os.environ.get(k) for k in overrides})

@@ -11,7 +11,8 @@ power rail, a degraded ICI link that still delivers bits.
 
 Design:
 
-* :data:`CHIP_SPECS` holds published per-chip peaks per generation (Google
+* :data:`CHIP_SPECS` holds published peaks per generation, normalised to one
+  PJRT *device* — per chip on megacore v4+, per TensorCore on v2/v3 (Google
   Cloud TPU docs / datasheet numbers).  The probe's figures are deliberate
   *lower bounds* (small problem sizes, wall-clock timing, dispatch overhead
   included), so grading uses an operator-tunable **fraction** of peak —
@@ -50,17 +51,26 @@ THROTTLE_FACTOR = 0.05
 # expectations mean the operator calibrated for their transport.
 MAX_DISPATCH_OVERHEAD_MS = 5.0
 
-# Published per-chip peaks by generation.  Units match the probe's measured
-# keys: bf16 TFLOP/s (dense, MXU), int8 TOPS, HBM GB/s, one-way per-link ICI
-# GB/s.  Sources: Google Cloud TPU system-architecture docs (v4: 275 bf16
-# TFLOP/s, 1228 GB/s HBM; v5e: 197 bf16 / 394 int8, 819 GB/s; v5p: 459 bf16,
-# 2765 GB/s; v6e/Trillium: 918 bf16 / 1836 int8, 1640 GB/s) and the published
-# ICI per-link rates (v4: 6×50 GB/s, v5e: 4×50 GB/s, v5p: 6×100 GB/s,
-# v6e: 4×112 GB/s).  v2/v3 carry compute+HBM only (no int8 MXU mode
-# documented; ICI specs predate the per-link convention used here).
+# Published peaks by generation, stated per PJRT *device* — the unit the
+# probe actually measures.  On v4+ (megacore) one device is one chip, so
+# these are the per-chip numbers; on v2/v3 one device is a single TensorCore
+# with HALF the chip's MXUs and HBM channels, so the published per-chip
+# figures (v2: 45 bf16 TFLOP/s, 700 GB/s; v3: 123 TFLOP/s, 900 GB/s) are
+# halved here — exactly as HBM_CAPACITY_GB below halves capacity.  Grading a
+# TensorCore against a whole-chip peak would put a healthy v2/v3 device at
+# 0.5 of "peak" before any degradation, and a 0.4 floor fraction would
+# false-fail (and --cordon-failed would quarantine) hosts running at spec.
+# Units match the probe's measured keys: bf16 TFLOP/s (dense, MXU), int8
+# TOPS, HBM GB/s, one-way per-link ICI GB/s.  Sources: Google Cloud TPU
+# system-architecture docs (v4: 275 bf16 TFLOP/s, 1228 GB/s HBM; v5e: 197
+# bf16 / 394 int8, 819 GB/s; v5p: 459 bf16, 2765 GB/s; v6e/Trillium: 918
+# bf16 / 1836 int8, 1640 GB/s) and the published ICI per-link rates (v4:
+# 6×50 GB/s, v5e: 4×50 GB/s, v5p: 6×100 GB/s, v6e: 4×112 GB/s).  v2/v3
+# carry compute+HBM only (no int8 MXU mode documented; ICI specs predate
+# the per-link convention used here).
 CHIP_SPECS: dict = {
-    "v2": {"matmul_tflops": 45.0, "hbm_gbps": 700.0},
-    "v3": {"matmul_tflops": 123.0, "hbm_gbps": 900.0},
+    "v2": {"matmul_tflops": 22.5, "hbm_gbps": 350.0},
+    "v3": {"matmul_tflops": 61.5, "hbm_gbps": 450.0},
     "v4": {
         "matmul_tflops": 275.0,
         "int8_tops": 275.0,
@@ -108,6 +118,32 @@ HBM_CAPACITY_GB = {
 # healthy chips; 90% of nominal separates "reserved carve-out" from
 # "missing memory channel".
 HBM_CAPACITY_FRACTION = 0.9
+
+
+def max_dispatch_from_env(raw: Optional[str]) -> Optional[float]:
+    """Parse ``TNC_PERF_FLOOR_MAX_DISPATCH_MS`` — presence and value apart.
+
+    ``None``/empty → ``None`` (caller uses :data:`MAX_DISPATCH_OVERHEAD_MS`);
+    ``0`` (or any non-positive, or ``inf``) → ``inf``, explicitly DISABLING
+    the dispatch-overhead gate; a non-number raises the same
+    config-typo-style message ``TNC_PERF_FLOOR`` gets, so ``--cordon-failed``
+    reads it as a config error, not a hardware fault (r4 advisor: the old
+    ``or 0 ... or None`` folded an explicit 0 back into the default,
+    making the gate impossible to turn off).
+    """
+    if raw is None or not raw.strip():
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"TNC_PERF_FLOOR_MAX_DISPATCH_MS {raw!r} is not a number"
+        ) from None
+    if math.isnan(value):
+        # NaN would silently disable the gate (every > comparison is False)
+        # without being the documented disable spelling — reject like a typo.
+        raise ValueError("TNC_PERF_FLOOR_MAX_DISPATCH_MS 'nan' is not a number")
+    return math.inf if value <= 0 else value
 
 
 def grade_hbm_capacity(

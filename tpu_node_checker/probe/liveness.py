@@ -463,6 +463,7 @@ try:
             FLOOR_METRICS,
             floor_failure_message,
             grade_floors,
+            max_dispatch_from_env,
         )
         frac = DEFAULT_FLOOR_FRACTION
         if os.environ.get("TNC_PERF_FLOOR"):
@@ -476,9 +477,9 @@ try:
         expect = None
         if os.environ.get("TNC_PERF_EXPECT"):
             expect = json.loads(os.environ["TNC_PERF_EXPECT"])
-        max_disp = float(
-            os.environ.get("TNC_PERF_FLOOR_MAX_DISPATCH_MS") or 0
-        ) or None
+        max_disp = max_dispatch_from_env(
+            os.environ.get("TNC_PERF_FLOOR_MAX_DISPATCH_MS")
+        )
         measured = {m: out.get(m) for m in FLOOR_METRICS}
         if isinstance(out.get("soak"), dict):
             # Sustained throughput from the soak rounds: a chip can pass the
