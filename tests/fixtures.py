@@ -26,6 +26,8 @@ def make_node(
     taints: Optional[list] = None,
     conditions: Optional[list] = None,
     unschedulable: bool = False,
+    not_ready_reason: Optional[str] = None,
+    not_ready_message: Optional[str] = None,
 ) -> dict:
     """One raw node dict, shaped like a k8s REST ``V1Node`` serialization."""
     alloc = {"cpu": "8", "memory": "32Gi", "pods": "110"}
@@ -33,9 +35,14 @@ def make_node(
         alloc.update(allocatable)
     cap = dict(capacity) if capacity is not None else dict(alloc)
     if conditions is None:
+        ready_cond = {"type": "Ready", "status": "True" if ready else "False"}
+        if not ready and not_ready_reason:
+            ready_cond["reason"] = not_ready_reason
+        if not ready and not_ready_message:
+            ready_cond["message"] = not_ready_message
         conditions = [
             {"type": "MemoryPressure", "status": "False"},
-            {"type": "Ready", "status": "True" if ready else "False"},
+            ready_cond,
         ]
     node = {
         "metadata": {"name": name, "labels": labels or {}},

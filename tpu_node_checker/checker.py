@@ -46,6 +46,7 @@ from tpu_node_checker.detect import (
     HARD_PLANNED_DISRUPTIONS,
     NodeInfo,
     SliceInfo,
+    format_why_not_ready,
     group_multislices,
     group_slices,
     select_accelerator_nodes,
@@ -1244,7 +1245,17 @@ def _round_causes(payload: dict) -> List[str]:
         causes.append(f"no probe report: {h}")
     for n in payload.get("nodes", []):
         if not n.get("ready"):
-            causes.append(f"not-ready: {n.get('name')}")
+            # "Why" from the Ready condition (KubeletNotReady vs
+            # NetworkUnavailable vs NodeStatusUnknown are different
+            # incidents) — the reference discards it (check-gpu-node.py:172).
+            nr = n.get("not_ready") or {}
+            why = format_why_not_ready(
+                nr.get("reason"), nr.get("message"),
+                n.get("adverse_conditions") or (),
+            )
+            causes.append(
+                f"not-ready: {n.get('name')}" + (f" ({why})" if why else "")
+            )
         elif not n.get("schedulable", True):
             causes.append(f"no allocatable devices: {n.get('name')}")
         elif not summary and isinstance(n.get("probe"), dict) and not n["probe"].get("ok"):

@@ -110,18 +110,88 @@ def _as_list(x) -> list:
     return x if isinstance(x, list) else []
 
 
+# Adverse NodeConditions: status=="True" on any of these is a named fault
+# channel even while the Ready condition itself may still say True.
+ADVERSE_CONDITIONS = (
+    "NetworkUnavailable",
+    "MemoryPressure",
+    "DiskPressure",
+    "PIDPressure",
+)
+
+
+def ready_condition(node: dict) -> Tuple[bool, Optional[str], Optional[str]]:
+    """``(ready, reason, message)`` from the Ready NodeCondition.
+
+    The reference keeps only the boolean (check-gpu-node.py:172-178) and so
+    discards the one field that answers "why is it NotReady" — kubelet's own
+    ``reason`` (``KubeletNotReady``, ``NodeStatusUnknown``, …) and human
+    ``message``, already present on the same LIST response.  Missing or
+    malformed condition → ``(False, None, None)``; non-string reason/message
+    slots (API garbage) fold to ``None`` rather than poisoning formatters.
+    """
+    conditions = _as_list(_as_dict(_as_dict(node).get("status")).get("conditions"))
+    for cond in conditions:
+        cond = _as_dict(cond)
+        if cond.get("type") == "Ready":
+            ready = cond.get("status") == "True"
+            reason = cond.get("reason")
+            message = cond.get("message")
+            return (
+                ready,
+                reason if isinstance(reason, str) and reason else None,
+                message if isinstance(message, str) and message else None,
+            )
+    return False, None, None
+
+
+def adverse_conditions(node: dict) -> Tuple[str, ...]:
+    """Adverse NodeCondition types currently asserted (status=="True").
+
+    Order follows :data:`ADVERSE_CONDITIONS`, not the wire, so the JSON
+    surface is stable for any condition ordering the API returns.
+    """
+    active = set()
+    for cond in _as_list(_as_dict(_as_dict(node).get("status")).get("conditions")):
+        cond = _as_dict(cond)
+        if cond.get("type") in ADVERSE_CONDITIONS and cond.get("status") == "True":
+            active.add(cond["type"])
+    return tuple(c for c in ADVERSE_CONDITIONS if c in active)
+
+
 def is_ready(node: dict) -> bool:
     """True iff a NodeCondition has type=="Ready" and status=="True".
 
     Same rule as check-gpu-node.py:172-178, including the defensive defaults:
     missing (or malformed) ``status``/``conditions`` → not ready.
     """
-    conditions = _as_list(_as_dict(_as_dict(node).get("status")).get("conditions"))
-    for cond in conditions:
-        cond = _as_dict(cond)
-        if cond.get("type") == "Ready":
-            return cond.get("status") == "True"
-    return False
+    return ready_condition(node)[0]
+
+
+def format_why_not_ready(
+    reason: Optional[str],
+    message: Optional[str],
+    adverse: Sequence[str] = (),
+) -> Optional[str]:
+    """``KubeletNotReady: container runtime is down`` — the one line every
+    NotReady surface (table, Slack, trend causes) renders the same way.
+
+    ``None`` when the API offered no detail at all.  The message is
+    whitespace-collapsed (kubelet messages can be multi-line) and capped at
+    100 chars so the line fits table cells and Slack bullets.
+    """
+    parts = []
+    if reason:
+        parts.append(reason)
+    if adverse:
+        parts.append("+".join(adverse))
+    if not parts:
+        return None
+    head = ", ".join(parts)
+    if message:
+        msg = " ".join(message.split())
+        head += f": {msg[:100]}{'…' if len(msg) > 100 else ''}"
+    return head
 
 
 def accelerator_allocatable(
@@ -189,6 +259,14 @@ class NodeInfo:
     # (PLANNED_DISRUPTION_TAINTS values) and the spot/preemptible flag.
     planned_disruptions: Tuple[str, ...] = ()
     interruptible: bool = False
+    # "Why NotReady" triage, from the Ready condition the reference discards
+    # (check-gpu-node.py:172-178): kubelet's reason (KubeletNotReady,
+    # NodeStatusUnknown, …) and message, plus any asserted adverse
+    # conditions (NetworkUnavailable / pressure) — distinct failure classes
+    # that must not all read as a bare "NotReady".
+    not_ready_reason: Optional[str] = None
+    not_ready_message: Optional[str] = None
+    adverse_conditions: Tuple[str, ...] = ()
     # Data-plane probe result, attached later by the probe layer (None = not probed):
     probe: Optional[dict] = None
 
@@ -218,6 +296,17 @@ class NodeInfo:
         if not HARD_PLANNED_DISRUPTIONS.intersection(self.planned_disruptions):
             return False
         return not (self.probe is not None and not self.probe.get("ok"))
+
+    @property
+    def why_not_ready(self) -> Optional[str]:
+        """Compact triage line for a NotReady node — ``reason: message``,
+        with asserted adverse conditions appended; ``None`` when ready or
+        when the API offered no detail (condition missing entirely)."""
+        if self.ready:
+            return None
+        return format_why_not_ready(
+            self.not_ready_reason, self.not_ready_message, self.adverse_conditions
+        )
 
     @property
     def effectively_ready(self) -> bool:
@@ -253,6 +342,13 @@ class NodeInfo:
             }
         if self.quarantined_by_us:
             d["quarantined_by_us"] = True
+        if not self.ready and (self.not_ready_reason or self.not_ready_message):
+            d["not_ready"] = {
+                "reason": self.not_ready_reason,
+                "message": self.not_ready_message,
+            }
+        if self.adverse_conditions:
+            d["adverse_conditions"] = list(self.adverse_conditions)
         if self.planned_disruptions or self.interruptible:
             d["planned"] = {
                 "disruptions": list(self.planned_disruptions),
@@ -317,9 +413,10 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         v = labels.get(key)
         return v if isinstance(v, str) else None
 
+    ready, nr_reason, nr_message = ready_condition(node)
     return NodeInfo(
         name=name if isinstance(name, str) else "",
-        ready=is_ready(node),
+        ready=ready,
         accelerators=sum(breakdown.values()),
         breakdown=breakdown,
         families=families,
@@ -334,6 +431,9 @@ def extract_node_info(node: dict, registry: Optional[ResourceRegistry] = None) -
         nodepool=_label(LABEL_NODEPOOL),
         planned_disruptions=planned,
         interruptible=interruptible,
+        not_ready_reason=None if ready else nr_reason,
+        not_ready_message=None if ready else nr_message,
+        adverse_conditions=adverse_conditions(node),
     )
 
 

@@ -35,7 +35,10 @@ Exported metric families:
   ``tpu_node_checker_cordon_skipped_over_cap`` — the quarantine lifecycle
   (nonzero skipped-over-cap means humans must look NOW);
 * ``tpu_node_checker_kind_mismatch_nodes`` — nodes whose probed TPU
-  generation contradicts their GKE accelerator label.
+  generation contradicts their GKE accelerator label;
+* ``tpu_node_checker_node_notready{reason}`` — NotReady node counts keyed by
+  the kubelet Ready-condition reason (KubeletNotReady vs NetworkUnavailable
+  vs NodeStatusUnknown route to different responders).
 """
 
 from __future__ import annotations
@@ -81,6 +84,18 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
         "Accelerator device counts by state.",
         [({"state": "total"}, payload.get("total_chips", 0)),
          ({"state": "ready"}, payload.get("ready_chips", 0))],
+    )
+    notready: dict = {}
+    for n in payload.get("nodes", []):
+        if not n.get("ready"):
+            reason = (n.get("not_ready") or {}).get("reason") or "unknown"
+            notready[reason] = notready.get(reason, 0) + 1
+    family(
+        "tpu_node_checker_node_notready",
+        "gauge",
+        "NotReady nodes by kubelet Ready-condition reason ('unknown' when "
+        "the API gave none).",
+        [({"reason": r}, float(c)) for r, c in sorted(notready.items())],
     )
     # "slice" is the unique series key: several single-host slices can share
     # one nodepool, and duplicate label sets would invalidate the whole scrape.

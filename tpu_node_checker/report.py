@@ -37,7 +37,12 @@ def _status(n: NodeInfo) -> str:
     under a PLANNED disruption (maintenance drain / autoscaler scale-down)
     — "GKE is taking this node, as scheduled" and "this node broke" must
     not read identically."""
-    base = "NotReady" if not n.ready else ("Ready" if n.schedulable else "Ready/NoAlloc")
+    if not n.ready:
+        # Kubelet's own reason token (short, camel-case) rides in the cell;
+        # the full message stays in Slack bullets / JSON / trend causes.
+        base = f"NotReady[{n.not_ready_reason}]" if n.not_ready_reason else "NotReady"
+    else:
+        base = "Ready" if n.schedulable else "Ready/NoAlloc"
     word = n.planned_word
     return f"{base} ({word})" if word else base
 
@@ -259,6 +264,11 @@ def format_slack_message(
     for n in listed:
         keys = ", ".join(f"{k}:{v}" for k, v in sorted(n.breakdown.items()))
         line = f"• `{n.name}`: {_status(n)}, devices: {n.accelerators} ({keys})"
+        if not n.ready and n.why_not_ready:
+            # "Why NotReady" is the first question on the page; kubelet's own
+            # reason (KubeletNotReady vs NetworkUnavailable vs
+            # NodeStatusUnknown) routes the response differently.
+            line += f" — {n.why_not_ready}"
         if n.probe is not None and not n.probe.get("ok"):
             # "Failed HOW" is the first question on every alert; the error
             # is truncated so a mass outage still fits Slack's limits.
