@@ -90,6 +90,12 @@ class TestExtraction:
     def test_format_why_not_ready(self):
         assert format_why_not_ready(None, None) is None
         assert format_why_not_ready("KubeletNotReady", None) == "KubeletNotReady"
+        # Message-only conditions (controller sets message, no reason): the
+        # one field that answers "why" must still surface.
+        assert (
+            format_why_not_ready(None, "container runtime is down")
+            == "container runtime is down"
+        )
         assert (
             format_why_not_ready(None, None, ("NetworkUnavailable",))
             == "NetworkUnavailable"

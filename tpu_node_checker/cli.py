@@ -287,6 +287,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             # emit-probe mode never runs the check, so the flag would
             # silently do nothing (same rule as --probe-soak/--probe-distributed).
             p.error(f"{flag} cannot be combined with --emit-probe")
+    if args.emit_probe:
+        for flag, on in (
+            ("--slack-webhook", args.slack_webhook),
+            ("--slack-only-on-error", args.slack_only_on_error),
+            ("--slack-on-change", args.slack_on_change),
+        ):
+            if on:
+                # Emitters never notify — Slack is the aggregator's job
+                # (it sees the fleet; a per-host pod would page per chip).
+                # Accepting the flag would silently alert nobody.
+                p.error(f"{flag} cannot be combined with --emit-probe")
     if args.cordon_max is not None and args.cordon_max < 1:
         p.error("--cordon-max must be at least 1")
     if args.cordon_max is not None and not args.cordon_failed:
@@ -340,26 +351,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         if getattr(args, "emit_probe", None):
             if args.watch is not None:
-                # Periodic re-emission — the DaemonSet pattern: keep the
-                # shared-volume report fresher than --probe-results-max-age.
-                # One bad round (shared-volume blip) must not kill the
-                # emitter: a crash-looping pod lets the report go stale and
-                # a healthy host would grade as failed under
-                # --probe-results-required.
-                import time as _time
-
-                while True:
-                    round_start = _time.monotonic()
-                    try:
-                        checker.emit_probe(args)
-                    except Exception as exc:  # noqa: BLE001
-                        print(f"Probe emission failed: {exc}", file=sys.stderr)
-                    # Fixed cadence: probe time comes out of the interval so
-                    # report freshness keeps the margin the aggregator's
-                    # --probe-results-max-age math assumes.
-                    _time.sleep(
-                        max(0.0, args.watch - (_time.monotonic() - round_start))
-                    )
+                # The DaemonSet emitter loop: periodic re-emission with the
+                # emitter's own metrics scrape and round log (checker.py).
+                checker.emit_probe_loop(args)  # returns only via signals
+                return checker.EXIT_ERROR  # pragma: no cover
             return checker.emit_probe(args)
         if getattr(args, "watch", None) is not None:
             checker.watch(args)  # returns only via signals/exceptions

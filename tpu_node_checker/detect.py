@@ -185,13 +185,14 @@ def format_why_not_ready(
         parts.append(reason)
     if adverse:
         parts.append("+".join(adverse))
+    msg = " ".join(message.split()) if message else ""
+    msg = f"{msg[:100]}{'…' if len(msg) > 100 else ''}" if msg else ""
     if not parts:
-        return None
+        # Message-only conditions happen (a controller that sets message but
+        # no reason): the one field that answers "why" must still surface.
+        return msg or None
     head = ", ".join(parts)
-    if message:
-        msg = " ".join(message.split())
-        head += f": {msg[:100]}{'…' if len(msg) > 100 else ''}"
-    return head
+    return f"{head}: {msg}" if msg else head
 
 
 def accelerator_allocatable(

@@ -71,58 +71,64 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             lines.append(_line(name, value, labels or None))
 
     payload = result.payload
-    family(
-        "tpu_node_checker_nodes",
-        "gauge",
-        "Accelerator node counts by state.",
-        [({"state": "total"}, payload.get("total_nodes", 0)),
-         ({"state": "ready"}, payload.get("ready_nodes", 0))],
-    )
-    family(
-        "tpu_node_checker_chips",
-        "gauge",
-        "Accelerator device counts by state.",
-        [({"state": "total"}, payload.get("total_chips", 0)),
-         ({"state": "ready"}, payload.get("ready_chips", 0))],
-    )
-    notready: dict = {}
-    for n in payload.get("nodes", []):
-        if not n.get("ready"):
-            reason = (n.get("not_ready") or {}).get("reason") or "unknown"
-            notready[reason] = notready.get(reason, 0) + 1
-    family(
-        "tpu_node_checker_node_notready",
-        "gauge",
-        "NotReady nodes by kubelet Ready-condition reason ('unknown' when "
-        "the API gave none).",
-        [({"reason": r}, float(c)) for r, c in sorted(notready.items())],
-    )
-    # "slice" is the unique series key: several single-host slices can share
-    # one nodepool, and duplicate label sets would invalidate the whole scrape.
-    slice_labels = lambda s: {  # noqa: E731
-        "slice": s.get("id") or "",
-        "nodepool": s.get("nodepool") or "",
-        "topology": s.get("topology") or "",
-    }
-    slices = payload.get("slices", [])
-    family(
-        "tpu_node_checker_slice_complete",
-        "gauge",
-        "1 when every host the slice topology implies is effectively Ready.",
-        [(slice_labels(s), 1.0 if s.get("complete") else 0.0) for s in slices],
-    )
-    family(
-        "tpu_node_checker_slice_ready_chips",
-        "gauge",
-        "Effectively-Ready chips per slice.",
-        [(slice_labels(s), s.get("ready_chips", 0)) for s in slices],
-    )
-    family(
-        "tpu_node_checker_slice_expected_chips",
-        "gauge",
-        "Chips the slice topology label promises.",
-        [(slice_labels(s), s.get("expected_chips") or 0) for s in slices],
-    )
+    # Fleet families render only for aggregator payloads: an emitter-mode
+    # scrape (probe-only payload, no LIST ran) must not advertise
+    # nodes{state="total"} 0 — "zero nodes" and "this process never counted
+    # nodes" are different facts.
+    if "total_nodes" in payload:
+        family(
+            "tpu_node_checker_nodes",
+            "gauge",
+            "Accelerator node counts by state.",
+            [({"state": "total"}, payload.get("total_nodes", 0)),
+             ({"state": "ready"}, payload.get("ready_nodes", 0))],
+        )
+        family(
+            "tpu_node_checker_chips",
+            "gauge",
+            "Accelerator device counts by state.",
+            [({"state": "total"}, payload.get("total_chips", 0)),
+             ({"state": "ready"}, payload.get("ready_chips", 0))],
+        )
+        notready: dict = {}
+        for n in payload.get("nodes", []):
+            if not n.get("ready"):
+                reason = (n.get("not_ready") or {}).get("reason") or "unknown"
+                notready[reason] = notready.get(reason, 0) + 1
+        family(
+            "tpu_node_checker_node_notready",
+            "gauge",
+            "NotReady nodes by kubelet Ready-condition reason ('unknown' when "
+            "the API gave none).",
+            [({"reason": r}, float(c)) for r, c in sorted(notready.items())],
+        )
+        # "slice" is the unique series key: several single-host slices can
+        # share one nodepool, and duplicate label sets would invalidate the
+        # whole scrape.
+        slice_labels = lambda s: {  # noqa: E731
+            "slice": s.get("id") or "",
+            "nodepool": s.get("nodepool") or "",
+            "topology": s.get("topology") or "",
+        }
+        slices = payload.get("slices", [])
+        family(
+            "tpu_node_checker_slice_complete",
+            "gauge",
+            "1 when every host the slice topology implies is effectively Ready.",
+            [(slice_labels(s), 1.0 if s.get("complete") else 0.0) for s in slices],
+        )
+        family(
+            "tpu_node_checker_slice_ready_chips",
+            "gauge",
+            "Effectively-Ready chips per slice.",
+            [(slice_labels(s), s.get("ready_chips", 0)) for s in slices],
+        )
+        family(
+            "tpu_node_checker_slice_expected_chips",
+            "gauge",
+            "Chips the slice topology label promises.",
+            [(slice_labels(s), s.get("expected_chips") or 0) for s in slices],
+        )
     multislices = payload.get("multislices") or []
     if multislices:
         ms_labels = lambda m: {"group": m.get("group") or ""}  # noqa: E731
@@ -407,11 +413,18 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
         "(0 ok, 1 monitor error, 2 none, 3 degraded).",
         [({}, result.exit_code if exit_code_override is None else exit_code_override)],
     )
+    # Aggregator rounds report phase-timer totals; emitter rounds report the
+    # probe's own elapsed time — never a constant 0.0 that would graph
+    # emitters as instant.
+    if "timings_ms" in payload:
+        duration = payload.get("timings_ms", {}).get("total", 0.0)
+    else:
+        duration = (probe or {}).get("elapsed_ms", 0.0)
     family(
         "tpu_node_checker_check_duration_ms",
         "gauge",
-        "End-to-end duration of the last check.",
-        [({}, payload.get("timings_ms", {}).get("total", 0.0))],
+        "End-to-end duration of the last check (probe time in emitter mode).",
+        [({}, duration)],
     )
     family(
         "tpu_node_checker_last_run_timestamp_seconds",
