@@ -1,0 +1,195 @@
+"""--calibrate: produce site-measured TNC_PERF_EXPECT (VERDICT r04 next #4).
+
+The dispatch-overhead gate deliberately refuses built-in-table grading on
+transports where wall-clock figures time the transport (tunneled PJRT), and
+``TNC_PERF_EXPECT`` grades anywhere — but nothing *produced* that JSON; each
+site had to hand-measure.  ``--calibrate N`` closes the loop: N probe reps,
+robust median per metric, margin, JSON on stdout (or a file).
+"""
+
+import json
+
+import pytest
+
+from tests import fixtures as fx  # noqa: F401 — import parity with suite style
+from tpu_node_checker import cli
+from tpu_node_checker.probe.floors import (
+    DEFAULT_CALIBRATION_MARGIN,
+    calibrate_expectations,
+    grade_floors,
+)
+from tpu_node_checker.probe.liveness import ProbeResult, run_local_probe
+
+
+class TestCalibrateExpectations:
+    def test_median_and_margin(self):
+        samples = [
+            {"matmul_tflops": 10.0, "hbm_gbps": 100.0},
+            {"matmul_tflops": 30.0, "hbm_gbps": 90.0},
+            {"matmul_tflops": 12.0, "hbm_gbps": 1e9},  # straggler rep
+        ]
+        out = calibrate_expectations(samples, margin=0.9)
+        assert out["matmul_tflops"] == pytest.approx(0.9 * 12.0)
+        assert out["hbm_gbps"] == pytest.approx(0.9 * 100.0)
+
+    def test_even_sample_count_averages_middle_pair(self):
+        out = calibrate_expectations(
+            [{"matmul_tflops": 10.0}, {"matmul_tflops": 20.0}], margin=1.0
+        )
+        assert out["matmul_tflops"] == pytest.approx(15.0)
+
+    def test_soak_median_lifts_to_sustained(self):
+        out = calibrate_expectations(
+            [{"matmul_tflops": 10.0, "soak": {"tflops_median": 8.0}}],
+            margin=1.0,
+        )
+        assert out["sustained_tflops"] == pytest.approx(8.0)
+
+    def test_garbage_values_filtered(self):
+        out = calibrate_expectations(
+            [
+                {"matmul_tflops": float("nan"), "hbm_gbps": -1.0,
+                 "int8_tops": True, "ring_link_gbps": "fast"},
+                {"matmul_tflops": 10.0},
+            ],
+            margin=1.0,
+        )
+        assert out == {"matmul_tflops": 10.0}
+
+    def test_no_measurable_metrics_is_empty(self):
+        assert calibrate_expectations([{"device_count": 8}]) == {}
+
+    def test_bad_margin_raises(self):
+        for margin in (0, -0.5, 1.5):
+            with pytest.raises(ValueError, match="margin"):
+                calibrate_expectations([{"matmul_tflops": 1.0}], margin=margin)
+
+    def test_calibrated_expectations_grade_through_dispatch_gate(self):
+        # The whole point: explicit expectations grade where the built-in
+        # table self-disqualifies (65 ms tunneled dispatch overhead).
+        expect = calibrate_expectations([{"matmul_tflops": 2.0}])
+        healthy = grade_floors(
+            ["TPU v5e"], "tpu", {"matmul_tflops": 1.9},
+            expectations=expect, dispatch_overhead_ms=65.0,
+        )
+        assert healthy["ok"] is True and healthy["generation"] == "custom"
+        throttled = grade_floors(
+            ["TPU v5e"], "tpu", {"matmul_tflops": 0.1},
+            expectations=expect, dispatch_overhead_ms=65.0,
+        )
+        assert throttled["failed"] == ["matmul_tflops"]
+
+
+def _fake_probe(monkeypatch, values, fail_at=None):
+    """run_local_probe double: rep i returns values[i] as matmul_tflops."""
+    calls = []
+
+    def fake(**kw):
+        i = len(calls)
+        calls.append(kw)
+        if fail_at is not None and i == fail_at:
+            return ProbeResult(
+                ok=False, level="compute", hostname="h", elapsed_ms=1.0,
+                device_count=8, error="chip dead",
+            )
+        return ProbeResult(
+            ok=True, level="compute", hostname="h", elapsed_ms=1.0,
+            device_count=8, platform="cpu",
+            details={"matmul_tflops": values[i], "hbm_gbps": 50.0},
+        )
+
+    monkeypatch.setattr("tpu_node_checker.probe.run_local_probe", fake)
+    return calls
+
+
+class TestCalibrateCli:
+    def test_stdout_json_is_margin_adjusted_median(self, monkeypatch, capsys):
+        _fake_probe(monkeypatch, [10.0, 14.0, 12.0])
+        code = cli.main(["--calibrate", "3", "--probe-level", "compute"])
+        captured = capsys.readouterr()
+        assert code == 0
+        expect = json.loads(captured.out)
+        assert expect["matmul_tflops"] == pytest.approx(
+            DEFAULT_CALIBRATION_MARGIN * 12.0
+        )
+        assert expect["hbm_gbps"] == pytest.approx(
+            DEFAULT_CALIBRATION_MARGIN * 50.0
+        )
+        # Per-rep telemetry goes to stderr — stdout stays pipeable JSON.
+        assert "rep 3/3" in captured.err
+        assert "TNC_PERF_EXPECT" in captured.err
+
+    def test_failed_rep_aborts_without_json(self, monkeypatch, capsys):
+        _fake_probe(monkeypatch, [10.0, 14.0, 12.0], fail_at=1)
+        code = cli.main(["--calibrate", "3", "--probe-level", "compute"])
+        captured = capsys.readouterr()
+        assert code == 3
+        assert captured.out == ""  # a sick host must never bless a floor
+        assert "refusing to calibrate" in captured.err
+
+    def test_calibrate_out_writes_file_atomically(
+        self, monkeypatch, tmp_path, capsys
+    ):
+        _fake_probe(monkeypatch, [10.0])
+        out = tmp_path / "expect.json"
+        code = cli.main([
+            "--calibrate", "1", "--probe-level", "compute",
+            "--calibrate-out", str(out), "--calibrate-margin", "1.0",
+        ])
+        assert code == 0
+        assert json.loads(out.read_text())["matmul_tflops"] == pytest.approx(10.0)
+        assert capsys.readouterr().out == ""
+        assert not out.with_suffix(".json.tmp").exists()
+
+    def test_reps_disable_floor_grading_during_calibration(self, monkeypatch):
+        calls = _fake_probe(monkeypatch, [10.0, 11.0])
+        assert cli.main(["--calibrate", "2", "--probe-level", "compute"]) == 0
+        assert all(kw.get("perf_floor") == 0 for kw in calls)
+
+    def test_flag_guards(self, capsys):
+        for argv in (
+            ["--calibrate", "2"],  # enumerate level
+            ["--calibrate", "0", "--probe-level", "compute"],
+            ["--calibrate", "2", "--probe-level", "compute", "--json"],
+            ["--calibrate", "2", "--probe-level", "compute", "--probe"],
+            ["--calibrate", "2", "--probe-level", "compute",
+             "--perf-floor", "0.4"],
+            ["--calibrate", "2", "--probe-level", "compute",
+             "--calibrate-margin", "1.5"],
+            ["--calibrate-out", "/tmp/x.json"],
+            ["--calibrate-margin", "0.8", "--probe", "--probe-level", "compute"],
+            ["--selftest", "--calibrate", "2"],
+        ):
+            with pytest.raises(SystemExit) as e:
+                cli.parse_args(argv)
+            assert e.value.code == 2, argv
+            capsys.readouterr()
+
+    def test_soak_calibration_is_reachable(self):
+        # --probe-soak composes with --calibrate (sustained_tflops is a
+        # calibratable metric); the soak guard must not demand --probe.
+        args = cli.parse_args([
+            "--calibrate", "2", "--probe-level", "compute",
+            "--probe-soak", "5",
+        ])
+        assert args.calibrate == 2 and args.probe_soak == 5.0
+        assert args.calibrate_margin == pytest.approx(
+            DEFAULT_CALIBRATION_MARGIN
+        )
+
+
+class TestCalibrateEndToEnd:
+    def test_calibrate_then_probe_grades_instead_of_skipping(self, monkeypatch):
+        # The real probe child on the CPU mesh: the built-in table skips
+        # (platform cpu), but calibrated expectations grade — healthy passes,
+        # and a throttle rehearsal against the same expectations fails.
+        base = run_local_probe(level="compute", timeout_s=300)
+        assert base.ok, base.error
+        expect = calibrate_expectations([base.to_dict()])
+        assert expect["matmul_tflops"] > 0
+        monkeypatch.setenv("TNC_PERF_EXPECT", json.dumps(expect))
+        graded = run_local_probe(level="compute", timeout_s=300)
+        assert graded.ok, graded.error
+        floor = graded.details["perf_floor"]
+        assert floor["ok"] is True and floor["generation"] == "custom"
+        assert "skipped" not in floor

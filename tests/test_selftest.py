@@ -174,6 +174,30 @@ class TestSelftestOrchestration:
         for k, v in stale.items():
             assert os.environ[k] == v
 
+    def test_throttle_leg_uses_calibrated_expectation(self, monkeypatch, capsys):
+        # The throttle leg grades against the host's own figure through the
+        # same median+margin path --calibrate uses — restricted to the
+        # injected metric so no other metric's jitter can fail the leg.
+        import json as _json
+        import os
+
+        from tpu_node_checker.probe.floors import DEFAULT_CALIBRATION_MARGIN
+
+        seen = []
+
+        def behavior(env, level):
+            if "TNC_CHAOS_THROTTLE" in env:
+                seen.append(_json.loads(os.environ["TNC_PERF_EXPECT"]))
+            return _healthy_behavior(env, level)
+
+        _fake_probe(monkeypatch, behavior)
+        assert cli.main(["--selftest", "--json"]) == 0
+        capsys.readouterr()
+        # _healthy_behavior's baseline measures matmul_tflops=1.5.
+        assert seen == [
+            {"matmul_tflops": round(DEFAULT_CALIBRATION_MARGIN * 1.5, 3)}
+        ]
+
     def test_probe_timeout_reaches_every_leg(self, monkeypatch, capsys):
         # The drill's one tuning knob: slow transports (first-compile TPU)
         # need a bigger per-leg budget, and EVERY leg's child must receive

@@ -173,6 +173,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        "class (perf throttle, collective leg, ICI link, DCN "
                        "boundary), each verified to be caught AND correctly named; "
                        "exit 0 = drill passed, 3 = a detector missed — runs alone")
+    probe.add_argument("--calibrate", type=int, default=None, metavar="REPS",
+                       help="measure this host's healthy perf expectations: run the "
+                       "probe REPS times at --probe-level (compute or higher), print "
+                       "the margin-adjusted per-metric medians as TNC_PERF_EXPECT "
+                       "JSON on stdout — grades perf floors on transports/hardware "
+                       "the built-in table refuses (tunneled PJRT, unlisted chips); "
+                       "any failed rep aborts (never calibrate a sick host) — runs "
+                       "alone")
+    probe.add_argument("--calibrate-margin", type=float, default=None,
+                       metavar="FRACTION",
+                       help="with --calibrate: expectation = FRACTION x median, "
+                       "keeping headroom under the healthy median so run-to-run "
+                       "jitter never sits above 'expected' (default 0.9)")
+    probe.add_argument("--calibrate-out", metavar="FILE",
+                       help="with --calibrate: write the JSON to FILE (atomic) "
+                       "instead of stdout")
 
     cordon = p.add_argument_group("Auto-quarantine (data-plane failures)")
     cordon.add_argument("--cordon-failed", action="store_true",
@@ -242,6 +258,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.uncordon_recovered
         or args.report_fresh
         or args.trend
+        or args.calibrate is not None
         or args.slack_webhook
         or args.log_jsonl
         or args.nodes_json
@@ -259,6 +276,50 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         # ran.
         p.error("--selftest runs alone (only --json and --probe-timeout "
                 "may accompany it)")
+    if args.calibrate is not None:
+        if (
+            args.emit_probe
+            or args.probe
+            or args.watch is not None
+            or args.probe_results
+            or args.cordon_failed
+            or args.uncordon_recovered
+            or args.report_fresh
+            or args.trend
+            or args.slack_webhook
+            or args.slack_only_on_error
+            or args.log_jsonl
+            or args.nodes_json
+            or args.label_selector
+            or args.resource_key
+            or args.strict_slices
+            or args.expected_chips
+            or args.multislice_label
+            or args.json
+            or args.trace
+            or args.perf_floor is not None
+        ):
+            # Calibration's stdout IS the TNC_PERF_EXPECT JSON (command
+            # substitution is the intended consumer); anything else riding
+            # along would either pollute it or silently not run.
+            p.error("--calibrate runs alone (only --probe-level/"
+                    "--probe-timeout/--probe-soak/--probe-topology and "
+                    "--calibrate-margin/--calibrate-out may accompany it)")
+        if args.calibrate < 1:
+            p.error("--calibrate needs at least 1 rep")
+        if args.probe_level == "enumerate":
+            p.error("--calibrate requires --probe-level compute (or higher)")
+        if args.calibrate_margin is None:
+            from tpu_node_checker.probe.floors import DEFAULT_CALIBRATION_MARGIN
+
+            args.calibrate_margin = DEFAULT_CALIBRATION_MARGIN
+        if not 0 < args.calibrate_margin <= 1:
+            p.error("--calibrate-margin must be in (0, 1]")
+    else:
+        if args.calibrate_out:
+            p.error("--calibrate-out requires --calibrate")
+        if args.calibrate_margin is not None:
+            p.error("--calibrate-margin requires --calibrate")
     if args.report_fresh and (
         args.emit_probe
         or args.probe
@@ -322,8 +383,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     if args.probe_soak:
         # Silently not soaking would grade a node healthy without ever
         # applying the sustained load the flag exists to apply.
-        if not (args.probe or args.emit_probe):
-            p.error("--probe-soak requires --probe or --emit-probe")
+        if not (args.probe or args.emit_probe or args.calibrate is not None):
+            p.error("--probe-soak requires --probe, --emit-probe or --calibrate")
         if args.probe_level == "enumerate":
             p.error("--probe-soak requires --probe-level compute (or higher)")
     if args.perf_floor is not None:
@@ -345,6 +406,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return checker.trend_summary(args.trend, json_mode=args.json)
         if getattr(args, "selftest", False):
             return checker.selftest(args)
+        if getattr(args, "calibrate", None) is not None:
+            return checker.calibrate(args)
         if getattr(args, "report_fresh", None):
             return checker.report_fresh(
                 args.report_fresh, args.probe_results_max_age

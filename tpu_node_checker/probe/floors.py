@@ -36,6 +36,7 @@ Design:
 from __future__ import annotations
 
 import math
+import statistics
 from typing import Mapping, Optional, Sequence
 
 from tpu_node_checker.generations import generation_of_kinds
@@ -357,6 +358,53 @@ def grade_floors(
     if throttled:
         verdict["throttled"] = throttled
     return verdict
+
+
+# Calibration keeps a little headroom under the healthy median so ordinary
+# run-to-run jitter on the SAME healthy host never sits above "expected".
+DEFAULT_CALIBRATION_MARGIN = 0.9
+
+
+def calibrate_expectations(
+    samples: Sequence[Mapping],
+    margin: float = DEFAULT_CALIBRATION_MARGIN,
+) -> dict:
+    """Robust per-metric median over probe reports → ``TNC_PERF_EXPECT``.
+
+    Closes the loop the dispatch-overhead gate deliberately leaves open: the
+    built-in table refuses to grade transports/hardware it cannot describe
+    (tunneled PJRT, unlisted generations), and ``TNC_PERF_EXPECT`` grades
+    anywhere — but nothing *produced* that JSON until ``--calibrate``
+    (round-4 verdict missing #2).
+
+    For each :data:`FLOOR_METRICS` key present (numeric, finite, positive)
+    in at least one sample, the expectation is ``margin × median`` — the
+    median discards a straggler rep (one GC pause, one cold cache), the
+    margin absorbs healthy jitter.  ``sustained_tflops`` is lifted from each
+    sample's ``soak.tflops_median`` exactly as floor grading does, so a
+    calibration run with ``--probe-soak`` produces a sustained expectation
+    too.  Metrics no sample measured are simply absent — grading only ever
+    covers measured+expected metrics.
+    """
+    if not 0 < margin <= 1:
+        raise ValueError(f"calibration margin {margin!r} must be in (0, 1]")
+    out = {}
+    for m in FLOOR_METRICS:
+        vals = []
+        for s in samples:
+            v = s.get(m)
+            if m == "sustained_tflops" and v is None and isinstance(s.get("soak"), Mapping):
+                v = s["soak"].get("tflops_median")
+            if (
+                isinstance(v, (int, float))
+                and not isinstance(v, bool)
+                and math.isfinite(v)
+                and v > 0
+            ):
+                vals.append(float(v))
+        if vals:
+            out[m] = round(margin * statistics.median(vals), 3)
+    return out
 
 
 def floor_failure_message(verdict: Mapping) -> str:
