@@ -45,7 +45,14 @@ class ClusterConfigError(RuntimeError):
 
 class ClusterAPIError(RuntimeError):
     """Non-2xx response from the API server (the stdlib transport's analog
-    of ``requests.HTTPError`` — callers rely only on the exit-1 catch-all)."""
+    of ``requests.HTTPError`` — callers rely only on the exit-1 catch-all).
+
+    ``status_code`` carries the HTTP status so control flow (the paginated
+    LIST's 410-restart) never string-matches the message."""
+
+    def __init__(self, message: str, status_code: Optional[int] = None):
+        super().__init__(message)
+        self.status_code = status_code
 
 
 class _Response:
@@ -63,7 +70,10 @@ class _Response:
         # points, leaking the cluster token off-host.
         if not 200 <= self.status_code < 300:
             snippet = self._body[:300].decode("utf-8", errors="replace")
-            raise ClusterAPIError(f"HTTP {self.status_code} from {self._url}: {snippet}")
+            raise ClusterAPIError(
+                f"HTTP {self.status_code} from {self._url}: {snippet}",
+                status_code=self.status_code,
+            )
 
     def json(self):
         return json.loads(self._body)
@@ -393,20 +403,70 @@ class KubeClient:
         elif config.basic_auth:
             self._session.auth = config.basic_auth
 
+    # LIST page size.  kubectl's own chunk size: a 64-host slice still fits
+    # one request (the single-request fast path is unchanged — one GET, no
+    # continue token in the response), while a 5k-node mixed cluster streams
+    # in ~10 bounded bodies instead of one multi-hundred-MB response.
+    LIST_PAGE_LIMIT = 500
+
     def list_nodes(
-        self, label_selector: Optional[str] = None, timeout: float = DEFAULT_TIMEOUT_S
+        self,
+        label_selector: Optional[str] = None,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        page_limit: Optional[int] = LIST_PAGE_LIMIT,
     ) -> List[dict]:
-        """``GET /api/v1/nodes`` — the single API call per run, as in
-        check-gpu-node.py:217 — optionally server-side filtered by label
-        selector so a v5e-256 check pulls 64 node objects, not the cluster."""
-        params = {}
-        if label_selector:
-            params["labelSelector"] = label_selector
-        resp = self._session.get(
-            f"{self.config.server}/api/v1/nodes", params=params, timeout=timeout
-        )
-        resp.raise_for_status()
-        return resp.json().get("items") or []
+        """``GET /api/v1/nodes`` with ``limit``/``continue`` pagination.
+
+        The reference pulls the whole NodeList in one unbounded response
+        (check-gpu-node.py:217); here responses are chunked (``page_limit``,
+        ``None``/0 disables) and followed via the ``continue`` token, with
+        server-side label filtering so a v5e-256 check pulls 64 node objects,
+        not the cluster.  A 410 Gone mid-pagination (continue token expired —
+        the API server compacted the snapshot under a slow walk) restarts the
+        LIST from scratch once rather than failing the round.
+        """
+        # Bound the walk: per-request timeouts never bound a server that
+        # keeps 200-ing with a non-advancing continue token.  1000 pages =
+        # half a million nodes at the default page size — far past any real
+        # cluster, so hitting the cap is a broken server, graded exit 1.
+        max_pages = 1000
+        for attempt in (0, 1):
+            params = {}
+            if label_selector:
+                params["labelSelector"] = label_selector
+            if page_limit:
+                params["limit"] = str(page_limit)
+            items: List[dict] = []
+            try:
+                for _ in range(max_pages):
+                    resp = self._session.get(
+                        f"{self.config.server}/api/v1/nodes",
+                        params=params,
+                        timeout=timeout,
+                    )
+                    resp.raise_for_status()
+                    doc = resp.json()
+                    items.extend(doc.get("items") or [])
+                    cont = (doc.get("metadata") or {}).get("continue")
+                    if not cont:
+                        return items
+                    params = dict(params, **{"continue": cont})
+                raise ClusterAPIError(
+                    f"LIST /api/v1/nodes did not terminate within {max_pages} "
+                    "pages (non-advancing continue token?)"
+                )
+            except Exception as exc:  # noqa: BLE001 — re-raised unless 410
+                # A drop-in requests.Session raises requests.HTTPError, not
+                # ClusterAPIError — read the status from whichever shape.
+                status = getattr(exc, "status_code", None)
+                if status is None:
+                    status = getattr(
+                        getattr(exc, "response", None), "status_code", None
+                    )
+                if attempt == 0 and status == 410 and params.get("continue"):
+                    continue  # expired token: one clean restart
+                raise
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def cordon_node(self, name: str, timeout: float = DEFAULT_TIMEOUT_S) -> None:
         """``PATCH /api/v1/nodes/{name}`` → ``spec.unschedulable=true``.
