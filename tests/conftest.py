@@ -15,6 +15,11 @@ Two quirks of the dev image are handled explicitly:
 
 import os
 
+# Any probe report our own code emits during tests is hard-checked against
+# the declared schema (probe/schema.py) — drift fails the suite, not a
+# production emitter.
+os.environ.setdefault("TNC_SCHEMA_STRICT", "1")
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # children: no TPU plugin registration
 _flags = os.environ.get("XLA_FLAGS", "")

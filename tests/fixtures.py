@@ -229,3 +229,36 @@ def serve_http(handler_cls):
     server = HTTPServer(("127.0.0.1", 0), handler_cls)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server
+
+
+def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = None):
+    """Handler class serving ``nodes`` as a NodeList with ``limit``/
+    ``continue`` pagination — the single definition of the fake API
+    server's paging semantics, shared by the pagination tests and
+    ``bench.py``'s 5k-node run.  ``requests_seen`` (optional list) records
+    each request's start offset."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler
+    from urllib.parse import parse_qs, urlparse
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            q = parse_qs(urlparse(self.path).query)
+            limit = int(q.get("limit", [str(len(nodes) or 1)])[0])
+            start = int(q.get("continue", ["0"])[0])
+            if requests_seen is not None:
+                requests_seen.append(start)
+            doc = {"kind": "NodeList", "items": nodes[start:start + limit]}
+            if start + limit < len(nodes):
+                doc["metadata"] = {"continue": str(start + limit)}
+            body = _json.dumps(doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    return Handler

@@ -322,30 +322,8 @@ class TestPaginatedList:
     def test_three_pages_over_real_http_transport(self):
         # End-to-end over the stdlib transport against a fake API server:
         # limit/continue round-trip through real URL encoding and JSON.
-        import json as _json
-        from http.server import BaseHTTPRequestHandler
-        from urllib.parse import parse_qs, urlparse
-
         nodes = fx.tpu_v5e_256_slice()
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                q = parse_qs(urlparse(self.path).query)
-                limit = int(q["limit"][0])
-                start = int(q.get("continue", ["0"])[0])
-                doc = fx.node_list(nodes[start:start + limit])
-                if start + limit < len(nodes):
-                    doc["metadata"] = {"continue": str(start + limit)}
-                body = _json.dumps(doc).encode()
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):
-                pass
-
-        server = fx.serve_http(Handler)
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes))
         try:
             cfg = cluster.ClusterConfig(
                 server=f"http://127.0.0.1:{server.server_address[1]}"

@@ -1,0 +1,212 @@
+"""The probe report's formal schema (VERDICT r04 missing #4 / next #7).
+
+The emitter/aggregator contract was version-checked by an int but not
+type-checked: a field-type drift inside the same major (ring_bad_links as a
+string, matmul_tflops as text) passed silently into grading and metrics.
+probe/schema.py is the machine contract; these tests pin both directions —
+conforming reports pass untouched, drifted reports are refused with the
+field NAMED.
+"""
+
+import json
+import re
+import time
+from pathlib import Path
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+from tpu_node_checker.probe.liveness import run_local_probe
+from tpu_node_checker.probe.schema import (
+    REPORT_SPEC,
+    as_json_schema,
+    validate_report,
+)
+
+
+def args_for(*argv):
+    return cli.parse_args(list(argv))
+
+
+MINIMAL = {"ok": True, "level": "enumerate", "hostname": "h", "elapsed_ms": 1.0}
+
+
+class TestValidateReport:
+    def test_minimal_and_rich_reports_conform(self):
+        assert validate_report(MINIMAL) == []
+        rich = dict(
+            MINIMAL,
+            schema=1,
+            written_at=time.time(),
+            platform="tpu",
+            device_count=4,
+            device_kinds=["TPU v5e"],
+            memory=[{"id": 0, "bytes_in_use": 0, "bytes_limit": 16_000_000_000}],
+            hbm_capacity={"generation": "v5e", "expected_gb": 16.0,
+                          "fraction": 0.9, "min_gb": 15.5,
+                          "failed_devices": [], "ok": True},
+            matmul_tflops=180.5,
+            perf_floor={"generation": "v5e", "fraction": 0.4,
+                        "expected": {"matmul_tflops": 197.0},
+                        "measured": {"matmul_tflops": 180.5},
+                        "ratios": {"matmul_tflops": 0.916},
+                        "failed": [], "ok": True},
+            collective_busbw_gbps=None,
+            ring_bad_links=["0->1"],
+            collective_legs_ok={"psum_ok": True, "all_gather_ok": False,
+                                "reduce_scatter_ok": True},
+            workload_losses=[2.5, 2.1, 1.8],
+            soak={"ok": True, "rounds": 5, "seconds": 10.0,
+                  "tflops_min": 170.0, "tflops_median": 180.0,
+                  "tflops_max": 181.0, "sustained_ratio": 0.94,
+                  "hbm_gbps_min": 700.0, "hbm_gbps_median": 720.0},
+        )
+        assert validate_report(rich) == []
+
+    def test_type_drift_names_the_field(self):
+        drifted = dict(MINIMAL, matmul_tflops="fast")
+        (violation,) = validate_report(drifted)
+        assert violation.startswith("matmul_tflops:")
+        drifted = dict(MINIMAL, ring_bad_links="0->1")  # str, not list
+        (violation,) = validate_report(drifted)
+        assert violation.startswith("ring_bad_links:")
+        drifted = dict(MINIMAL, collective_legs_ok={"psum_ok": "yes"})
+        (violation,) = validate_report(drifted)
+        assert violation.startswith("collective_legs_ok.psum_ok:")
+        drifted = dict(MINIMAL, memory=[{"bytes_limit": "16GB"}])
+        (violation,) = validate_report(drifted)
+        assert violation.startswith("memory[0].bytes_limit:")
+
+    def test_bool_never_passes_as_number(self):
+        (violation,) = validate_report(dict(MINIMAL, matmul_tflops=True))
+        assert violation.startswith("matmul_tflops:")
+
+    def test_null_allowed_only_where_documented(self):
+        assert validate_report(dict(MINIMAL, collective_busbw_gbps=None)) == []
+        (violation,) = validate_report(dict(MINIMAL, matmul_tflops=None))
+        assert violation.startswith("matmul_tflops:")
+
+    def test_required_keys(self):
+        assert "ok: required key missing" in validate_report({"level": "compute"})
+        assert "level: required key missing" in validate_report({"ok": True})
+
+    def test_unknown_keys_are_forward_compatible(self):
+        assert validate_report(dict(MINIMAL, a_future_minor_field=123)) == []
+        # ...including unknown keys inside objects with typed known keys.
+        assert validate_report(
+            dict(MINIMAL, soak={"ok": True, "rounds": 1, "seconds": 1.0,
+                                "tflops_min": 1.0, "tflops_median": 1.0,
+                                "tflops_max": 1.0, "sustained_ratio": 1.0,
+                                "hbm_gbps_min": 1.0, "hbm_gbps_median": 1.0,
+                                "new_minor_figure": 3.0})
+        ) == []
+
+    def test_garbage_never_raises(self):
+        assert validate_report(None)
+        assert validate_report("report")
+        assert validate_report([MINIMAL])
+        assert validate_report({1: "x", "ok": True, "level": "z"})
+
+    def test_spec_covers_every_emitted_key(self):
+        # Lockstep guard: any new out["key"] in the probe child must be
+        # added to REPORT_SPEC (and docs/PROBE.md) or this fails.
+        src = Path(checker.__file__).parent / "probe" / "liveness.py"
+        emitted = set(re.findall(r'out\["([a-z_0-9]+)"\]', src.read_text()))
+        missing = emitted - set(REPORT_SPEC)
+        assert not missing, f"probe keys not in REPORT_SPEC: {sorted(missing)}"
+
+    def test_real_probe_report_conforms(self):
+        r = run_local_probe(level="compute", timeout_s=300)
+        assert r.ok, r.error
+        doc = r.to_dict()
+        doc["schema"] = 1
+        doc["written_at"] = time.time()
+        assert validate_report(doc) == []
+
+    def test_strict_mode_off_spellings(self, monkeypatch):
+        # An exported TNC_SCHEMA_STRICT=0 selects the documented warn-only
+        # production behavior — it must not read as "strict".
+        from tpu_node_checker.probe.schema import strict_mode
+
+        for off in ("", "0", "false", "False", "no"):
+            monkeypatch.setenv("TNC_SCHEMA_STRICT", off)
+            assert strict_mode() is False, off
+        for on in ("1", "true", "yes"):
+            monkeypatch.setenv("TNC_SCHEMA_STRICT", on)
+            assert strict_mode() is True, on
+
+    def test_json_schema_document(self):
+        doc = as_json_schema()
+        assert doc["required"] == ["ok", "level"]
+        assert set(doc["properties"]) == set(REPORT_SPEC)
+        json.dumps(doc)  # serializable end to end
+        assert doc["properties"]["collective_busbw_gbps"]["anyOf"]
+        assert doc["properties"]["memory"]["items"]["properties"]["bytes_limit"]
+
+
+class TestAggregatorRefusal:
+    def _write_report(self, directory, hostname, **overrides):
+        doc = {
+            "ok": True, "level": "compute", "hostname": hostname,
+            "elapsed_ms": 5.0, "schema": 1, "written_at": time.time(),
+            "device_count": 4,
+        }
+        doc.update(overrides)
+        (directory / f"{hostname}.json").write_text(json.dumps(doc))
+
+    def test_type_drifted_report_refused_with_named_field(
+        self, tmp_path, capsys
+    ):
+        nodes = fx.tpu_v5e_single_host()
+        host = nodes[0]["metadata"]["name"]
+        self._write_report(tmp_path, host, matmul_tflops="fast")
+        result = checker.run_check(
+            args_for(
+                "--probe-results", str(tmp_path),
+                "--probe-results-required", "--json",
+            ),
+            nodes=nodes,
+        )
+        err = capsys.readouterr().err
+        assert "schema violation" in err and "matmul_tflops" in err
+        # Refused ⇒ the host graded MISSING (safe direction), counted under
+        # the same contract-break counter as version skew.
+        assert result.payload["probe_summary"]["hosts_missing"] == [host]
+        assert result.payload["probe_summary"]["reports_skipped"]["schema"] == 1
+
+    def test_conforming_report_attaches(self, tmp_path, capsys):
+        nodes = fx.tpu_v5e_single_host()
+        host = nodes[0]["metadata"]["name"]
+        self._write_report(tmp_path, host, matmul_tflops=180.5)
+        result = checker.run_check(
+            args_for("--probe-results", str(tmp_path), "--json"), nodes=nodes
+        )
+        assert result.payload["probe_summary"]["hosts_ok"] == 1
+        capsys.readouterr()
+
+
+class TestEmitterStrictness:
+    def test_emitter_validates_its_own_report(self, tmp_path, monkeypatch, capsys):
+        from tpu_node_checker.probe.liveness import ProbeResult
+
+        monkeypatch.setattr(
+            "tpu_node_checker.probe.run_local_probe",
+            lambda **kw: ProbeResult(
+                ok=True, level="compute", hostname="h", elapsed_ms=1.0,
+                device_count=4, details={"matmul_tflops": "fast"},  # drifted
+            ),
+        )
+        # Strict (the suite's default): the bug fails loudly, nothing written.
+        out = tmp_path / "r.json"
+        code = cli.main(["--emit-probe", str(out)])
+        captured = capsys.readouterr()
+        assert code == 1
+        assert "matmul_tflops" in (captured.out + captured.err)
+        assert not out.exists()
+        # Production (no TNC_SCHEMA_STRICT): warn on stderr, still emit — a
+        # schema lagging a hotfix must not silence a healthy fleet.
+        monkeypatch.delenv("TNC_SCHEMA_STRICT")
+        code = cli.main(["--emit-probe", str(out)])
+        captured = capsys.readouterr()
+        assert code == 0
+        assert "WARNING" in captured.err and "matmul_tflops" in captured.err
+        assert json.loads(out.read_text())["matmul_tflops"] == "fast"

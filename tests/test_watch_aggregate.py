@@ -217,7 +217,8 @@ class TestProbeResultsAggregation:
         reports = tmp_path / "reports"
         reports.mkdir()
         (reports / "gke-tpu-v5p-3.json").write_text(
-            json.dumps({"ok": True, "hostname": "gke-tpu-v5p-3",
+            json.dumps({"ok": True, "level": "enumerate",
+                        "hostname": "gke-tpu-v5p-3",
                         "written_at": time.time() + 5})
         )
         result = checker.run_check(
@@ -527,7 +528,7 @@ class TestWatch:
             (["--probe-results-required"], "requires --probe-results"),
             (["--probe", "--probe-soak", "60"], "requires --probe-level compute"),
             (["--probe-soak", "60", "--probe-level", "compute"],
-             "requires --probe or --emit-probe"),
+             "requires --probe, --emit-probe or --calibrate"),
         ]:
             with pytest.raises(SystemExit):
                 cli.parse_args(argv)
@@ -990,7 +991,8 @@ class TestReportSchemaVersioning:
         reports.mkdir()
         (reports / "gke-tpu-v5e-0.json").write_text(
             json.dumps(
-                {"ok": True, "hostname": "gke-tpu-v5e-0", "written_at": time.time()}
+                {"ok": True, "level": "enumerate",
+                 "hostname": "gke-tpu-v5e-0", "written_at": time.time()}
             )
         )
         code = checker.one_shot(
@@ -1015,6 +1017,7 @@ class TestKindMismatchWarning:
             json.dumps(
                 {
                     "ok": True,
+                    "level": "enumerate",
                     "hostname": "gke-tpu-x-0",
                     "device_kinds": kinds,
                     "written_at": time.time(),

@@ -229,6 +229,8 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> dict:
     import os
     import time as _time
 
+    from tpu_node_checker.probe.schema import validate_report as _validate_report
+
     skipped = {"unreadable": 0, "schema": 0, "stale": 0, "future_skew": 0}
     directory = getattr(args, "probe_results", None)
     if not directory:
@@ -280,6 +282,20 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> dict:
                 file=sys.stderr,
             )
             skipped["stale"] += 1
+            continue
+        violations = _validate_report(data)
+        if violations:
+            # Same major, drifted TYPES (a bug, or a foreign emitter): a
+            # misread field would flow straight into grading and metrics —
+            # refuse with the field named, under the same counter as
+            # version skew (both are contract breaks).  Checked after the
+            # freshness gates so a stale report still counts as stale.
+            print(
+                f"Skipping probe report {path}: schema violation — "
+                + "; ".join(violations[:5]),
+                file=sys.stderr,
+            )
+            skipped["schema"] += 1
             continue
         hostname = data.get("hostname") or os.path.splitext(os.path.basename(path))[0]
         node = by_name.get(hostname)
@@ -978,6 +994,21 @@ def _emit_probe_once(args) -> tuple:
     doc = probed.to_dict()
     doc["schema"] = REPORT_SCHEMA_VERSION  # aggregator contract version
     doc["written_at"] = time.time()  # staleness anchor for the aggregator
+    from tpu_node_checker.probe.schema import strict_mode, validate_report
+
+    violations = validate_report(doc)
+    if violations:
+        # Our own emitter producing an off-contract report is a BUG, but a
+        # field the schema lags behind must not stop a healthy host from
+        # vouching for its chips in production — warn there, fail hard in
+        # tests/CI (TNC_SCHEMA_STRICT).
+        msg = (
+            "probe report violates its declared schema: "
+            + "; ".join(violations[:5])
+        )
+        if strict_mode():
+            raise ValueError(msg)
+        print(f"WARNING: {msg}", file=sys.stderr)
     payload = json.dumps(doc, ensure_ascii=False, indent=2)
     target = args.emit_probe
     if target == "-":
