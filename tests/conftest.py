@@ -29,3 +29,25 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def shared_compute_probe():
+    """One real, CLEAN compute-level probe child on the CPU mesh, shared by
+    every test that only READS the healthy verdict (VERDICT r04 next #6:
+    each probe child pays a fresh jax import — the suite was paying it tens
+    of times for the same clean result).  Tests that mutate probe inputs
+    (TNC_* env, flags, chaos) must spawn their own child.  The spawn runs
+    with TNC_* scrubbed so no requesting test's environment can leak in.
+    """
+    from tpu_node_checker.probe.liveness import run_local_probe
+
+    saved = {k: os.environ.pop(k) for k in list(os.environ) if k.startswith("TNC_")}
+    try:
+        r = run_local_probe(level="compute", timeout_s=400)
+    finally:
+        os.environ.update(saved)
+    assert r.ok, r.error
+    return r

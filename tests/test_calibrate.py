@@ -179,13 +179,13 @@ class TestCalibrateCli:
 
 
 class TestCalibrateEndToEnd:
-    def test_calibrate_then_probe_grades_instead_of_skipping(self, monkeypatch):
+    def test_calibrate_then_probe_grades_instead_of_skipping(
+        self, monkeypatch, shared_compute_probe
+    ):
         # The real probe child on the CPU mesh: the built-in table skips
         # (platform cpu), but calibrated expectations grade — healthy passes,
         # and a throttle rehearsal against the same expectations fails.
-        base = run_local_probe(level="compute", timeout_s=300)
-        assert base.ok, base.error
-        expect = calibrate_expectations([base.to_dict()])
+        expect = calibrate_expectations([shared_compute_probe.to_dict()])
         assert expect["matmul_tflops"] > 0
         monkeypatch.setenv("TNC_PERF_EXPECT", json.dumps(expect))
         graded = run_local_probe(level="compute", timeout_s=300)

@@ -323,14 +323,19 @@ class TestPaginatedList:
         # End-to-end over the stdlib transport against a fake API server:
         # limit/continue round-trip through real URL encoding and JSON.
         nodes = fx.tpu_v5e_256_slice()
-        server = fx.serve_http(fx.paged_nodelist_handler(nodes))
+        seen: list = []
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes, seen))
         try:
             cfg = cluster.ClusterConfig(
                 server=f"http://127.0.0.1:{server.server_address[1]}"
             )
             got = cluster.KubeClient(cfg).list_nodes(page_limit=22)
-            assert len(got) == 64  # ceil(64/22) = 3 pages
+            assert len(got) == 64
             assert len({n["metadata"]["name"] for n in got}) == 64
+            # The limit param must actually cross the wire: the shared
+            # handler defaults a MISSING limit to one all-nodes page, so
+            # pin the 3-page walk (ceil(64/22)) explicitly.
+            assert seen == [0, 22, 44]
         finally:
             server.shutdown()
 

@@ -109,6 +109,7 @@ class TestDcnProbes:
         assert r.ok, r.error
 
 
+@pytest.mark.slow  # six collective-level probe children (~110s); CI's slow step covers them
 class TestDcnInProbeChild:
     """End-to-end through the subprocess child on the CPU mesh."""
 

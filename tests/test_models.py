@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from tpu_node_checker.models import (
     BurninConfig,
@@ -50,12 +51,14 @@ class TestWorkloadProbe:
         assert len(r.losses) == 3
         assert r.losses[-1] < r.losses[0]
 
+    @pytest.mark.slow  # heavy XLA compile (13-21s); CI's slow step covers it
     def test_sharded_probe_over_mesh(self):
         mesh = build_mesh(MeshSpec((("data", 4), ("model", 2))))
         r = workload_probe(TINY, mesh=mesh, steps=3)
         assert r.ok, r.error
         assert r.losses[-1] < r.losses[0]
 
+    @pytest.mark.slow  # heavy XLA compile (13-21s); CI's slow step covers it
     def test_sharded_matches_single_device(self):
         # GSPMD must not change the math: same seed, same loss trajectory.
         mesh = build_mesh(MeshSpec((("data", 2), ("model", 4))))
@@ -70,6 +73,7 @@ class TestWorkloadProbe:
         assert not r.ok
         assert r.error
 
+    @pytest.mark.slow  # heavy XLA compile (13-21s); CI's slow step covers it
     def test_flash_attention_matches_xla_attention(self):
         # Same seed, same data: the Pallas-forward/XLA-backward step must
         # track the pure-XLA step's loss trajectory.
@@ -99,6 +103,7 @@ class TestWorkloadProbe:
         assert not r.ok
         assert "seq % 128" in r.error
 
+    @pytest.mark.slow  # heavy XLA compile (13-21s); CI's slow step covers it
     def test_remat_matches_no_remat(self):
         # jax.checkpoint trades FLOPs for HBM; the loss trajectory must be
         # bit-compatible up to float noise.

@@ -324,12 +324,10 @@ class TestHbmCapacity:
 class TestFloorsInProbeChild:
     """End-to-end through the subprocess child on the CPU mesh."""
 
-    def test_off_tpu_grading_is_stamped_skipped(self):
+    def test_off_tpu_grading_is_stamped_skipped(self, shared_compute_probe):
         # CPU platform, no explicit expectations: the verdict must say WHY
         # floors did not grade — visible, never silent.
-        r = run_local_probe(level="compute", timeout_s=300)
-        assert r.ok, r.error
-        floor = r.details.get("perf_floor")
+        floor = shared_compute_probe.details.get("perf_floor")
         assert floor and "cpu" in floor["skipped"]
 
     def test_expectation_override_grades_and_fails(self, monkeypatch):
@@ -342,13 +340,14 @@ class TestFloorsInProbeChild:
         assert floor["failed"] == ["matmul_tflops"]
         assert floor["generation"] == "custom"
 
-    def test_chaos_throttle_fails_healthy_host_with_named_metric(self, monkeypatch):
-        # Learn this machine's real figure, then expect exactly it: the
-        # un-throttled chip passes (measured ≈ expected > 0.4×expected) and
-        # the throttled rehearsal (÷20) fails naming the metric.
-        base = run_local_probe(level="compute", timeout_s=300)
-        assert base.ok, base.error
-        measured = base.details["matmul_tflops"]
+    def test_chaos_throttle_fails_healthy_host_with_named_metric(
+        self, monkeypatch, shared_compute_probe
+    ):
+        # Learn this machine's real figure (from the shared clean child),
+        # then expect exactly it: the un-throttled chip passes (measured ≈
+        # expected > 0.4×expected) and the throttled rehearsal (÷20) fails
+        # naming the metric.
+        measured = shared_compute_probe.details["matmul_tflops"]
         monkeypatch.setenv(
             "TNC_PERF_EXPECT", json.dumps({"matmul_tflops": measured})
         )
@@ -371,6 +370,7 @@ class TestFloorsInProbeChild:
         assert r.details.get("chaos_injected") == {"throttle": "matmul_tflops"}
         assert "TNC_CHAOS_THROTTLE" in (r.error or "")
 
+    @pytest.mark.slow  # own probe child(ren); CI's slow step covers it
     def test_soak_median_graded_as_sustained(self, monkeypatch):
         # End-to-end wiring: a short soak's tflops_median feeds floor
         # grading as sustained_tflops when the expectations name it.
@@ -385,6 +385,7 @@ class TestFloorsInProbeChild:
         assert floor["measured"]["sustained_tflops"] > 0
         assert "sustained_tflops" in (r.error or "")
 
+    @pytest.mark.slow  # own probe child(ren); CI's slow step covers it
     def test_malformed_floor_env_vars_name_the_var(self, monkeypatch):
         # A config typo must read as a config typo, not a hardware fault —
         # --cordon-failed acts on probe failures.
@@ -403,6 +404,7 @@ class TestFloorsInProbeChild:
         assert not r.ok
         assert "TNC_PERF_FLOOR_MAX_DISPATCH_MS" in (r.error or "")
 
+    @pytest.mark.slow  # own probe child(ren); CI's slow step covers it
     def test_perf_floor_zero_disables_via_flag_plumbing(self, monkeypatch):
         monkeypatch.setenv("TNC_PERF_EXPECT", json.dumps({"matmul_tflops": 1e9}))
         r = run_local_probe(level="compute", timeout_s=300, perf_floor=0)

@@ -114,13 +114,32 @@ class TestValidateReport:
         missing = emitted - set(REPORT_SPEC)
         assert not missing, f"probe keys not in REPORT_SPEC: {sorted(missing)}"
 
-    def test_real_probe_report_conforms(self):
-        r = run_local_probe(level="compute", timeout_s=300)
-        assert r.ok, r.error
-        doc = r.to_dict()
+    def test_real_probe_report_conforms(self, shared_compute_probe):
+        doc = shared_compute_probe.to_dict()
         doc["schema"] = 1
         doc["written_at"] = time.time()
         assert validate_report(doc) == []
+
+    def test_failed_leg_nulls_still_conform(self):
+        # When a per-axis leg crashes before producing a verdict, liveness
+        # emits null for the verdict/topology keys ((ax.details or
+        # {}).get(...)).  Such FAILED-probe reports must attach and degrade
+        # the host — refusing them as drifted would let a sick host keep
+        # its healthy kubelet grade.
+        failed = dict(
+            MINIMAL, ok=False, error="ici axis leg crashed",
+            ici_axis_ok=None, ici_topology=None,
+            fault_domain_ok=None, fault_domain_topology=None,
+        )
+        assert validate_report(failed) == []
+        # The populated shapes still conform — and still drift-check.
+        assert validate_report(
+            dict(MINIMAL, ici_axis_ok={"t0": True, "t1": False})
+        ) == []
+        (violation,) = validate_report(dict(MINIMAL, ici_axis_ok={"t0": "yes"}))
+        assert violation.startswith("ici_axis_ok.t0:")
+        (violation,) = validate_report(dict(MINIMAL, ici_axis_ok=[True]))
+        assert violation.startswith("ici_axis_ok:")
 
     def test_strict_mode_off_spellings(self, monkeypatch):
         # An exported TNC_SCHEMA_STRICT=0 selects the documented warn-only

@@ -152,12 +152,16 @@ REPORT_SPEC: dict = {
     "collective_legs_ok": {"__values__": "bool"},
     "collective_err": "str",
     "chaos_injected": {"__values__": "str"},
-    "ici_topology": "str",
-    "ici_axis_ok": {"__values__": "bool"},
+    # The per-axis legs emit null for verdict/topology when the leg itself
+    # crashed before producing one ((ax.details or {}).get(...) in
+    # liveness.py) — such failed-probe reports must still attach and
+    # degrade the host, not be refused as drifted.
+    "ici_topology": ("str", "null"),
+    "ici_axis_ok": ({"__values__": "bool"}, "null"),
     "ici_axis_busbw_gbps": {"__values__": _NUM_OR_NULL},
     "axis_busbw_err": {"__values__": "str"},
-    "fault_domain_ok": {"__values__": "bool"},
-    "fault_domain_topology": "str",
+    "fault_domain_ok": ({"__values__": "bool"}, "null"),
+    "fault_domain_topology": ("str", "null"),
     "fault_domain_busbw_gbps": {"__values__": _NUM_OR_NULL},
     "dcn_busbw_gbps": _NUM_OR_NULL,
     "dcn_err": "str",
@@ -204,7 +208,7 @@ def _describe(spec: Spec) -> str:
     if isinstance(spec, str):
         return spec
     if isinstance(spec, tuple):
-        return " or ".join(spec)
+        return " or ".join(_describe(t) for t in spec)
     if isinstance(spec, list):
         return f"list of {_describe(spec[0])}"
     return "object"
@@ -214,11 +218,22 @@ def _check(value, spec: Spec, path: str, out: List[str]) -> None:
     if isinstance(spec, str):
         spec = (spec,)
     if isinstance(spec, tuple):
-        if not any(_type_ok(value, t) for t in spec):
-            out.append(
-                f"{path}: expected {_describe(spec)}, "
-                f"got {type(value).__name__}"
-            )
+        # anyOf: scalar names check directly.  A value whose container KIND
+        # matches a nested alternative delegates into it, so violations
+        # keep naming the inner field (ici_axis_ok.t0, not ici_axis_ok).
+        for t in spec:
+            if isinstance(t, str) and _type_ok(value, t):
+                return
+        for t in spec:
+            if isinstance(t, dict) and isinstance(value, Mapping):
+                _check(value, t, path, out)
+                return
+            if isinstance(t, list) and isinstance(value, list):
+                _check(value, t, path, out)
+                return
+        out.append(
+            f"{path}: expected {_describe(spec)}, got {type(value).__name__}"
+        )
         return
     if isinstance(spec, list):
         if not isinstance(value, list):
@@ -276,6 +291,8 @@ def _spec_to_json_schema(spec: Spec) -> dict:
             {"any": {}, "null": {"type": "null"}, "bool": {"type": "boolean"},
              "int": {"type": "integer"}, "number": {"type": "number"},
              "str": {"type": "string"}}[t]
+            if isinstance(t, str)
+            else _spec_to_json_schema(t)
             for t in spec
         ]
         return types[0] if len(types) == 1 else {"anyOf": types}
