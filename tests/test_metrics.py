@@ -430,6 +430,60 @@ class TestTrendSummary:
         out = capsys.readouterr().out
         assert "(slice pool-a: 14/16 hosts ready; probe-failed: h3)" in out
 
+    def test_top_causes_roll_up_by_class(self, tmp_path, capsys):
+        # Across ALL degraded rounds (not only transitions): names fold
+        # into classes — a 64-host outage is one cause — and the NotReady
+        # kubelet reason survives the fold.
+        t0 = 1_700_000_000
+        entries = [
+            {"ts": t0, "exit_code": 0},
+            {"ts": t0 + 60, "exit_code": 3, "causes": [
+                "slice pool-a: 14/16 hosts ready",
+                "slice pool-b: 15/16 hosts ready",
+                "not-ready: h1 (KubeletNotReady: runtime down)",
+                "not-ready: h2 (KubeletNotReady: runtime down)",
+                "not-ready: h3 (NodeStatusUnknown: kubelet stopped)",
+                "+9 more",
+            ]},
+            {"ts": t0 + 120, "exit_code": 3, "causes": [
+                "slice pool-a: 14/16 hosts ready",
+                "probe-failed: h4",
+            ]},
+            {"ts": t0 + 180, "exit_code": 1, "error": "API unreachable"},
+            {"ts": t0 + 240, "exit_code": 0},
+        ]
+        path = self._log(tmp_path, entries)
+        assert cli.main(["--trend", path, "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        # Per-round dedup: two slices in round 1 count that ROUND once per
+        # class; the cap line vanishes.
+        assert s["top_causes"][0] == {"cause": "slice incomplete", "rounds": 2}
+        by_cause = {c["cause"]: c["rounds"] for c in s["top_causes"]}
+        assert by_cause["not-ready (KubeletNotReady)"] == 1
+        assert by_cause["monitor error"] == 1
+        assert "+9 more" not in by_cause
+        assert s["cause_classes_total"] == 5
+        # A message-only condition ("not-ready: h (container runtime is
+        # down)") must not promote its first word to a reason class.
+        from tpu_node_checker.checker import _cause_class
+
+        assert (
+            _cause_class("not-ready: h1 (container runtime is down)")
+            == "not-ready"
+        )
+        assert (
+            _cause_class("not-ready: h1 (KubeletNotReady)")
+            == "not-ready (KubeletNotReady)"
+        )
+        assert (
+            _cause_class("not-ready: h1 (KubeletNotReady, NetworkUnavailable: x)")
+            == "not-ready (KubeletNotReady)"
+        )
+        # Human mode prints the same roll-up.
+        assert cli.main(["--trend", path]) == 0
+        out = capsys.readouterr().out
+        assert "top causes: slice incomplete ×2" in out
+
     def test_monitor_error_transition_carries_error(self, tmp_path, capsys):
         t0 = 1_700_000_000
         entries = [

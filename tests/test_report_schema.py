@@ -106,6 +106,34 @@ class TestValidateReport:
         assert validate_report([MINIMAL])
         assert validate_report({1: "x", "ok": True, "level": "z"})
 
+    def test_fuzz_validator_is_total(self):
+        # The validator fronts the aggregator: ANY JSON-shaped value —
+        # including deeply nested garbage under known keys — must yield a
+        # list of strings, never an exception (a crash here would sink the
+        # whole check round, not one report).
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        json_vals = st.recursive(
+            st.none() | st.booleans() | st.integers() | st.floats()
+            | st.text(max_size=8),
+            lambda inner: st.lists(inner, max_size=4)
+            | st.dictionaries(st.text(max_size=8), inner, max_size=4),
+            max_leaves=12,
+        )
+        spec_keys = st.dictionaries(
+            st.sampled_from(sorted(REPORT_SPEC)), json_vals, max_size=6
+        )
+
+        @settings(max_examples=150, deadline=None)
+        @given(st.one_of(json_vals, spec_keys))
+        def run(doc):
+            out = validate_report(doc)
+            assert isinstance(out, list)
+            assert all(isinstance(v, str) for v in out)
+
+        run()
+
     def test_spec_covers_every_emitted_key(self):
         # Lockstep guard: any new out["key"] in the probe child must be
         # added to REPORT_SPEC (and docs/PROBE.md) or this fails.

@@ -27,6 +27,7 @@ import fnmatch
 import json
 import math
 import os
+import re
 import sys
 import time
 from dataclasses import dataclass, field
@@ -1235,6 +1236,32 @@ def _recover_last_code(args) -> Optional[int]:
     return None
 
 
+def _cause_class(cause: str) -> str:
+    """Fold one logged cause line into its failure CLASS for the --trend
+    roll-up: per-host and per-slice names drop (a 64-host outage is one
+    cause, not 64), the NotReady kubelet reason survives (different reasons
+    route to different responders), and the "+N more" cap lines vanish."""
+    cause = cause.strip()
+    if not cause or re.fullmatch(r"\+\d+ more", cause):
+        return ""
+    head, sep, rest = cause.partition(":")
+    head = head.strip()
+    if head.startswith("slice "):
+        return "slice incomplete"
+    if head == "not-ready":
+        # Only a reason-SHAPED token counts (a lone word ending the paren
+        # group or followed by ':'/','): a message-only condition renders
+        # as "(container runtime is down)" and its first word must not
+        # masquerade as a kubelet reason class.
+        m = re.search(r"\((\w+)\s*[:,)]", rest)
+        return f"not-ready ({m.group(1)})" if m else "not-ready"
+    if head.startswith("expected ≥"):
+        return "capacity shortfall"
+    # "probe-failed", "no probe report", "no allocatable devices",
+    # "monitor error", "no accelerator nodes", ...
+    return head if sep else cause[:40]
+
+
 def trend_summary(path: str, json_mode: bool = False) -> int:
     """``--trend FILE``: summarize a ``--log-jsonl`` trend log.
 
@@ -1328,6 +1355,25 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         if final_code != EXIT_OK and final_e.get("planned"):
             planned_outage_s += dt
     occupancy_total = sum(state_seconds.values())
+    # Dominant failure classes across ALL degraded rounds (not only
+    # transitions): "what mostly took us down" is the first post-incident
+    # question after "when".  Host/slice names are folded into classes so a
+    # 64-host outage reads as one cause, and the NotReady kubelet reason is
+    # kept — KubeletNotReady and NodeStatusUnknown are different incidents.
+    cause_counts: dict = {}
+    for _, code, e in rounds:
+        if code == EXIT_OK:
+            continue
+        causes = e.get("causes") if isinstance(e.get("causes"), list) else []
+        if code == EXIT_ERROR and not causes and e.get("error"):
+            causes = ["monitor error"]
+        for cls in {cls for c in causes if (cls := _cause_class(str(c)))}:
+            cause_counts[cls] = cause_counts.get(cls, 0) + 1
+    top_causes = [
+        {"cause": cls, "rounds": n}
+        for cls, n in sorted(cause_counts.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+    ]
+    cause_classes_total = len(cause_counts)
     summary = {
         "rounds": len(rounds),
         "skipped_lines": skipped,
@@ -1363,6 +1409,10 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
             if slice_ratios
             else None
         ),
+        "top_causes": top_causes,
+        # Same no-silent-truncation rule as transitions_total: a capped
+        # list must say what it dropped.
+        "cause_classes_total": cause_classes_total,
         "transitions": transitions[-20:],
         "transitions_total": len(transitions),
         "longest_outage_s": round(longest_outage_s, 1),
@@ -1417,6 +1467,13 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         f"longest outage {summary['longest_outage_s']}s; "
         f"current state: exit {summary['last_exit_code']}"
     )
+    if top_causes:
+        omitted = cause_classes_total - len(top_causes)
+        print(
+            "top causes: "
+            + "; ".join(f"{c['cause']} ×{c['rounds']}" for c in top_causes)
+            + (f"; +{omitted} more classes" if omitted else "")
+        )
     shown = summary["transitions"]  # one truncation rule for both surfaces
     if len(transitions) > len(shown):
         print(f"  … {len(transitions) - len(shown)} earlier transitions omitted")
