@@ -302,3 +302,37 @@ class TestScale:
         # 5024 nodes parsed, grouped, and reported: the in-process path must
         # stay well inside the 2 s budget (generous bound for slow CI).
         assert elapsed_s < 2.0, f"scale check took {elapsed_s:.2f}s"
+
+
+class TestColdPathImports:
+    def test_probe_less_check_keeps_heavy_modules_unloaded(self, tmp_path):
+        # The cold-start budget's structural guard: a plain control-plane
+        # check must not import jax, requests, PyYAML, or any probe
+        # machinery (liveness subprocess plumbing, the report schema) — the
+        # round-4/5 lazy-import work, pinned so a future top-of-function
+        # import cannot silently re-tax every cron run.
+        import subprocess
+        import sys
+
+        path = write_nodes(tmp_path, fx.tpu_v5e_256_slice())
+        code = (
+            "import sys\n"
+            "from tpu_node_checker import checker, cli\n"
+            f"args = cli.parse_args(['--json', '--nodes-json', {str(path)!r}])\n"
+            "result = checker.run_check(args)\n"
+            "assert result.exit_code == 0\n"
+            "heavy = [m for m in ('jax', 'requests', 'yaml',\n"
+            "                     'tpu_node_checker.probe.liveness',\n"
+            "                     'tpu_node_checker.probe.schema',\n"
+            "                     'tpu_node_checker.metrics')\n"
+            "         if m in sys.modules]\n"
+            "assert not heavy, f'cold path imported {heavy}'\n"
+            "print('cold path lean')\n"
+        )
+        env = {k: v for k, v in __import__("os").environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, env=env
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "cold path lean" in proc.stdout

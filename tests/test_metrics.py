@@ -479,6 +479,13 @@ class TestTrendSummary:
             _cause_class("not-ready: h1 (KubeletNotReady, NetworkUnavailable: x)")
             == "not-ready (KubeletNotReady)"
         )
+        # '+'-joined adverse lists class consistently whether one or many.
+        assert (
+            _cause_class("not-ready: h1 (DiskPressure+PIDPressure)")
+            == "not-ready (DiskPressure+PIDPressure)"
+        )
+        # A lowercase single-word message is never promoted to a reason.
+        assert _cause_class("not-ready: h1 (unreachable)") == "not-ready"
         # Human mode prints the same roll-up.
         assert cli.main(["--trend", path]) == 0
         out = capsys.readouterr().out

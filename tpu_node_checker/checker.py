@@ -230,12 +230,13 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> dict:
     import os
     import time as _time
 
-    from tpu_node_checker.probe.schema import validate_report as _validate_report
-
     skipped = {"unreadable": 0, "schema": 0, "stale": 0, "future_skew": 0}
     directory = getattr(args, "probe_results", None)
     if not directory:
         return skipped
+    # Behind the early return: probe-less runs must not pay this import on
+    # the cold-start budget.
+    from tpu_node_checker.probe.schema import validate_report as _validate_report
     max_age = getattr(args, "probe_results_max_age", None) or 900.0
     now = _time.time()
     by_name = {n.name: n for n in accel}
@@ -1249,11 +1250,13 @@ def _cause_class(cause: str) -> str:
     if head.startswith("slice "):
         return "slice incomplete"
     if head == "not-ready":
-        # Only a reason-SHAPED token counts (a lone word ending the paren
-        # group or followed by ':'/','): a message-only condition renders
-        # as "(container runtime is down)" and its first word must not
+        # Only a reason-SHAPED token counts: a CamelCase condition name
+        # (KubeletNotReady, NodeStatusUnknown), possibly a '+'-joined
+        # adverse list (DiskPressure+PIDPressure), ending the paren group
+        # or followed by ':'/','.  A message-only condition renders as
+        # "(container runtime is down)" and its first word must not
         # masquerade as a kubelet reason class.
-        m = re.search(r"\((\w+)\s*[:,)]", rest)
+        m = re.search(r"\(([A-Z]\w*(?:\+[A-Z]\w*)*)\s*[:,)]", rest)
         return f"not-ready ({m.group(1)})" if m else "not-ready"
     if head.startswith("expected ≥"):
         return "capacity shortfall"
