@@ -1,0 +1,68 @@
+"""Docs drift guards: the documented surface IS the implemented surface.
+
+Round-3 shipped a "complete README flag table" (commit e474647) with
+nothing keeping it complete: any new argparse flag could land undocumented,
+and a renamed flag would leave the README teaching a spelling that errors.
+Same class of guard as tests/test_dependency_surface.py, pointed at docs.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tpu_node_checker import cli
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _parser_flags() -> set:
+    # The REAL parser's actions — no source regex to fall out of sync.
+    return {
+        opt
+        for action in cli.build_parser()._actions
+        for opt in action.option_strings
+        if opt.startswith("--")
+    }
+
+
+def test_every_cli_flag_is_documented_in_readme():
+    flags = _parser_flags()
+    assert flags, "found no flags — the scan itself broke"
+    readme = (REPO / "README.md").read_text()
+    documented = set(re.findall(r"`(--[a-z][a-z0-9-]*)", readme))
+    missing = flags - documented - {"--help"}
+    assert not missing, (
+        "flags implemented but absent from README.md (add a flag-table row "
+        f"or usage example): {sorted(missing)}"
+    )
+
+
+def test_readme_documents_no_phantom_flags():
+    # The inverse direction: a doc row for a flag that no longer parses
+    # teaches operators a spelling that errors.
+    flags = _parser_flags() | {"--help", "--version"}
+    readme = (REPO / "README.md").read_text()
+    documented = set(re.findall(r"`(--[a-z][a-z0-9-]*)", readme))
+    phantom = documented - flags
+    assert not phantom, f"README documents flags that do not exist: {sorted(phantom)}"
+
+
+def test_probe_md_documents_every_emitted_key():
+    # docs/PROBE.md is the prose twin of probe/schema.py's REPORT_SPEC —
+    # a key the schema types but the reference never mentions is invisible
+    # to operators reading the docs.
+    from tpu_node_checker.probe.schema import REPORT_SPEC
+
+    probe_md = (REPO / "docs" / "PROBE.md").read_text()
+    # Backtick-anchored, as the tables render keys: a bare-substring match
+    # would let `ok` ride inside "soak" and call itself documented.
+    missing = {
+        k
+        for k in REPORT_SPEC
+        if not re.search(rf"`[^`]*\b{re.escape(k)}\b[^`]*`", probe_md)
+    }
+    assert not missing, (
+        f"probe-report keys typed in REPORT_SPEC but absent from docs/PROBE.md: "
+        f"{sorted(missing)}"
+    )

@@ -42,7 +42,10 @@ def _expected_chips(raw: str):
     return (key or None, n)
 
 
-def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+def build_parser() -> argparse.ArgumentParser:
+    """The flag surface, constructible without parsing — validation lives in
+    :func:`parse_args`; tests/test_docs_surface.py walks the real actions to
+    hold README's flag table to this parser."""
     p = argparse.ArgumentParser(
         prog="tpu-node-checker",
         description=(
@@ -222,6 +225,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                        help="delivery retries on connection-reset errors (default 3)")
     slack.add_argument("--slack-retry-delay", type=float, default=30.0,
                        help="seconds between Slack delivery retries (default 30)")
+    return p
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = build_parser()
     args = p.parse_args(argv)
     if args.watch is not None and args.watch <= 0:
         p.error("--watch interval must be a positive number of seconds")
