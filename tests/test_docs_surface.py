@@ -55,12 +55,20 @@ def test_probe_md_documents_every_emitted_key():
     from tpu_node_checker.probe.schema import REPORT_SPEC
 
     probe_md = (REPO / "docs" / "PROBE.md").read_text()
-    # Backtick-anchored, as the tables render keys: a bare-substring match
-    # would let `ok` ride inside "soak" and call itself documented.
+    # Keys must appear INSIDE a code span: extract span contents first —
+    # a paired-backtick regex over the whole document would also match
+    # prose BETWEEN two adjacent spans, and a bare substring would let
+    # `ok` ride inside "soak".
+    # Fenced ``` blocks first (their triple backticks would invert inline
+    # pairing for everything after them), keeping their contents — a key
+    # shown in an example JSON block counts as documented.
+    fences = re.findall(r"```[a-z]*\n(.*?)```", probe_md, re.S)
+    inline_src = re.sub(r"```[a-z]*\n.*?```", "", probe_md, flags=re.S)
+    spans = "\n".join(re.findall(r"`([^`]+)`", inline_src) + fences)
     missing = {
         k
         for k in REPORT_SPEC
-        if not re.search(rf"`[^`]*\b{re.escape(k)}\b[^`]*`", probe_md)
+        if not re.search(rf"\b{re.escape(k)}\b", spans)
     }
     assert not missing, (
         f"probe-report keys typed in REPORT_SPEC but absent from docs/PROBE.md: "

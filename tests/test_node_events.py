@@ -1,0 +1,260 @@
+"""--node-events: the kubectl-describe triage block, pushed not dug for.
+
+Kubelet's Ready condition says *what* (not_ready_reason); the node's Event
+stream often says *why* (OOM kills, disk eviction, network plugin crash
+loops).  Fetched only for sick nodes, capped, never fatal to the round.
+No reference analog: check-gpu-node.py never reads events.
+"""
+
+import json
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, cluster, report
+from tpu_node_checker.checker import (
+    _EVENTS_NODE_CAP,
+    _EVENTS_PER_NODE,
+    _summarize_events,
+)
+from tpu_node_checker.detect import extract_node_info
+
+
+def args_for(*argv):
+    return cli.parse_args(list(argv))
+
+
+def _event(reason, message, typ="Warning", last="2026-07-30T10:00:00Z", count=1):
+    return {
+        "type": typ,
+        "reason": reason,
+        "message": message,
+        "count": count,
+        "lastTimestamp": last,
+    }
+
+
+class TestSummarize:
+    def test_warnings_first_newest_first_capped(self):
+        raw = [
+            _event("A", "old normal", typ="Normal", last="2026-07-30T01:00:00Z"),
+            _event("B", "old warning", last="2026-07-30T02:00:00Z"),
+            _event("C", "new warning", last="2026-07-30T09:00:00Z"),
+            _event("D", "new normal", typ="Normal", last="2026-07-30T08:00:00Z"),
+            _event("E", "mid warning", last="2026-07-30T05:00:00Z"),
+        ]
+        out = _summarize_events(raw)
+        assert len(out) == _EVENTS_PER_NODE
+        assert [e["reason"] for e in out] == ["C", "E", "B"]
+
+    def test_messages_collapse_and_cap_garbage_tolerated(self):
+        raw = [
+            _event("R", "line1\n  line2   line3" + "x" * 500),
+            "not-a-dict",
+            {"type": None, "reason": None, "message": None,
+             "lastTimestamp": 123},  # non-string timestamp folds to ""
+        ]
+        out = _summarize_events(raw)
+        assert "\n" not in out[0]["message"]
+        assert len(out[0]["message"]) <= 200
+        assert out[-1]["last_seen"] == ""
+
+    def test_event_series_and_event_time_fallbacks(self):
+        out = _summarize_events([
+            {"type": "Warning", "reason": "R1", "message": "m",
+             "eventTime": "2026-07-30T03:00:00Z"},
+            {"type": "Warning", "reason": "R2", "message": "m",
+             "series": {"lastObservedTime": "2026-07-30T07:00:00Z"}},
+        ])
+        assert [e["reason"] for e in out] == ["R2", "R1"]
+
+
+class FakeEventsClient:
+    def __init__(self, events_by_node=None, fail_for=()):
+        self.events_by_node = events_by_node or {}
+        self.fail_for = set(fail_for)
+        self.calls = []
+
+    def list_node_events(self, name, timeout=None, limit=20):
+        self.calls.append(name)
+        if name in self.fail_for:
+            raise cluster.ClusterAPIError("HTTP 403: events is forbidden",
+                                          status_code=403)
+        return self.events_by_node.get(name, [])
+
+
+class TestEventPagination:
+    def test_continue_followed_so_newest_events_survive(self):
+        # etcd returns events oldest-first; a crash-looping node with 30+
+        # events must not lose its FRESH tail to a discarded continue token.
+        all_events = [
+            _event(f"R{i}", f"m{i}", last=f"2026-07-30T{i:02d}:00:00Z")
+            for i in range(30)
+        ]
+
+        class PagingSession:
+            headers: dict = {}
+            verify = cert = auth = None
+            calls: list = []
+
+            def get(self, url, params=None, timeout=None):
+                params = dict(params or {})
+                self.calls.append(params)
+                start = int(params.get("continue") or 0)
+                limit = int(params["limit"])
+
+                class R:
+                    status_code = 200
+
+                    def raise_for_status(inner):
+                        pass
+
+                    def json(inner):
+                        doc = {"items": all_events[start:start + limit]}
+                        if start + limit < len(all_events):
+                            doc["metadata"] = {"continue": str(start + limit)}
+                        return doc
+
+                return R()
+
+        cfg = cluster.ClusterConfig(server="https://api:6443")
+        client = cluster.KubeClient(cfg, session=PagingSession())
+        items = client.list_node_events("n1", limit=20)
+        assert len(items) == 30  # both pages
+        newest = checker._summarize_events(items)[0]
+        assert newest["reason"] == "R29"  # the fresh tail survived
+
+
+class TestAttach:
+    def _nodes(self, not_ready=2, total=4):
+        return fx.tpu_v5p_64_slice(not_ready=not_ready)[:total]
+
+    def test_sick_nodes_get_events_healthy_do_not(self, capsys):
+        nodes = self._nodes()
+        client = FakeEventsClient({
+            "gke-tpu-v5p-0": [_event("SystemOOM", "oom-killer invoked")],
+            "gke-tpu-v5p-1": [],
+        })
+        args = args_for("--node-events", "--json")
+        # run_check with injected nodes resolves no live client; inject ours
+        # through the same parameter the cordon path uses.
+        accel, _ = checker.select_accelerator_nodes(nodes)
+        checker._attach_node_events(args, accel, client)
+        assert sorted(client.calls) == ["gke-tpu-v5p-0", "gke-tpu-v5p-1"]
+        by_name = {n.name: n for n in accel}
+        assert by_name["gke-tpu-v5p-0"].events[0]["reason"] == "SystemOOM"
+        assert by_name["gke-tpu-v5p-1"].events == []
+        assert by_name["gke-tpu-v5p-2"].events is None  # healthy: unfetched
+        # And the JSON payload carries them.
+        assert by_name["gke-tpu-v5p-0"].to_dict()["events"][0]["reason"] == "SystemOOM"
+        assert "events" not in by_name["gke-tpu-v5p-2"].to_dict()
+        capsys.readouterr()
+
+    def test_fetch_failure_degrades_to_stderr_not_exit_1(self, capsys):
+        nodes = self._nodes()
+        client = FakeEventsClient(fail_for={"gke-tpu-v5p-0"})
+        accel, _ = checker.select_accelerator_nodes(nodes)
+        checker._attach_node_events(args_for("--node-events"), accel, client)
+        err = capsys.readouterr().err
+        assert "Cannot fetch events for gke-tpu-v5p-0" in err
+        by_name = {n.name: n for n in accel}
+        assert by_name["gke-tpu-v5p-0"].events is None
+        assert by_name["gke-tpu-v5p-1"].events == []  # others still fetched
+
+    def test_fetch_cap_is_visible(self, capsys):
+        nodes = fx.tpu_v5p_64_slice(not_ready=12)
+        client = FakeEventsClient()
+        accel, _ = checker.select_accelerator_nodes(nodes)
+        checker._attach_node_events(args_for("--node-events"), accel, client)
+        assert len(client.calls) == _EVENTS_NODE_CAP
+        assert f"beyond the {_EVENTS_NODE_CAP}-node fetch cap" in (
+            capsys.readouterr().err
+        )
+
+    def test_no_sick_nodes_no_calls(self):
+        client = FakeEventsClient()
+        accel, _ = checker.select_accelerator_nodes(fx.tpu_v5p_64_slice())
+        checker._attach_node_events(args_for("--node-events"), accel, client)
+        assert client.calls == []
+
+
+class TestSurfaces:
+    def test_slack_bullet_carries_top_event(self):
+        info = extract_node_info(
+            fx.make_node(
+                "gke-tpu-00", ready=False,
+                allocatable={"google.com/tpu": "4"},
+                not_ready_reason="KubeletNotReady",
+            )
+        )
+        info.events = _summarize_events(
+            [_event("SystemOOM", "oom-killer invoked on\nprocess foo")]
+        )
+        msg = report.format_slack_message([info], [])
+        assert "last event SystemOOM: oom-killer invoked on process foo" in msg
+
+    def test_flag_guards(self, capsys):
+        for argv in (
+            ["--node-events", "--nodes-json", "/tmp/n.json"],
+            ["--node-events", "--emit-probe", "-"],
+            ["--trend", "f", "--node-events"],
+            ["--selftest", "--node-events"],
+            ["--report-fresh", "f", "--node-events"],
+            ["--calibrate", "2", "--probe-level", "compute", "--node-events"],
+        ):
+            with pytest.raises(SystemExit) as e:
+                cli.parse_args(argv)
+            assert e.value.code == 2, argv
+            capsys.readouterr()
+
+    def test_live_cluster_end_to_end_over_fake_api(self, tmp_path):
+        # Full path: LIST + per-sick-node event fetches over the real
+        # stdlib transport against a fake API server.
+        import urllib.parse
+        from http.server import BaseHTTPRequestHandler
+
+        nodes = fx.tpu_v5p_64_slice(not_ready=1)
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/api/v1/nodes":
+                    doc = fx.node_list(nodes)
+                elif parsed.path == "/api/v1/events":
+                    q = urllib.parse.parse_qs(parsed.query)
+                    sel = q["fieldSelector"][0]
+                    assert "involvedObject.kind=Node" in sel
+                    name = sel.split("involvedObject.name=")[1]
+                    doc = {"items": [_event("SystemOOM", f"oom on {name}")]}
+                else:  # pragma: no cover
+                    doc = {}
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        server = fx.serve_http(Handler)
+        try:
+            kc = tmp_path / "kubeconfig"
+            kc.write_text(
+                "apiVersion: v1\ncurrent-context: c\n"
+                "contexts:\n- name: c\n  context:\n    cluster: cl\n    user: u\n"
+                "clusters:\n- name: cl\n  cluster:\n"
+                f"    server: http://127.0.0.1:{server.server_address[1]}\n"
+                "users:\n- name: u\n  user:\n    token: tok\n"
+            )
+            result = checker.run_check(
+                args_for("--node-events", "--json", "--kubeconfig", str(kc))
+            )
+            sick = [n for n in result.payload["nodes"] if not n["ready"]]
+            assert len(sick) == 1
+            assert sick[0]["events"][0]["reason"] == "SystemOOM"
+            assert "oom on gke-tpu-v5p-0" in sick[0]["events"][0]["message"]
+            healthy = [n for n in result.payload["nodes"] if n["ready"]]
+            assert all("events" not in n for n in healthy)
+        finally:
+            server.shutdown()

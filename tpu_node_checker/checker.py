@@ -320,6 +320,78 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> dict:
     return skipped
 
 
+# --node-events fetch bounds: one bounded call per sick node, few nodes.
+# Past the cap the fetches stop (visibly) — a fleet-wide outage must not
+# turn the checker into an API-server event storm.
+_EVENTS_NODE_CAP = 8
+_EVENTS_PER_NODE = 3
+
+
+def _summarize_events(raw: Sequence) -> list:
+    """Raw Event objects → compact triage entries, Warnings first, newest
+    first, messages whitespace-collapsed and capped."""
+    evs = []
+    for e in raw:
+        if not isinstance(e, dict):
+            continue
+        last = (
+            e.get("lastTimestamp")
+            or e.get("eventTime")
+            or (e.get("series") or {}).get("lastObservedTime")
+            or e.get("firstTimestamp")
+            or ""
+        )
+        evs.append(
+            {
+                "type": e.get("type"),
+                "reason": e.get("reason"),
+                "message": " ".join(str(e.get("message") or "").split())[:200],
+                "count": e.get("count"),
+                "last_seen": last if isinstance(last, str) else "",
+            }
+        )
+    # Warnings outrank Normals; within a class, newest first (RFC-3339
+    # strings sort chronologically).
+    evs.sort(
+        key=lambda v: (v.get("type") == "Warning", v.get("last_seen") or ""),
+        reverse=True,
+    )
+    return evs[:_EVENTS_PER_NODE]
+
+
+def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
+    """``--node-events``: recent k8s Events for SICK nodes.
+
+    The ``kubectl describe node`` triage block, pushed instead of dug for:
+    kubelet's Ready condition says *what* (see ``not_ready_reason``), the
+    event stream often says *why* (OOM kills, disk eviction, network plugin
+    crash loops) — fetched only for nodes that are not effectively ready,
+    capped, and never fatal to the round (an events RBAC gap degrades to a
+    stderr note, not exit 1).  No reference analog: check-gpu-node.py never
+    reads events.
+    """
+    sick = [n for n in accel if not n.effectively_ready]
+    if not sick:
+        return
+    try:
+        client = _resolve_client(args, client)
+    except Exception as exc:  # noqa: BLE001 — triage extra, never fatal
+        print(f"Cannot fetch node events: {exc}", file=sys.stderr)
+        return
+    for n in sick[:_EVENTS_NODE_CAP]:
+        try:
+            n.events = _summarize_events(client.list_node_events(n.name))
+        except Exception as exc:  # noqa: BLE001
+            print(f"Cannot fetch events for {n.name}: {exc}", file=sys.stderr)
+    omitted = len(sick) - _EVENTS_NODE_CAP
+    if omitted > 0:
+        print(
+            f"--node-events: {omitted} more sick node(s) beyond the "
+            f"{_EVENTS_NODE_CAP}-node fetch cap",
+            file=sys.stderr,
+        )
+
+
 def _resolve_client(args, client):
     """Reuse the LIST call's client; offline runs resolve one on demand."""
     if client is not None:
@@ -520,6 +592,10 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
         with timer.phase("probe"):
             _run_probe(args, accel, result, slices)
     reports_skipped = _attach_probe_results(args, accel)
+
+    if getattr(args, "node_events", False):
+        with timer.phase("events"):
+            _attach_node_events(args, accel, kube_client)
 
     # Effective readiness: kubelet Ready minus unschedulable/probe-failed hosts.
     effective_ready = [n for n in ready if n.effectively_ready]

@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--strict-slices", action="store_true",
                    help="exit 3 if any multi-host TPU slice is incomplete")
+    p.add_argument("--node-events", action="store_true",
+                   help="fetch recent k8s Events for sick nodes (the kubectl-"
+                   "describe triage block: OOM kills, evictions, plugin crash "
+                   "loops) into the JSON payload and Slack bullets; capped "
+                   "fetches, needs 'events: list' RBAC, live cluster only")
     p.add_argument("--multislice-label", action="append", metavar="KEY",
                    help="node label key that groups slices into a DCN-joined "
                    "multislice (repeatable; checked before the built-in "
@@ -241,6 +246,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--probe-results-required requires --probe-results DIR")
     if args.trend and (
         args.emit_probe
+        or args.node_events
         or args.probe
         or args.watch is not None
         or args.probe_results
@@ -259,6 +265,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--trend runs alone (only --json may accompany it)")
     if args.selftest and (
         args.emit_probe
+        or args.node_events
         or args.probe
         or args.watch is not None
         or args.probe_results
@@ -287,6 +294,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     if args.calibrate is not None:
         if (
             args.emit_probe
+            or args.node_events
             or args.probe
             or args.watch is not None
             or args.probe_results
@@ -330,6 +338,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             p.error("--calibrate-margin requires --calibrate")
     if args.report_fresh and (
         args.emit_probe
+        or args.node_events
         or args.probe
         or args.watch is not None
         or args.probe_results
@@ -367,6 +376,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                 # (it sees the fleet; a per-host pod would page per chip).
                 # Accepting the flag would silently alert nobody.
                 p.error(f"{flag} cannot be combined with --emit-probe")
+    if args.node_events:
+        if args.nodes_json:
+            # Offline fixtures have no event stream; silently fetching
+            # nothing would let an operator believe triage ran.
+            p.error("--node-events requires a live cluster (not --nodes-json)")
+        if args.emit_probe:
+            p.error("--node-events cannot be combined with --emit-probe")
     if args.cordon_max is not None and args.cordon_max < 1:
         p.error("--cordon-max must be at least 1")
     if args.cordon_max is not None and not args.cordon_failed:

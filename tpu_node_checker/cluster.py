@@ -468,6 +468,44 @@ class KubeClient:
                 raise
         raise AssertionError("unreachable")  # pragma: no cover
 
+    def list_node_events(
+        self, name: str, timeout: float = DEFAULT_TIMEOUT_S, limit: int = 20
+    ) -> List[dict]:
+        """Recent Events for one Node object — the ``kubectl describe node``
+        triage block, fetched only for sick nodes under ``--node-events``.
+
+        ``GET /api/v1/events`` with a server-side fieldSelector (Node events
+        live in the ``default`` namespace but the cluster-scoped list with
+        ``involvedObject`` filtering covers every writer), paged in
+        ``limit``-sized chunks.  The continue token IS followed (etcd
+        returns events oldest-first, so stopping at page one would keep a
+        week-old Normal and drop the fresh SystemOOM that explains the
+        outage) but bounded to a few pages — triage wants the recent tail,
+        never an unbounded dump.  Needs ``events: list`` RBAC
+        (deploy/rbac.yaml).
+        """
+        params = {
+            "fieldSelector": (
+                f"involvedObject.kind=Node,involvedObject.name={name}"
+            ),
+            "limit": str(limit),
+        }
+        items: List[dict] = []
+        for _ in range(5):  # 5 × limit events is past any sane TTL'd stream
+            resp = self._session.get(
+                f"{self.config.server}/api/v1/events",
+                params=params,
+                timeout=timeout,
+            )
+            resp.raise_for_status()
+            doc = resp.json()
+            items.extend(doc.get("items") or [])
+            cont = (doc.get("metadata") or {}).get("continue")
+            if not cont:
+                break
+            params = dict(params, **{"continue": cont})
+        return items
+
     def cordon_node(self, name: str, timeout: float = DEFAULT_TIMEOUT_S) -> None:
         """``PATCH /api/v1/nodes/{name}`` → ``spec.unschedulable=true``.
 

@@ -270,6 +270,10 @@ class NodeInfo:
     adverse_conditions: Tuple[str, ...] = ()
     # Data-plane probe result, attached later by the probe layer (None = not probed):
     probe: Optional[dict] = None
+    # Recent k8s Events for SICK nodes, attached by --node-events (None =
+    # not fetched): [{type, reason, message, count, last_seen}], newest
+    # first — the `kubectl describe node` triage block, pushed not dug for.
+    events: Optional[list] = None
 
     @property
     def is_tpu(self) -> bool:
@@ -357,6 +361,8 @@ class NodeInfo:
             }
         if self.probe is not None:
             d["probe"] = self.probe
+        if self.events is not None:
+            d["events"] = list(self.events)
         return d
 
 

@@ -269,6 +269,17 @@ def format_slack_message(
             # reason (KubeletNotReady vs NetworkUnavailable vs
             # NodeStatusUnknown) routes the response differently.
             line += f" — {n.why_not_ready}"
+        if n.events:
+            # --node-events attached the kubectl-describe triage block;
+            # surface the top (Warnings-first, newest-first) entry.
+            ev = n.events[0]
+            # Already whitespace-collapsed and capped by _summarize_events;
+            # only Slack's tighter width applies here.
+            msg = str(ev.get("message") or "")
+            line += (
+                f" — last event {ev.get('reason')}"
+                + (f": {msg[:90]}{'…' if len(msg) > 90 else ''}" if msg else "")
+            )
         if n.probe is not None and not n.probe.get("ok"):
             # "Failed HOW" is the first question on every alert; the error
             # is truncated so a mass outage still fits Slack's limits.
