@@ -171,6 +171,31 @@ class TestAttach:
             capsys.readouterr().err
         )
 
+    def test_unplanned_faults_outrank_maintenance_for_the_cap(self):
+        # A rolling drain of >= cap cordoned-by-maintenance nodes must not
+        # starve the one genuinely faulted node of its event fetch.
+        drain_taint = [{
+            "key": "cloud.google.com/impending-node-termination",
+            "value": "1", "effect": "NoSchedule",
+        }]
+        nodes = [
+            fx.make_node(
+                f"drained-{i}", ready=False,
+                allocatable={"google.com/tpu": "4"}, taints=drain_taint,
+            )
+            for i in range(checker._EVENTS_NODE_CAP)
+        ] + [
+            fx.make_node(
+                "faulted-0", ready=False,
+                allocatable={"google.com/tpu": "4"},
+                not_ready_reason="KubeletNotReady",
+            )
+        ]
+        client = FakeEventsClient()
+        accel, _ = checker.select_accelerator_nodes(nodes)
+        checker._attach_node_events(args_for("--node-events"), accel, client)
+        assert "faulted-0" in client.calls
+
     def test_no_sick_nodes_no_calls(self):
         client = FakeEventsClient()
         accel, _ = checker.select_accelerator_nodes(fx.tpu_v5p_64_slice())

@@ -190,6 +190,31 @@ class TestValidateReport:
         assert doc["properties"]["memory"]["items"]["properties"]["bytes_limit"]
 
 
+class TestSchemaCliExport:
+    def test_prints_the_document_and_runs_alone(self, capsys):
+        import pytest
+
+        assert cli.main(["--probe-report-schema"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["$schema"].startswith("https://json-schema.org/")
+        assert set(doc["properties"]) == set(REPORT_SPEC)
+        for argv in (
+            ["--probe-report-schema", "--json"],
+            ["--probe-report-schema", "--probe"],
+            ["--probe-report-schema", "--watch", "5"],
+            ["--probe-report-schema", "--slack-webhook", "https://x"],
+            # Caught via parser defaults, not a hand-kept name list: a
+            # zero value and an explicitly-set truthy-default flag both
+            # differ from their defaults.
+            ["--probe-report-schema", "--probe-timeout", "0"],
+            ["--probe-report-schema", "--slack-retry-count", "5"],
+        ):
+            with pytest.raises(SystemExit) as e:
+                cli.parse_args(argv)
+            assert e.value.code == 2, argv
+            capsys.readouterr()
+
+
 class TestAggregatorRefusal:
     def _write_report(self, directory, hostname, **overrides):
         doc = {

@@ -373,6 +373,11 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
     sick = [n for n in accel if not n.effectively_ready]
     if not sick:
         return
+    # Unplanned faults outrank maintenance drains for the fetch budget: a
+    # rolling drain of 8+ cordoned nodes must not starve the one genuinely
+    # faulted node of the triage this flag exists for (stable sort keeps
+    # cluster order within each class).
+    sick.sort(key=lambda n: n.sickness_planned)
     try:
         client = _resolve_client(args, client)
     except Exception as exc:  # noqa: BLE001 — triage extra, never fatal

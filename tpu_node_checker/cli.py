@@ -197,6 +197,11 @@ def build_parser() -> argparse.ArgumentParser:
     probe.add_argument("--calibrate-out", metavar="FILE",
                        help="with --calibrate: write the JSON to FILE (atomic) "
                        "instead of stdout")
+    probe.add_argument("--probe-report-schema", action="store_true",
+                       help="print the probe report's formal JSON Schema "
+                       "(draft 2020-12) to stdout and exit — for external "
+                       "consumers validating --emit-probe output (the checker "
+                       "itself validates with the same spec); runs alone")
 
     cordon = p.add_argument_group("Auto-quarantine (data-plane failures)")
     cordon.add_argument("--cordon-failed", action="store_true",
@@ -236,6 +241,16 @@ def build_parser() -> argparse.ArgumentParser:
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p = build_parser()
     args = p.parse_args(argv)
+    if args.probe_report_schema and any(
+        v != p.get_default(k)
+        for k, v in vars(args).items()
+        if k != "probe_report_schema"
+    ):
+        # Pure-output mode: anything riding along would silently not run.
+        # Compared against the parser's OWN defaults, so zero-valued flags
+        # are caught and a future truthy-default flag cannot break the
+        # bare invocation.
+        p.error("--probe-report-schema runs alone")
     if args.watch is not None and args.watch <= 0:
         p.error("--watch interval must be a positive number of seconds")
     if args.metrics_port is not None and args.watch is None:
@@ -432,6 +447,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             return checker.selftest(args)
         if getattr(args, "calibrate", None) is not None:
             return checker.calibrate(args)
+        if getattr(args, "probe_report_schema", False):
+            import json as _json
+
+            from tpu_node_checker.probe.schema import as_json_schema
+
+            print(_json.dumps(as_json_schema(), indent=2))
+            return checker.EXIT_OK
         if getattr(args, "report_fresh", None):
             return checker.report_fresh(
                 args.report_fresh, args.probe_results_max_age

@@ -26,6 +26,7 @@ import base64
 import json
 import os
 import subprocess
+import sys
 import tempfile
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
@@ -409,6 +410,48 @@ class KubeClient:
     # in ~10 bounded bodies instead of one multi-hundred-MB response.
     LIST_PAGE_LIMIT = 500
 
+    def _paged_list(
+        self, path: str, params: dict, timeout: float, max_pages: int
+    ) -> Tuple[List[dict], Optional[str]]:
+        """Follow ``limit``/``continue`` for one GET list — the single
+        pagination walk both node and event LISTs share.
+
+        Returns ``(items, leftover_continue)``: ``leftover_continue`` is
+        non-None iff ``max_pages`` was exhausted with the token still set
+        (the caller decides whether that is fatal or a stderr note).  A 410
+        Gone mid-walk (expired snapshot; status read from either the stdlib
+        ClusterAPIError or a drop-in requests.HTTPError) restarts the walk
+        from scratch once.
+        """
+        for attempt in (0, 1):
+            page_params = dict(params)
+            items: List[dict] = []
+            try:
+                for _ in range(max_pages):
+                    resp = self._session.get(
+                        f"{self.config.server}{path}",
+                        params=page_params,
+                        timeout=timeout,
+                    )
+                    resp.raise_for_status()
+                    doc = resp.json()
+                    items.extend(doc.get("items") or [])
+                    cont = (doc.get("metadata") or {}).get("continue")
+                    if not cont:
+                        return items, None
+                    page_params = dict(page_params, **{"continue": cont})
+                return items, page_params.get("continue")
+            except Exception as exc:  # noqa: BLE001 — re-raised unless 410
+                status = getattr(exc, "status_code", None)
+                if status is None:
+                    status = getattr(
+                        getattr(exc, "response", None), "status_code", None
+                    )
+                if attempt == 0 and status == 410 and page_params.get("continue"):
+                    continue  # expired token: one clean restart
+                raise
+        raise AssertionError("unreachable")  # pragma: no cover
+
     def list_nodes(
         self,
         label_selector: Optional[str] = None,
@@ -425,48 +468,24 @@ class KubeClient:
         the API server compacted the snapshot under a slow walk) restarts the
         LIST from scratch once rather than failing the round.
         """
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if page_limit:
+            params["limit"] = str(page_limit)
         # Bound the walk: per-request timeouts never bound a server that
         # keeps 200-ing with a non-advancing continue token.  1000 pages =
         # half a million nodes at the default page size — far past any real
         # cluster, so hitting the cap is a broken server, graded exit 1.
-        max_pages = 1000
-        for attempt in (0, 1):
-            params = {}
-            if label_selector:
-                params["labelSelector"] = label_selector
-            if page_limit:
-                params["limit"] = str(page_limit)
-            items: List[dict] = []
-            try:
-                for _ in range(max_pages):
-                    resp = self._session.get(
-                        f"{self.config.server}/api/v1/nodes",
-                        params=params,
-                        timeout=timeout,
-                    )
-                    resp.raise_for_status()
-                    doc = resp.json()
-                    items.extend(doc.get("items") or [])
-                    cont = (doc.get("metadata") or {}).get("continue")
-                    if not cont:
-                        return items
-                    params = dict(params, **{"continue": cont})
-                raise ClusterAPIError(
-                    f"LIST /api/v1/nodes did not terminate within {max_pages} "
-                    "pages (non-advancing continue token?)"
-                )
-            except Exception as exc:  # noqa: BLE001 — re-raised unless 410
-                # A drop-in requests.Session raises requests.HTTPError, not
-                # ClusterAPIError — read the status from whichever shape.
-                status = getattr(exc, "status_code", None)
-                if status is None:
-                    status = getattr(
-                        getattr(exc, "response", None), "status_code", None
-                    )
-                if attempt == 0 and status == 410 and params.get("continue"):
-                    continue  # expired token: one clean restart
-                raise
-        raise AssertionError("unreachable")  # pragma: no cover
+        items, leftover = self._paged_list(
+            "/api/v1/nodes", params, timeout, max_pages=1000
+        )
+        if leftover:
+            raise ClusterAPIError(
+                "LIST /api/v1/nodes did not terminate within 1000 pages "
+                "(non-advancing continue token?)"
+            )
+        return items
 
     def list_node_events(
         self, name: str, timeout: float = DEFAULT_TIMEOUT_S, limit: int = 20
@@ -477,11 +496,14 @@ class KubeClient:
         ``GET /api/v1/events`` with a server-side fieldSelector (Node events
         live in the ``default`` namespace but the cluster-scoped list with
         ``involvedObject`` filtering covers every writer), paged in
-        ``limit``-sized chunks.  The continue token IS followed (etcd
-        returns events oldest-first, so stopping at page one would keep a
-        week-old Normal and drop the fresh SystemOOM that explains the
-        outage) but bounded to a few pages — triage wants the recent tail,
-        never an unbounded dump.  Needs ``events: list`` RBAC
+        ``limit``-sized chunks through the same walk the node LIST uses
+        (410-restart included).  The continue token IS followed to the end
+        whenever possible: etcd returns events oldest-first, so abandoning
+        the walk early would keep a week-old Normal and drop the fresh
+        SystemOOM that explains the outage.  50 pages (1000 events at the
+        default limit) is far past any TTL'd per-node stream; past it the
+        shortfall is NOTED on stderr — the newest tail may be missing, and
+        pretending otherwise would be worse.  Needs ``events: list`` RBAC
         (deploy/rbac.yaml).
         """
         params = {
@@ -490,20 +512,15 @@ class KubeClient:
             ),
             "limit": str(limit),
         }
-        items: List[dict] = []
-        for _ in range(5):  # 5 × limit events is past any sane TTL'd stream
-            resp = self._session.get(
-                f"{self.config.server}/api/v1/events",
-                params=params,
-                timeout=timeout,
+        items, leftover = self._paged_list(
+            "/api/v1/events", params, timeout, max_pages=50
+        )
+        if leftover:
+            print(
+                f"node {name}: event list exceeded 50 pages; the newest "
+                "events may be missing from triage",
+                file=sys.stderr,
             )
-            resp.raise_for_status()
-            doc = resp.json()
-            items.extend(doc.get("items") or [])
-            cont = (doc.get("metadata") or {}).get("continue")
-            if not cont:
-                break
-            params = dict(params, **{"continue": cont})
         return items
 
     def cordon_node(self, name: str, timeout: float = DEFAULT_TIMEOUT_S) -> None:
