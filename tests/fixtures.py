@@ -231,6 +231,37 @@ def serve_http(handler_cls):
     return server
 
 
+def json_value_strategy(
+    text_size: int = 20,
+    max_leaves: int = 12,
+    allow_nan: bool = True,
+    allow_infinity: bool = True,
+):
+    """One recursive JSON-ish-value hypothesis strategy for every fuzz
+    surface (detect totality, report-schema validator, trend reader) —
+    three hand-rolled near-copies previously drifted on float/NaN knobs.
+    ``allow_nan=False, allow_infinity=False`` yields values that survive a
+    strict ``json.dumps`` round-trip.  Lazy import: fixtures is also
+    consumed by bench.py, which must not require hypothesis."""
+    from hypothesis import strategies as st
+
+    scalars = st.one_of(
+        st.none(),
+        st.booleans(),
+        st.integers(min_value=-(10**18), max_value=10**18),
+        st.floats(allow_nan=allow_nan, allow_infinity=allow_infinity),
+        st.text(max_size=text_size),
+    )
+    return st.recursive(
+        scalars,
+        lambda children: st.one_of(
+            st.lists(children, max_size=4),
+            st.dictionaries(st.text(max_size=text_size), children, max_size=4),
+        ),
+        max_leaves=max_leaves,
+    )
+
+
 def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = None):
     """Handler class serving ``nodes`` as a NodeList with ``limit``/
     ``continue`` pagination — the single definition of the fake API

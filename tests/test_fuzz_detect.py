@@ -16,6 +16,7 @@ from tpu_node_checker.detect import (
     is_ready,
     select_accelerator_nodes,
 )
+from tests import fixtures as fx
 from tpu_node_checker.utils.quantity import parse_quantity
 
 # JSON-ish scalars that could appear anywhere in a node object.
@@ -49,14 +50,7 @@ label_values = st.one_of(
     scalars, st.sampled_from(("2x2x1", "16x16", "8x", "x", "0x4", "tpu-v5e-pool"))
 )
 
-json_values = st.recursive(
-    scalars,
-    lambda children: st.one_of(
-        st.lists(children, max_size=4),
-        st.dictionaries(st.text(max_size=20), children, max_size=4),
-    ),
-    max_leaves=20,
-)
+json_values = fx.json_value_strategy(text_size=20, max_leaves=20)
 
 # Node-shaped but with garbage in every slot.
 node_like = st.fixed_dictionaries(

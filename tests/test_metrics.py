@@ -3,6 +3,8 @@
 import json
 import urllib.request
 
+import pytest
+
 from tests import fixtures as fx
 from tpu_node_checker import checker, cli
 from tpu_node_checker.detect import chips_per_host_from_instance_type, extract_node_info, group_slices
@@ -490,6 +492,65 @@ class TestTrendSummary:
         assert cli.main(["--trend", path]) == 0
         out = capsys.readouterr().out
         assert "top causes: slice incomplete ×2" in out
+
+    def test_trend_over_emitter_round_log(self, tmp_path, capsys):
+        # The emitter loop's --log-jsonl shape is --trend-compatible: a
+        # DaemonSet pod's own probe history trends like an aggregator's.
+        t0 = 1_700_000_000
+        entries = [
+            {"ts": t0, "exit_code": 0, "probe_ok": True,
+             "probe_level": "compute", "duration_ms": 900.0},
+            {"ts": t0 + 300, "exit_code": 3, "probe_ok": False,
+             "probe_level": "compute", "duration_ms": 950.0,
+             "causes": ["probe-failed: h1 (matmul mismatch)"]},
+            {"ts": t0 + 600, "exit_code": 0, "probe_ok": True,
+             "probe_level": "compute", "duration_ms": 910.0},
+        ]
+        path = self._log(tmp_path, entries)
+        assert cli.main(["--trend", path, "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["availability_pct"] == pytest.approx(66.67, abs=0.01)
+        assert s["top_causes"] == [{"cause": "probe-failed", "rounds": 1}]
+        assert s["transitions"][0]["causes"] == [
+            "probe-failed: h1 (matmul mismatch)"
+        ]
+
+    def test_fuzz_trend_reader_is_total(self, tmp_path, capsys):
+        # The trend log is operator-writable (and crash-appendable): ANY
+        # file content must yield exit 0 (usable rounds exist) or exit 1 —
+        # never a traceback that sinks post-incident analysis.
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        # NaN/inf stay ON: json round-trips them (NaN/Infinity) and the
+        # reader must skip such lines, not crash the UTC formatter.
+        json_vals = fx.json_value_strategy(text_size=8, max_leaves=8)
+        entry_ish = st.one_of(
+            json_vals,
+            st.fixed_dictionaries(
+                {},
+                optional={
+                    "ts": json_vals, "exit_code": json_vals,
+                    "causes": json_vals, "planned": json_vals,
+                    "total_chips": json_vals, "ready_chips": json_vals,
+                    "slices": json_vals, "slices_complete": json_vals,
+                    "error": json_vals,
+                },
+            ),
+        )
+
+        @settings(max_examples=60, deadline=None)
+        @given(st.lists(entry_ish, max_size=6), st.booleans())
+        def run(entries, json_mode):
+            path = tmp_path / "fuzz.jsonl"
+            path.write_text(
+                "".join(json.dumps(e) + "\n" for e in entries) + "{not json\n"
+            )
+            rc = checker.trend_summary(str(path), json_mode=json_mode)
+            assert rc in (0, 1)
+            capsys.readouterr()
+
+        run()
 
     def test_monitor_error_transition_carries_error(self, tmp_path, capsys):
         t0 = 1_700_000_000

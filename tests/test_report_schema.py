@@ -114,13 +114,7 @@ class TestValidateReport:
         from hypothesis import given, settings
         from hypothesis import strategies as st
 
-        json_vals = st.recursive(
-            st.none() | st.booleans() | st.integers() | st.floats()
-            | st.text(max_size=8),
-            lambda inner: st.lists(inner, max_size=4)
-            | st.dictionaries(st.text(max_size=8), inner, max_size=4),
-            max_leaves=12,
-        )
+        json_vals = fx.json_value_strategy(text_size=8, max_leaves=12)
         spec_keys = st.dictionaries(
             st.sampled_from(sorted(REPORT_SPEC)), json_vals, max_size=6
         )

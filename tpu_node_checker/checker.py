@@ -1370,8 +1370,16 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
             continue
         try:
             e = json.loads(line)
-            rounds.append((float(e["ts"]), int(e["exit_code"]), e))
-        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            ts = float(e["ts"])
+            if not math.isfinite(ts):
+                # NaN/inf ts would poison interval math and crash the UTC
+                # formatter downstream.
+                raise ValueError(f"non-finite ts {ts!r}")
+            rounds.append((ts, int(e["exit_code"]), e))
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError,
+                OverflowError):
+            # OverflowError: json round-trips Infinity, and int(inf) raises
+            # it — a malformed line must be SKIPPED, never sink the analysis.
             skipped += 1
     if not rounds:
         print(f"trend log {path} has no usable rounds", file=sys.stderr)
