@@ -216,19 +216,64 @@ def node_list(items: List[dict]) -> dict:
     return {"kind": "NodeList", "apiVersion": "v1", "items": items}
 
 
-def serve_http(handler_cls):
-    """Silenced, daemon-threaded HTTPServer on an ephemeral port.
+def serve_http(handler_cls, tls_cert=None):
+    """Silenced, daemon-threaded HTTP(S) server on an ephemeral port.
 
     Shared by every fixture that plays an HTTP endpoint (fake API server,
     probe-report webhooks); the caller defines behavior in ``handler_cls``
     and owns shutdown (``server.shutdown()``).
+
+    Threaded (one handler thread per CONNECTION), because the checker's
+    transport keeps sockets alive: a single-threaded server would sit in
+    one connection's keep-alive read loop and never accept the next dial.
+    The server counts accepted connections in ``server.connections_opened``
+    — the ground truth the pool-reuse tests and bench assert against.
+    ``tls_cert`` = ``(certfile, keyfile)`` wraps the listener in TLS.
     """
     import threading
-    from http.server import HTTPServer
+    from http.server import ThreadingHTTPServer
 
-    server = HTTPServer(("127.0.0.1", 0), handler_cls)
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+        connections_opened = 0
+
+        def get_request(self):
+            request = super().get_request()
+            self.connections_opened += 1  # accept() is serialized: no race
+            return request
+
+    server = Server(("127.0.0.1", 0), handler_cls)
+    if tls_cert is not None:
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls_cert[0], tls_cert[1])
+        server.socket = ctx.wrap_socket(server.socket, server_side=True)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server
+
+
+def self_signed_cert(tmpdir: str):
+    """127.0.0.1 cert via the openssl CLI; ``None`` where openssl is absent
+    (TLS-dependent fixtures then skip).  Shared with bench.py."""
+    import os
+    import subprocess
+
+    cert = os.path.join(tmpdir, "cert.pem")
+    key = os.path.join(tmpdir, "key.pem")
+    try:
+        proc = subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+                "-subj", "/CN=127.0.0.1",
+                "-addext", "subjectAltName=IP:127.0.0.1",
+            ],
+            capture_output=True,
+        )
+    except OSError:
+        return None
+    return (cert, key) if proc.returncode == 0 else None
 
 
 def json_value_strategy(
@@ -273,6 +318,11 @@ def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = No
     from urllib.parse import parse_qs, urlparse
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 so the checker's keep-alive pool can actually reuse the
+        # connection across pages (1.0 closes per request); every response
+        # carries Content-Length, which 1.1 keep-alive requires.
+        protocol_version = "HTTP/1.1"
+
         def do_GET(self):
             q = parse_qs(urlparse(self.path).query)
             limit = int(q.get("limit", [str(len(nodes) or 1)])[0])

@@ -149,6 +149,9 @@ class TestPrecedence:
     def test_credential_temp_files_registered_for_cleanup(self, tmp_path, monkeypatch):
         cleaned = []
         monkeypatch.setattr(cluster.atexit, "register", lambda fn, *a: cleaned.append(a))
+        # Materialization is content-addressed (cache-key stability for the
+        # keep-alive client cache); start clean so THIS load registers.
+        monkeypatch.setattr(cluster, "_MATERIALIZED", {})
         key = base64.b64encode(b"KEY").decode()
         crt = base64.b64encode(b"CRT").decode()
         cfg = cluster.load_kubeconfig(
@@ -444,28 +447,35 @@ class TestStdlibSession:
         # Exactly one request reached the server — nothing was re-sent.
         assert len(seen) == 1
 
-    def test_tls_opener_built_once_and_http_skips_tls(self):
+    def test_tls_context_cached_and_http_never_builds_one(self, http_server):
         s = cluster._StdlibSession()
-        assert s._get_opener(True) is s._get_opener(True)
-        # Plain-http opener must not build an SSL context at all (the system
-        # CA load costs ~20 ms — a per-check tax http endpoints must not pay).
+        assert s._context() is s._context()  # built once, cached
+        # A plain-http request must not build an SSL context at all (the
+        # system CA load costs ~20 ms — a per-check tax http endpoints must
+        # not pay).  Pinned against the NEW pooled transport.
+        base, _ = http_server
+        s2 = cluster._StdlibSession()
         calls = []
-        orig = s._context
-        s._context = lambda: calls.append(1) or orig()
-        s._get_opener(False)
+        orig = s2._context
+        s2._context = lambda: calls.append(1) or orig()
+        s2.get(f"{base}/x", timeout=5).raise_for_status()
         assert calls == []
+        assert s2._ssl_ctx is None
 
-    def test_uppercase_scheme_uses_real_tls_opener(self, http_server):
-        # RFC 3986: the scheme is case-insensitive.  "HTTPS://…" must route
-        # to the CA-loaded opener, not the bare fail-closed one — and
-        # "HTTP://…" must still work against a plain server.
+    def test_uppercase_scheme_is_case_insensitive(self, http_server):
+        # RFC 3986: the scheme is case-insensitive.  "HTTP://…" must work
+        # against a plain server (and "HTTPS://…" would select the TLS
+        # connection class, same as lowercase).
         base, seen = http_server
         s = cluster._StdlibSession()
         resp = s.get(base.replace("http://", "HTTP://") + "/x", timeout=5)
         resp.raise_for_status()
-        built = s._get_opener(True)
-        # The https-keyed opener is the _context()-built one (sanity).
-        assert s._get_opener(True) is built
+        assert seen[0]["path"] == "/x"
+
+    def test_unsupported_scheme_rejected(self):
+        s = cluster._StdlibSession()
+        with pytest.raises(cluster.ClusterAPIError, match="scheme"):
+            s.get("ftp://127.0.0.1/x", timeout=5)
 
     def test_kube_client_defaults_to_stdlib_session(self):
         cfg = cluster.ClusterConfig(server="https://api:6443", token="t")

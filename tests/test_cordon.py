@@ -127,6 +127,9 @@ class TestCordonFailed:
         payload = json.loads(capsys.readouterr().out)
         assert payload["cordon"]["cordoned"] == ["tpu-1"]
         assert payload["cordon"]["dry_run"] is False
+        # Offline node source, live PATCH traffic: the round's transport
+        # telemetry must still surface (the on-demand resolved client).
+        assert payload["api_transport"]["requests_sent"] >= 1
 
     def test_cap_limits_cordons_and_reports_rest(self, tmp_path, fake_api, capsys):
         nodes = _tpu_nodes(3)

@@ -218,6 +218,33 @@ class TestSurfaces:
         msg = report.format_slack_message([info], [])
         assert "last event SystemOOM: oom-killer invoked on process foo" in msg
 
+    def test_reasonless_event_falls_back_to_type_never_none(self):
+        # ADVICE r5: reason is optional on Events (only type/message are
+        # near-universal) — the bullet must fall back to the type, or drop
+        # the fragment, never render a literal "last event None".
+        def bullet(events):
+            info = extract_node_info(
+                fx.make_node(
+                    "gke-tpu-00", ready=False,
+                    allocatable={"google.com/tpu": "4"},
+                )
+            )
+            info.events = _summarize_events(events)
+            return report.format_slack_message([info], [])
+
+        msg = bullet([{"type": "Warning", "message": "disk is on fire",
+                       "lastTimestamp": "2026-07-30T10:00:00Z"}])
+        assert "last event Warning: disk is on fire" in msg
+        assert "None" not in msg
+        # No reason, no type, message only: label-less fragment.
+        msg = bullet([{"message": "anonymous writer",
+                       "lastTimestamp": "2026-07-30T10:00:00Z"}])
+        assert "last event: anonymous writer" in msg
+        assert "None" not in msg
+        # Nothing usable at all: the fragment is dropped entirely.
+        msg = bullet([{"lastTimestamp": "2026-07-30T10:00:00Z"}])
+        assert "last event" not in msg
+
     def test_flag_guards(self, capsys):
         for argv in (
             ["--node-events", "--nodes-json", "/tmp/n.json"],

@@ -163,6 +163,30 @@ class TestValidateReport:
         (violation,) = validate_report(dict(MINIMAL, ici_axis_ok=[True]))
         assert violation.startswith("ici_axis_ok:")
 
+    def test_crashed_collective_leg_nulls_still_conform(self):
+        # ADVICE r5 high: a CRASHED collective probe (details=None in
+        # parallel/collectives.py) emits {psum_ok: None, all_gather_ok:
+        # None, reduce_scatter_ok: None} — the exact shape liveness.py
+        # builds via (coll.details or {}).get(k).  Bool-only value specs
+        # rejected the whole report, and the host silently graded HEALTHY.
+        crashed = dict(
+            MINIMAL, ok=False, level="collective",
+            collective_ok=False,
+            collective_err="RuntimeError: collective probe crashed",
+            collective_legs_ok={
+                "psum_ok": None, "all_gather_ok": None, "reduce_scatter_ok": None,
+            },
+        )
+        assert validate_report(crashed) == []
+        # Populated verdicts still conform — and still drift-check.
+        assert validate_report(
+            dict(MINIMAL, collective_legs_ok={"psum_ok": True, "all_gather_ok": False})
+        ) == []
+        (violation,) = validate_report(
+            dict(MINIMAL, collective_legs_ok={"psum_ok": "yes"})
+        )
+        assert violation.startswith("collective_legs_ok.psum_ok:")
+
     def test_strict_mode_off_spellings(self, monkeypatch):
         # An exported TNC_SCHEMA_STRICT=0 selects the documented warn-only
         # production behavior — it must not read as "strict".

@@ -1,0 +1,624 @@
+"""Keep-alive pool + bounded fan-out: the transport-layer perf contract.
+
+BENCH_r05 showed the transport as the bottleneck: every LIST page, watch
+round, events fetch and cordon PATCH paid a fresh TCP(+TLS) handshake.
+These tests pin the pooled ``_StdlibSession`` replacement:
+
+* an N-page paged LIST reuses ONE connection (the fixture server counts
+  accepted connections — ground truth, not client bookkeeping);
+* a keep-alive socket the server quietly closed redials exactly once on an
+  idempotent GET, and the redial's failure PROPAGATES (no retry loop);
+* PATCH is never blind-retried after a socket death (it may have applied);
+* the security posture survives the rewrite: redirects refused,
+  Authorization never re-sent, plain-http never loads the CA store;
+* the per-node fan-outs (``--node-events``, cordon) complete in
+  ~max(single call), not sum, with deterministic result ordering.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, cluster
+from tpu_node_checker.utils.fanout import bounded_map
+
+
+def args_for(*argv):
+    return cli.parse_args(list(argv))
+
+
+class TestPoolReuse:
+    def test_eleven_page_list_reuses_one_connection(self):
+        # 110 nodes / page_limit 10 = 11 pages — the 5k-node walk's shape.
+        nodes = fx.cpu_only_cluster(110)
+        seen: list = []
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes, seen))
+        try:
+            cfg = cluster.ClusterConfig(
+                server=f"http://127.0.0.1:{server.server_address[1]}"
+            )
+            client = cluster.KubeClient(cfg)
+            got = client.list_nodes(page_limit=10)
+            assert len(got) == 110
+            assert len(seen) == 11
+            assert server.connections_opened == 1  # one dial, 11 requests
+            stats = client.transport_stats()
+            assert stats["connections_opened"] == 1
+            assert stats["requests_sent"] == 11
+            assert stats["requests_reused"] == 10
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_keep_alive_disabled_dials_per_request(self):
+        # The "before" behavior, kept dialable for the bench's honest
+        # comparison: keep_alive=False pays one connection per page.
+        nodes = fx.cpu_only_cluster(50)
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes))
+        try:
+            cfg = cluster.ClusterConfig(
+                server=f"http://127.0.0.1:{server.server_address[1]}"
+            )
+            session = cluster._StdlibSession(keep_alive=False)
+            client = cluster.KubeClient(cfg, session=session)
+            got = client.list_nodes(page_limit=10)
+            assert len(got) == 50
+            assert server.connections_opened == 5
+            assert session.requests_reused == 0
+        finally:
+            server.shutdown()
+
+    def test_eleven_page_https_list_reuses_one_connection(self, tmp_path):
+        # The acceptance shape: an 11-page HTTPS paged LIST opens exactly
+        # one connection — the handshake is paid once, not per page.
+        tls = fx.self_signed_cert(str(tmp_path))
+        if tls is None:
+            pytest.skip("openssl CLI unavailable")
+        nodes = fx.cpu_only_cluster(110)
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes), tls_cert=tls)
+        try:
+            cfg = cluster.ClusterConfig(
+                server=f"https://127.0.0.1:{server.server_address[1]}",
+                ca_file=tls[0],
+            )
+            client = cluster.KubeClient(cfg)
+            got = client.list_nodes(page_limit=10)
+            assert len(got) == 110
+            assert server.connections_opened == 1
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_sequential_requests_share_the_connection(self):
+        # LIST + events + PATCH — the full round's call mix on one socket.
+        state = {"requests": 0}
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _respond(self):
+                state["requests"] += 1
+                body = b'{"items": []}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._respond()
+
+            def do_PATCH(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                self._respond()
+
+            def log_message(self, *args):
+                pass
+
+        server = fx.serve_http(Handler)
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            s = cluster._StdlibSession()
+            s.get(f"{base}/api/v1/nodes", timeout=5).raise_for_status()
+            s.get(f"{base}/api/v1/events", timeout=5).raise_for_status()
+            s.patch(f"{base}/api/v1/nodes/n", data="{}", timeout=5).raise_for_status()
+            assert state["requests"] == 3
+            assert server.connections_opened == 1
+        finally:
+            server.shutdown()
+
+
+class _SilentCloseState:
+    """Server behavior knobs shared with the handler class."""
+
+    def __init__(self, respond_max=None, delay_s=0.0):
+        self.responses = 0
+        self.respond_max = respond_max  # None = always respond
+        self.delay_s = delay_s
+        self.seen: list = []  # methods that ARRIVED at the server
+
+
+def _silent_close_handler(state):
+    """Responds, then silently closes the connection (NO Connection: close
+    header) — the stale-keep-alive-socket shape an idle-timeouted API
+    server LB produces.  After ``respond_max`` responses, closes every
+    connection without responding at all."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _serve(self):
+            state.seen.append(self.command)
+            if state.respond_max is not None and state.responses >= state.respond_max:
+                self.close_connection = True  # slam shut, no response
+                return
+            time.sleep(state.delay_s)
+            state.responses += 1
+            body = b'{"items": []}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            # Close WITHOUT advertising it: the client pools the socket
+            # and only discovers the death at its next acquire (liveness
+            # peek) or, in the peek-vs-close race, on the request itself.
+            self.close_connection = True
+
+        def do_GET(self):
+            self._serve()
+
+        def do_PATCH(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self._serve()
+
+        def log_message(self, *args):
+            pass
+
+    return Handler
+
+
+def _wait_pool_dead(s, retries=50):
+    """Wait until every pooled socket reads as dead (the server's FIN can
+    land a few ms after the response bytes)."""
+    for _ in range(retries):
+        with s._lock:
+            conns = [c for idle in s._pool.values() for c in idle]
+        if conns and all(cluster._StdlibSession._sock_is_dead(c) for c in conns):
+            return
+        time.sleep(0.01)
+
+
+class TestStaleSocketRecovery:
+    def test_get_survives_stale_socket_with_one_fresh_dial(self):
+        state = _SilentCloseState()
+        server = fx.serve_http(_silent_close_handler(state))
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            s = cluster._StdlibSession()
+            s.get(f"{base}/x", timeout=5).raise_for_status()
+            _wait_pool_dead(s)
+            # The pooled socket is dead: the acquire-time liveness peek
+            # discards it and the GET rides exactly one fresh dial.
+            s.get(f"{base}/x", timeout=5).raise_for_status()
+            assert state.responses == 2
+            assert server.connections_opened == 2  # original + one redial
+            assert s.connections_opened == 2
+            assert s.requests_reused == 0  # the dead socket was never used
+        finally:
+            server.shutdown()
+
+    def test_get_retry_when_peek_race_hands_out_dead_socket(self, monkeypatch):
+        # The peek is racy: the peer can close between peek and send.  Pin
+        # the in-flight retry path by blinding the peek — the GET must
+        # fail on the dead pooled socket, then transparently redial ONCE.
+        state = _SilentCloseState()
+        server = fx.serve_http(_silent_close_handler(state))
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            s = cluster._StdlibSession()
+            s.get(f"{base}/x", timeout=5).raise_for_status()
+            _wait_pool_dead(s)
+            monkeypatch.setattr(
+                cluster._StdlibSession, "_sock_is_dead", staticmethod(lambda c: False)
+            )
+            s.get(f"{base}/x", timeout=5).raise_for_status()
+            assert state.responses == 2
+            assert s.connections_opened == 2
+            assert s.requests_reused == 0  # the reuse attempt FAILED
+        finally:
+            server.shutdown()
+
+    def test_stale_failure_flushes_poolmates_so_retry_dials_fresh(self, monkeypatch):
+        # Two pooled sockets, both dead (e.g. an LB idle-timeout sweep
+        # between watch rounds), peek blinded: the GET's failure on corpse
+        # #1 must flush corpse #2 so the single retry reaches a FRESH dial
+        # instead of exhausting itself on the next dead socket.
+        state = _SilentCloseState(delay_s=0.2)
+        server = fx.serve_http(_silent_close_handler(state))
+        try:
+            from concurrent.futures import ThreadPoolExecutor
+
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            s = cluster._StdlibSession()
+            with ThreadPoolExecutor(2) as pool:  # overlap via server delay
+                futs = [
+                    pool.submit(lambda: s.get(f"{base}/x", timeout=5)) for _ in range(2)
+                ]
+                for f in futs:
+                    f.result().raise_for_status()
+            assert s.connections_opened == 2  # both workers dialed
+            _wait_pool_dead(s)
+            state.delay_s = 0.0
+            monkeypatch.setattr(
+                cluster._StdlibSession, "_sock_is_dead", staticmethod(lambda c: False)
+            )
+            s.get(f"{base}/x", timeout=5).raise_for_status()  # survives
+            assert s.connections_opened == 3  # exactly one fresh dial
+        finally:
+            server.shutdown()
+
+    def test_redial_failure_propagates_no_retry_loop(self):
+        # Respond once ever; afterwards every connection is slammed shut.
+        # The post-stale fresh dial gets one shot — its failure surfaces.
+        state = _SilentCloseState(respond_max=1)
+        server = fx.serve_http(_silent_close_handler(state))
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            s = cluster._StdlibSession()
+            s.get(f"{base}/x", timeout=5).raise_for_status()
+            _wait_pool_dead(s)
+            with pytest.raises(Exception):
+                s.get(f"{base}/x", timeout=5)
+            # One original dial + exactly one more — never a third.
+            assert server.connections_opened == 2
+        finally:
+            server.shutdown()
+
+    def test_patch_is_never_resent_after_mid_request_socket_death(self):
+        # The PATCH reaches the server once, the socket dies without a
+        # response — the transport must surface the failure, never re-send
+        # (the first PATCH may have been applied).
+        state = _SilentCloseState(respond_max=1)
+        server = fx.serve_http(_silent_close_handler(state))
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            s = cluster._StdlibSession()
+            s.get(f"{base}/x", timeout=5).raise_for_status()
+            # Deterministic: once the corpse reads dead, the acquire peek
+            # discards it and the PATCH rides a fresh dial — which the
+            # server reads, then slams without responding.
+            _wait_pool_dead(s)
+            with pytest.raises(Exception):
+                s.patch(f"{base}/api/v1/nodes/n", data="{}", timeout=5)
+            assert state.seen.count("PATCH") == 1  # arrived once, never again
+        finally:
+            server.shutdown()
+
+    def test_patch_on_raced_dead_socket_not_retried(self, monkeypatch):
+        # Peek blinded (the race window): the PATCH rides the dead pooled
+        # socket, its bytes go nowhere, and the transport must NOT redial-
+        # and-resend — the failure surfaces as the caller's per-node note.
+        state = _SilentCloseState()
+        server = fx.serve_http(_silent_close_handler(state))
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            s = cluster._StdlibSession()
+            s.get(f"{base}/x", timeout=5).raise_for_status()  # prime the pool
+            _wait_pool_dead(s)
+            monkeypatch.setattr(
+                cluster._StdlibSession, "_sock_is_dead", staticmethod(lambda c: False)
+            )
+            with pytest.raises(Exception):
+                s.patch(f"{base}/api/v1/nodes/n", data="{}", timeout=5)
+            assert state.seen.count("PATCH") == 0  # bytes died with the socket
+            assert s.connections_opened == 1  # no redial for PATCH
+        finally:
+            server.shutdown()
+
+
+class TestSecurityPosture:
+    """Redirect-refusal and http-no-CA-load, pinned against the NEW
+    transport (complementing tests/test_cluster.py's TestStdlibSession)."""
+
+    @pytest.fixture
+    def redirect_server(self):
+        seen = []
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                seen.append(
+                    {"path": self.path, "auth": self.headers.get("Authorization")}
+                )
+                self.send_response(302)
+                self.send_header("Location", "http://127.0.0.1:1/elsewhere")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        server = fx.serve_http(Handler)
+        yield f"http://127.0.0.1:{server.server_address[1]}", seen
+        server.shutdown()
+
+    def test_redirect_refused_auth_never_crosses(self, redirect_server):
+        base, seen = redirect_server
+        s = cluster._StdlibSession()
+        s.headers["Authorization"] = "Bearer secret"
+        resp = s.get(f"{base}/redirect", timeout=5)
+        assert resp.status_code == 302
+        with pytest.raises(cluster.ClusterAPIError, match="HTTP 302"):
+            resp.raise_for_status()
+        # Exactly one request total: the 302 was never followed, so the
+        # Authorization header never left for the redirect target.
+        assert len(seen) == 1
+        assert seen[0]["auth"] == "Bearer secret"
+
+    def test_http_target_never_builds_tls_context(self):
+        nodes = fx.cpu_only_cluster(25)
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes))
+        try:
+            cfg = cluster.ClusterConfig(
+                server=f"http://127.0.0.1:{server.server_address[1]}"
+            )
+            client = cluster.KubeClient(cfg)
+            calls = []
+            session = client._session
+            orig = session._context
+            session._context = lambda: calls.append(1) or orig()
+            client.list_nodes(page_limit=10)
+            assert calls == []  # a full paged walk, zero CA-store loads
+        finally:
+            server.shutdown()
+
+
+class TestBoundedMap:
+    def test_results_in_input_order_failures_captured(self):
+        def work(i):
+            if i == 2:
+                raise ValueError("boom-2")
+            time.sleep(0.01 * (5 - i))  # later items finish FIRST
+            return i * 10
+
+        out = bounded_map(work, range(5), max_workers=5)
+        assert [ok for ok, _ in out] == [True, True, False, True, True]
+        assert [v for ok, v in out if ok] == [0, 10, 30, 40]
+        assert isinstance(out[2][1], ValueError)
+
+    def test_serial_degenerate_matches_parallel(self):
+        for workers in (1, 3):
+            out = bounded_map(lambda i: i + 1, [1, 2, 3], max_workers=workers)
+            assert out == [(True, 2), (True, 3), (True, 4)]
+        assert bounded_map(lambda i: i, [], max_workers=4) == []
+
+
+class _SlowEventsClient:
+    """list_node_events stand-in with injected per-request latency."""
+
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def list_node_events(self, name, timeout=None, limit=100):
+        time.sleep(self.delay_s)
+        with self._lock:
+            self.calls.append(name)
+        return [{"type": "Warning", "reason": f"R-{name}", "message": "m",
+                 "lastTimestamp": "2026-07-30T10:00:00Z"}]
+
+
+class TestEventsFanOut:
+    def _sick_accel(self, n=8):
+        nodes = fx.tpu_v5p_64_slice(not_ready=n)
+        accel, _ = checker.select_accelerator_nodes(nodes)
+        return accel
+
+    def test_eight_sick_nodes_cost_max_not_sum(self, capsys):
+        delay = 0.15
+        client = _SlowEventsClient(delay)
+        accel = self._sick_accel(8)
+        t0 = time.perf_counter()
+        checker._attach_node_events(
+            args_for("--node-events", "--api-concurrency", "8"), accel, client
+        )
+        elapsed = time.perf_counter() - t0
+        assert len(client.calls) == 8
+        # Serial would be >= 8 * 0.15 = 1.2 s; parallel ~0.15 s + overhead.
+        assert elapsed < 4 * delay, f"fan-out took {elapsed:.2f}s — serial?"
+        by_name = {n.name: n for n in accel}
+        for i in range(8):
+            name = f"gke-tpu-v5p-{i}"
+            assert by_name[name].events[0]["reason"] == f"R-{name}"
+        capsys.readouterr()
+
+    def test_concurrency_one_is_serial_and_identical(self, capsys):
+        client = _SlowEventsClient(0.0)
+        accel = self._sick_accel(3)
+        checker._attach_node_events(
+            args_for("--node-events", "--api-concurrency", "1"), accel, client
+        )
+        # Serial path preserves the sickness-sorted order exactly.
+        assert client.calls == [f"gke-tpu-v5p-{i}" for i in range(3)]
+        capsys.readouterr()
+
+    def test_failures_stay_per_node_and_ordered(self, capsys):
+        class FlakyClient(_SlowEventsClient):
+            def list_node_events(self, name, timeout=None, limit=100):
+                if name.endswith("-1"):
+                    raise cluster.ClusterAPIError("HTTP 403: forbidden", 403)
+                return super().list_node_events(name, timeout, limit)
+
+        client = FlakyClient(0.0)
+        accel = self._sick_accel(3)
+        checker._attach_node_events(
+            args_for("--node-events", "--api-concurrency", "4"), accel, client
+        )
+        err = capsys.readouterr().err
+        assert "Cannot fetch events for gke-tpu-v5p-1" in err
+        by_name = {n.name: n for n in accel}
+        assert by_name["gke-tpu-v5p-1"].events is None
+        assert by_name["gke-tpu-v5p-0"].events and by_name["gke-tpu-v5p-2"].events
+
+    def test_api_concurrency_flag_validation(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            cli.parse_args(["--api-concurrency", "0"])
+        assert e.value.code == 2
+        capsys.readouterr()
+        assert cli.parse_args(["--api-concurrency", "1"]).api_concurrency == 1
+
+
+class TestClientCacheAndTelemetry:
+    def test_same_resolved_config_reuses_the_client(self):
+        cfg = cluster.ClusterConfig(server="https://cache-test:6443", token="t")
+        a = checker._cached_client(cfg)
+        b = checker._cached_client(cfg)
+        assert a is b
+        checker.reset_client_cache()
+        c = checker._cached_client(cfg)
+        assert c is not a
+        checker.reset_client_cache()
+
+    def test_inline_data_kubeconfig_yields_stable_cache_key(self, tmp_path):
+        # GKE-style kubeconfigs inline credentials (*-data); materialized
+        # temp files are content-addressed, so re-resolving the SAME
+        # kubeconfig every watch round lands on the SAME cache key — the
+        # cross-round pooling this PR exists for.  Path-per-round would
+        # make the client cache miss every round, silently.
+        import base64
+
+        ca = base64.b64encode(b"POOL-CA").decode()
+        kc = tmp_path / "kubeconfig"
+        kc.write_text(
+            "apiVersion: v1\ncurrent-context: c\n"
+            "contexts:\n- name: c\n  context:\n    cluster: cl\n    user: u\n"
+            "clusters:\n- name: cl\n  cluster:\n"
+            "    server: https://inline-data:6443\n"
+            f"    certificate-authority-data: {ca}\n"
+            "users:\n- name: u\n  user:\n    token: tok\n"
+        )
+        cfg1 = cluster.load_kubeconfig(str(kc))
+        cfg2 = cluster.load_kubeconfig(str(kc))
+        assert checker._client_key(cfg1) == checker._client_key(cfg2)
+        assert checker._cached_client(cfg1) is checker._cached_client(cfg2)
+        checker.reset_client_cache()
+
+    def test_watch_rounds_reuse_the_pooled_connection(self, tmp_path):
+        # Two run_check rounds against one live server: round 2 must pay
+        # ZERO new connections — the number every watch round after the
+        # first actually pays — and the payload's transport telemetry must
+        # say so.
+        nodes = fx.tpu_v5e_single_host()
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes))
+        try:
+            kc = tmp_path / "kubeconfig"
+            kc.write_text(
+                "apiVersion: v1\ncurrent-context: c\n"
+                "contexts:\n- name: c\n  context:\n    cluster: cl\n    user: u\n"
+                "clusters:\n- name: cl\n  cluster:\n"
+                f"    server: http://127.0.0.1:{server.server_address[1]}\n"
+                "users:\n- name: u\n  user:\n    token: tok\n"
+            )
+            args = args_for("--kubeconfig", str(kc), "--json")
+            r1 = checker.run_check(args)
+            r2 = checker.run_check(args)
+            assert r1.exit_code == 0 and r2.exit_code == 0
+            assert server.connections_opened == 1
+            t2 = r2.payload["api_transport"]
+            assert t2["connections_opened"] == 1
+            assert t2["requests_reused"] >= 1
+        finally:
+            server.shutdown()
+            checker.reset_client_cache()
+
+    def test_transport_counters_rendered_as_prometheus_counters(self):
+        from tpu_node_checker.metrics import render_metrics
+
+        result = checker.CheckResult(
+            exit_code=0,
+            payload={
+                "total_nodes": 1, "ready_nodes": 1,
+                "total_chips": 4, "ready_chips": 4,
+                "nodes": [], "slices": [], "timings_ms": {"total": 1.0},
+                "api_transport": {
+                    "connections_opened": 1,
+                    "requests_sent": 12,
+                    "requests_reused": 11,
+                },
+            },
+        )
+        text = render_metrics(result)
+        assert "tpu_node_checker_api_connections_opened_total 1" in text
+        assert "tpu_node_checker_api_requests_total 12" in text
+        assert "tpu_node_checker_api_requests_reused_total 11" in text
+        assert "# TYPE tpu_node_checker_api_connections_opened_total counter" in text
+
+    def test_requests_session_dropin_reports_no_stats(self):
+        class RequestsLikeSession:
+            headers: dict = {}
+            verify = cert = auth = None
+
+            def get(self, url, params=None, timeout=None):
+                class R:
+                    status_code = 200
+
+                    def raise_for_status(self):
+                        pass
+
+                    def json(self):
+                        return {"items": []}
+
+                return R()
+
+        cfg = cluster.ClusterConfig(server="https://api:6443")
+        client = cluster.KubeClient(cfg, session=RequestsLikeSession())
+        client.list_nodes()
+        assert client.transport_stats() == {}
+        client.close()  # no-op, must not raise
+
+
+class TestCordonFanOut:
+    def test_parallel_patches_all_land_report_deterministic(self, tmp_path):
+        # 4 probe-failed nodes, concurrency 4: every PATCH lands, and the
+        # report's cordoned list is in candidate order regardless of which
+        # worker finished first.
+        patched = []
+        lock = threading.Lock()
+
+        delay = 0.15
+
+        class FakeClient:
+            def cordon_node(self, name, timeout=None):
+                time.sleep(delay)
+                with lock:
+                    patched.append(name)
+
+        nodes = [
+            fx.make_node(
+                f"tpu-{i}", allocatable={"google.com/tpu": "4"},
+                labels={"cloud.google.com/gke-nodepool": "p"},
+            )
+            for i in range(4)
+        ]
+        accel, _ = checker.select_accelerator_nodes(nodes)
+        for n in accel:
+            n.probe = {"ok": False, "level": "compute", "error": "dead"}
+        args = args_for(
+            "--probe-results", str(tmp_path), "--cordon-failed",
+            "--cordon-max", "4", "--api-concurrency", "4",
+        )
+        t0 = time.perf_counter()
+        entry = checker._cordon_failed_nodes(args, accel, client=FakeClient())
+        elapsed = time.perf_counter() - t0
+        assert sorted(patched) == [f"tpu-{i}" for i in range(4)]
+        assert entry["cordoned"] == [f"tpu-{i}" for i in range(4)]  # input order
+        assert entry["failed"] == []
+        # Serial would be >= 4 * delay; same slack policy as the events
+        # fan-out test (scheduler jitter on loaded CI must not flake this).
+        assert elapsed < 3 * delay, f"parallel cordon took {elapsed:.2f}s — serial?"
+        assert all(n.cordoned for n in accel)

@@ -86,6 +86,70 @@ def _registry_from_args(args) -> ResourceRegistry:
     return reg
 
 
+# Keep-alive client cache: one KubeClient (one pooled transport) per set of
+# resolved connection credentials, so every watch round after the first pays
+# zero TCP+TLS handshakes (BENCH_r05: the HTTPS cold path was 120.8 ms —
+# almost all of it handshake).  Keyed by the RESOLVED config, not the flag:
+# config resolution still runs every round (cheap — miniyaml parse), so a
+# rotated token or exec-plugin refresh lands on a new key and a fresh
+# client instead of riding a session with dead credentials.
+_CLIENT_CACHE: dict = {}
+_CLIENT_CACHE_MAX = 8  # tests spin many fixture servers; evict, don't grow
+
+# The live client this round's API traffic actually rode (LIST or, for
+# offline node sources with live PATCH/events traffic, the on-demand
+# resolved client) — the source of the payload's api_transport telemetry.
+_ROUND_CLIENT: dict = {"client": None}
+
+
+def _client_key(cfg) -> tuple:
+    return (
+        cfg.server,
+        cfg.token,
+        cfg.basic_auth,
+        cfg.client_cert,
+        cfg.ca_file,
+        cfg.insecure_skip_tls_verify,
+    )
+
+
+def _cached_client(cfg):
+    from tpu_node_checker.cluster import KubeClient
+
+    key = _client_key(cfg)
+    client = _CLIENT_CACHE.get(key)
+    if client is None:
+        while len(_CLIENT_CACHE) >= _CLIENT_CACHE_MAX:
+            # Evict least-recently-USED (hits below move their entry to the
+            # end): a long-lived watch loop's hot client must never be the
+            # one closed to make room.
+            _CLIENT_CACHE.pop(next(iter(_CLIENT_CACHE))).close()
+        client = KubeClient(cfg)
+    else:
+        del _CLIENT_CACHE[key]  # re-insert: move-to-end = mark recently used
+    _CLIENT_CACHE[key] = client
+    _ROUND_CLIENT["client"] = client
+    return client
+
+
+def reset_client_cache() -> None:
+    """Drop (and close) every cached client — watch mode calls this after a
+    failed round so the next round redials instead of trusting a pool whose
+    sockets (or credentials) just demonstrated they may be dead."""
+    while _CLIENT_CACHE:
+        _, client = _CLIENT_CACHE.popitem()
+        client.close()
+
+
+def _api_concurrency(args) -> int:
+    """``--api-concurrency``: bound on concurrent API calls in the per-node
+    fan-outs (events fetches, cordon/uncordon PATCHes).  1 = serial."""
+    from tpu_node_checker.utils.fanout import DEFAULT_API_CONCURRENCY
+
+    value = getattr(args, "api_concurrency", None)
+    return max(1, int(value)) if value is not None else DEFAULT_API_CONCURRENCY
+
+
 def _fetch_nodes(args, timer: PhaseTimer):
     """Node source: ``--nodes-json`` fixture file, or one live LIST call.
 
@@ -99,14 +163,14 @@ def _fetch_nodes(args, timer: PhaseTimer):
                 doc = json.load(f)
             # "items": null happens in Go-serialized NodeLists; treat as empty.
             return ((doc.get("items") or []) if isinstance(doc, dict) else doc), None
-    from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
+    from tpu_node_checker.cluster import resolve_cluster_config
 
     with timer.phase("config"):
         cfg = resolve_cluster_config(
             getattr(args, "kubeconfig", None), getattr(args, "context", None)
         )
     with timer.phase("list"):
-        client = KubeClient(cfg)
+        client = _cached_client(cfg)
         return client.list_nodes(
             label_selector=getattr(args, "label_selector", None)
         ), client
@@ -320,9 +384,12 @@ def _attach_probe_results(args, accel: List[NodeInfo]) -> dict:
     return skipped
 
 
-# --node-events fetch bounds: one bounded call per sick node, few nodes.
-# Past the cap the fetches stop (visibly) — a fleet-wide outage must not
-# turn the checker into an API-server event storm.
+# --node-events fetch bounds: one BOUNDED paged walk per sick node
+# (EVENTS_PAGE_LIMIT events/page, EVENTS_MAX_PAGES pages — see
+# cluster.KubeClient), at most _EVENTS_NODE_CAP nodes, fanned out over at
+# most --api-concurrency connections.  Past the cap the fetches stop
+# (visibly) — a fleet-wide outage must not turn the checker into an
+# API-server event storm against an already-degraded control plane.
 _EVENTS_NODE_CAP = 8
 _EVENTS_PER_NODE = 3
 
@@ -369,6 +436,11 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
     capped, and never fatal to the round (an events RBAC gap degrades to a
     stderr note, not exit 1).  No reference analog: check-gpu-node.py never
     reads events.
+
+    The per-node walks fan out over a bounded thread pool
+    (``--api-concurrency``, each worker on its own pooled keep-alive
+    connection), so 8 sick nodes cost ~max(one walk), not the sum — the
+    exact round where latency matters most is the degraded one.
     """
     sick = [n for n in accel if not n.effectively_ready]
     if not sick:
@@ -383,11 +455,19 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
     except Exception as exc:  # noqa: BLE001 — triage extra, never fatal
         print(f"Cannot fetch node events: {exc}", file=sys.stderr)
         return
-    for n in sick[:_EVENTS_NODE_CAP]:
-        try:
-            n.events = _summarize_events(client.list_node_events(n.name))
-        except Exception as exc:  # noqa: BLE001
-            print(f"Cannot fetch events for {n.name}: {exc}", file=sys.stderr)
+    from tpu_node_checker.utils.fanout import bounded_map
+
+    targets = sick[:_EVENTS_NODE_CAP]
+    outcomes = bounded_map(
+        lambda n: client.list_node_events(n.name), targets, _api_concurrency(args)
+    )
+    # Input-ordered results: attachment and stderr notes stay deterministic
+    # no matter which worker finished first.
+    for n, (ok, value) in zip(targets, outcomes):
+        if ok:
+            n.events = _summarize_events(value)
+        else:
+            print(f"Cannot fetch events for {n.name}: {value}", file=sys.stderr)
     omitted = len(sick) - _EVENTS_NODE_CAP
     if omitted > 0:
         print(
@@ -398,12 +478,14 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
 
 
 def _resolve_client(args, client):
-    """Reuse the LIST call's client; offline runs resolve one on demand."""
+    """Reuse the LIST call's client; offline runs resolve one on demand
+    (through the same keep-alive cache, so repeated offline-plus-PATCH
+    rounds also pool their connections)."""
     if client is not None:
         return client
-    from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
+    from tpu_node_checker.cluster import resolve_cluster_config
 
-    return KubeClient(
+    return _cached_client(
         resolve_cluster_config(
             getattr(args, "kubeconfig", None), getattr(args, "context", None)
         )
@@ -469,24 +551,32 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None) -> dict:
         ]
         print(f"--uncordon-recovered: cannot reach cluster: {exc}", file=sys.stderr)
         return report_entry
-    for n in candidates:
-        try:
-            client.uncordon_node(n.name)
-        except Exception as exc:  # noqa: BLE001
-            report_entry["failed"].append({"node": n.name, "error": str(exc)})
-            print(f"Uncordon of {n.name} failed: {exc}", file=sys.stderr)
+    from tpu_node_checker.utils.fanout import bounded_map
+
+    workers = _api_concurrency(args)
+    # Bounded parallel PATCHes (one pooled connection per worker); outcomes
+    # come back in candidate order, so report lists and stderr notes stay
+    # deterministic.  A dead-socket PATCH is NEVER transparently retried by
+    # the transport (it may have applied) — it lands here as a failure note.
+    for n, (ok, err) in zip(
+        candidates, bounded_map(lambda n: client.uncordon_node(n.name), candidates, workers)
+    ):
+        if not ok:
+            report_entry["failed"].append({"node": n.name, "error": str(err)})
+            print(f"Uncordon of {n.name} failed: {err}", file=sys.stderr)
         else:
             n.cordoned = False
             n.quarantined_by_us = False
             report_entry["uncordoned"].append(n.name)
             print(f"Uncordoned {n.name} (chip probe recovered).", file=sys.stderr)
-    for n in stale:
-        try:
-            client.clear_quarantine_annotation(n.name)
-        except Exception as exc:  # noqa: BLE001
-            report_entry["failed"].append({"node": n.name, "error": str(exc)})
+    for n, (ok, err) in zip(
+        stale,
+        bounded_map(lambda n: client.clear_quarantine_annotation(n.name), stale, workers),
+    ):
+        if not ok:
+            report_entry["failed"].append({"node": n.name, "error": str(err)})
             print(
-                f"Clearing stale annotation on {n.name} failed: {exc}", file=sys.stderr
+                f"Clearing stale annotation on {n.name} failed: {err}", file=sys.stderr
             )
         else:
             n.quarantined_by_us = False
@@ -567,12 +657,17 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None) -> dict:
         ]
         print(f"--cordon-failed: cannot reach cluster: {exc}", file=sys.stderr)
         return report_entry
-    for n in to_cordon:
-        try:
-            client.cordon_node(n.name)
-        except Exception as exc:  # noqa: BLE001
-            report_entry["failed"].append({"node": n.name, "error": str(exc)})
-            print(f"Cordon of {n.name} failed: {exc}", file=sys.stderr)
+    from tpu_node_checker.utils.fanout import bounded_map
+
+    # Bounded parallel PATCHes, results consumed in candidate order (see
+    # _uncordon_recovered_nodes for the ordering/retry rationale).
+    for n, (ok, err) in zip(
+        to_cordon,
+        bounded_map(lambda n: client.cordon_node(n.name), to_cordon, _api_concurrency(args)),
+    ):
+        if not ok:
+            report_entry["failed"].append({"node": n.name, "error": str(err)})
+            print(f"Cordon of {n.name} failed: {err}", file=sys.stderr)
         else:
             n.cordoned = True
             report_entry["cordoned"].append(n.name)
@@ -585,6 +680,7 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     gating decisions is computed here so tests can drive it directly."""
     timer = PhaseTimer()
     kube_client = None
+    _ROUND_CLIENT["client"] = None  # telemetry tracks THIS round's traffic
     if nodes is None:
         nodes, kube_client = _fetch_nodes(args, timer)
     result = CheckResult(exit_code=EXIT_OK)
@@ -715,6 +811,18 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
             payload["cordon"] = cordon_report
         if uncordon_report is not None:
             payload["uncordon"] = uncordon_report
+        # Keep-alive pool telemetry (session-lifetime counters): reuse
+        # climbing while connections_opened stays flat is the pooled
+        # transport doing its job across watch rounds; the gap between
+        # them going the wrong way is a server dropping keep-alive.
+        # _ROUND_CLIENT also covers offline node sources (--nodes-json)
+        # whose cordon/uncordon/events traffic resolved a live client on
+        # demand — those rounds send real API requests too.
+        live_client = kube_client or _ROUND_CLIENT["client"]
+        if live_client is not None:
+            stats = getattr(live_client, "transport_stats", lambda: {})()
+            if stats:
+                payload["api_transport"] = stats
         payload["exit_code"] = result.exit_code
     payload["timings_ms"] = timer.as_dict()
     result.payload = payload
@@ -1257,6 +1365,10 @@ def watch(args) -> None:
         except Exception as exc:  # noqa: BLE001 — a bad round must not kill the daemon
             code = EXIT_ERROR
             print(f"Check round failed: {exc}", file=sys.stderr)
+            # The cached keep-alive client just failed a round: drop it so
+            # the next round redials (and re-resolves credentials) instead
+            # of re-trusting a pool that may hold only dead sockets.
+            reset_client_cache()
             if metrics_server is not None:
                 metrics_server.mark_error(EXIT_ERROR)
             _append_state_log(args, None, error=str(exc))

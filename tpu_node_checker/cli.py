@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--strict-slices", action="store_true",
                    help="exit 3 if any multi-host TPU slice is incomplete")
+    p.add_argument("--api-concurrency", type=int, default=None, metavar="N",
+                   help="max concurrent Kubernetes API calls in the per-node "
+                   "fan-outs (--node-events fetches, cordon/uncordon patches); "
+                   "each worker keeps its own pooled keep-alive connection "
+                   "(default 4; 1 = serial)")
     p.add_argument("--node-events", action="store_true",
                    help="fetch recent k8s Events for sick nodes (the kubectl-"
                    "describe triage block: OOM kills, evictions, plugin crash "
@@ -253,6 +258,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--probe-report-schema runs alone")
     if args.watch is not None and args.watch <= 0:
         p.error("--watch interval must be a positive number of seconds")
+    if args.api_concurrency is not None and args.api_concurrency < 1:
+        p.error("--api-concurrency must be at least 1 (1 = serial)")
     if args.metrics_port is not None and args.watch is None:
         p.error("--metrics-port requires --watch (one-shot runs serve no scrapes)")
     if args.slack_on_change and args.watch is None:

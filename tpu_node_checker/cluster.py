@@ -3,10 +3,12 @@
 The reference delegates this layer to the ``kubernetes`` client package
 (``load_kube_config`` check-gpu-node.py:160-169, ``client.CoreV1Api()`` :253,
 ``api.list_node()`` :217).  This build ships its own thin client over stdlib
-``urllib`` instead: the checker makes exactly **one** GET, so a client library
-is dead weight on the <2 s latency budget (importing ``kubernetes`` costs
-hundreds of ms; even ``requests`` alone is ~200 ms), and raw REST dicts are
-exactly what the pure core (``tpu_node_checker.detect``) consumes.
+``http.client`` instead, with keep-alive connection pooling
+(:class:`_StdlibSession`): a client library is dead weight on the <2 s
+latency budget (importing ``kubernetes`` costs hundreds of ms; even
+``requests`` alone is ~200 ms), raw REST dicts are exactly what the pure
+core (``tpu_node_checker.detect``) consumes, and a long-lived checker pays
+the TCP+TLS handshake once per server, not once per request.
 
 Config discovery preserves the reference's precedence — ``--kubeconfig`` flag →
 ``$KUBECONFIG`` (only if the path exists, check-gpu-node.py:165-167) → default
@@ -28,6 +30,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -81,106 +84,233 @@ class _Response:
 
 
 class _StdlibSession:
-    """``requests.Session``-shaped transport over stdlib urllib.
+    """``requests.Session``-shaped keep-alive transport over ``http.client``.
 
     Importing requests costs ~200 ms — more than half of what the checker
-    actually spends against its <2 s budget — to make one GET (plus an
-    opt-in PATCH).  The Slack notifier keeps requests (its retry
-    classification is pinned to requests' exception taxonomy by the
-    reference contract, check-gpu-node.py:86-99), but that import only
-    happens when a webhook is configured, off the happy path.
+    actually spends against its <2 s budget.  The Slack notifier keeps
+    requests (its retry classification is pinned to requests' exception
+    taxonomy by the reference contract, check-gpu-node.py:86-99), but that
+    import only happens when a webhook is configured, off the happy path.
+
+    Connections are POOLED, keyed by ``(scheme, host, port)``: a paginated
+    LIST, the per-sick-node events fetches, every watch round, and the
+    cordon/uncordon PATCHes all reuse one TCP+TLS connection per concurrent
+    caller instead of paying the handshake per request (the kubectl /
+    client-go shared-transport model).  The pool is a free-list: a thread
+    pops an idle connection (or dials a new one — bounded in practice by
+    the ``--api-concurrency`` fan-out width) and returns it after reading
+    the full response, so concurrent workers never interleave on a socket.
+
+    A keep-alive socket the server quietly closed between rounds surfaces
+    as ``RemoteDisconnected``/``BrokenPipeError`` on the next use; for an
+    idempotent GET on a REUSED connection the session transparently redials
+    once.  Non-idempotent methods (PATCH) are NEVER blind-retried: a socket
+    that died after the bytes left may have applied the patch, and
+    re-sending could double-apply — the error surfaces to the caller, whose
+    per-node failure handling already treats it as a note, not a round
+    failure.
+
+    Security posture (unchanged from the urllib transport, pinned by
+    tests): redirects are never followed — ``http.client`` performs no
+    redirect handling, so a 3xx comes back as a plain ``_Response`` that
+    ``raise_for_status`` rejects, and the Authorization header can never
+    cross a redirect off-host.  The TLS context is built once per session
+    and ONLY when an https target is contacted: plain-http endpoints
+    (local test servers, port-forwards) never pay the ~20 ms system CA
+    store load.  Unlike urllib, no proxy environment variables are
+    honored — the API server is dialed directly.
 
     Attribute contract shared with requests.Session (and the test fakes):
     ``headers`` dict, ``verify`` (True | False | CA path), ``cert``
-    ((cert, key) paths), ``auth`` ((user, password)).
+    ((cert, key) paths), ``auth`` ((user, password)).  Transport telemetry:
+    ``connections_opened`` / ``requests_sent`` / ``requests_reused``
+    monotonic counters (surfaced as Prometheus counters in watch mode).
     """
 
-    def __init__(self):
+    def __init__(self, keep_alive: bool = True):
         self.headers: dict = {}
         self.verify = True
         self.cert: Optional[Tuple[str, str]] = None
         self.auth: Optional[Tuple[str, str]] = None
-        self._openers: dict = {}
+        self.keep_alive = keep_alive
+        self.connections_opened = 0
+        self.requests_sent = 0
+        self.requests_reused = 0
+        self._ssl_ctx = None
+        self._pool: dict = {}  # (scheme, host, port) -> [idle connections]
+        self._lock = threading.Lock()
 
     def _context(self):
-        import ssl
+        """TLS context, built once per session (verify/cert are set by
+        KubeClient before the first request and never change after)."""
+        if self._ssl_ctx is None:
+            import ssl
 
-        if self.verify is False:
-            ctx = ssl.create_default_context()
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
-        elif isinstance(self.verify, str):
-            ctx = ssl.create_default_context(cafile=self.verify)
-        else:
-            ctx = ssl.create_default_context()
-        if self.cert:
-            ctx.load_cert_chain(self.cert[0], self.cert[1])
-        return ctx
-
-    def _get_opener(self, https: bool):
-        """Opener with redirects DISABLED and the TLS context cached.
-
-        Never following redirects (3xx surfaces as an error via
-        raise_for_status) is a security posture, not a convenience: the
-        default urllib redirect handler re-sends the original headers —
-        Authorization included — to wherever the redirect points, leaking
-        the cluster token off-host; the Kubernetes API never legitimately
-        redirects these calls.  The context is built once per session AND
-        only for https targets: ``ssl.create_default_context()`` loads the
-        system CA store (~20 ms), which plain-http endpoints (local test
-        servers, port-forwards) must not pay per check.
-        """
-        if https not in self._openers:
-            import urllib.request
-
-            class _NoRedirect(urllib.request.HTTPRedirectHandler):
-                def redirect_request(self, *args, **kwargs):
-                    return None  # default handlers turn the 3xx into HTTPError
-
-            handlers = [_NoRedirect()]
-            if https:
-                handlers.append(urllib.request.HTTPSHandler(context=self._context()))
+            if self.verify is False:
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            elif isinstance(self.verify, str):
+                ctx = ssl.create_default_context(cafile=self.verify)
             else:
-                # build_opener would otherwise add a DEFAULT HTTPSHandler,
-                # whose init loads the system CA store anyway — hand it a
-                # bare context instead: costs nothing to build, and fails
-                # CLOSED (no CAs loaded) if an https URL ever reached the
-                # http opener.
-                import ssl
+                ctx = ssl.create_default_context()
+            if self.cert:
+                ctx.load_cert_chain(self.cert[0], self.cert[1])
+            self._ssl_ctx = ctx
+        return self._ssl_ctx
 
-                handlers.append(
-                    urllib.request.HTTPSHandler(
-                        context=ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-                    )
-                )
-            self._openers[https] = urllib.request.build_opener(*handlers)
-        return self._openers[https]
+    def _new_connection(self, scheme: str, host: str, port: int, timeout):
+        import http.client
+
+        if scheme == "https":
+            conn = http.client.HTTPSConnection(
+                host, port, timeout=timeout, context=self._context()
+            )
+        else:
+            # Plain-http never touches ssl at all — no CA store load, and
+            # no code path by which an https URL could reach a TLS-free
+            # socket (the scheme picks the connection class directly).
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        with self._lock:
+            self.connections_opened += 1
+        return conn
+
+    def _acquire(self, key, timeout):
+        """Pop a LIVE idle pooled connection for ``key``, else dial fresh.
+
+        Every popped connection is liveness-peeked first (an idle
+        keep-alive socket the peer closed — LB idle timeout between watch
+        rounds — reads as EOF): knowably-dead sockets are discarded here so
+        they are never handed to a non-retryable PATCH, and a GET does not
+        burn its one stale-socket retry on them.  The peek is inherently
+        racy (the peer can close between peek and send); the reused-GET
+        redial in ``_request`` covers that residue.
+
+        Returns ``(conn, reused)`` — ``reused`` gates the one-shot
+        stale-socket retry (a FRESH connection failing is a real error).
+        """
+        while True:
+            with self._lock:
+                idle = self._pool.get(key)
+                conn = idle.pop() if idle else None
+            if conn is None:
+                return self._new_connection(*key, timeout), False
+            if self._sock_is_dead(conn):
+                conn.close()
+                continue
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            return conn, True
+
+    @staticmethod
+    def _sock_is_dead(conn) -> bool:
+        """Zero-timeout readability peek: an idle keep-alive HTTP socket has
+        nothing to say, so readable means EOF (peer closed) or protocol
+        garbage — either way the connection is unusable.  Works for TLS
+        sockets too (select on the underlying fd; a clean close shows as a
+        readable close_notify/EOF)."""
+        sock = conn.sock
+        if sock is None:
+            return True
+        import select
+
+        try:
+            return bool(select.select([sock], [], [], 0)[0])
+        except (OSError, ValueError):
+            return True
+
+    def _discard_idle(self, key) -> None:
+        """Close every idle connection for ``key`` — when one pooled socket
+        proves stale mid-request, its pool-mates idled exactly as long and
+        are suspect too; the subsequent redial must reach a fresh dial, not
+        the next corpse (which would exhaust the one-shot retry)."""
+        with self._lock:
+            idle = self._pool.pop(key, [])
+        for conn in idle:
+            conn.close()
+
+    def _release(self, key, conn, raw) -> None:
+        """Return a connection to the pool unless the response ended it."""
+        if not self.keep_alive or raw.will_close or conn.sock is None:
+            conn.close()
+            return
+        with self._lock:
+            self._pool.setdefault(key, []).append(conn)
+
+    def close(self) -> None:
+        """Close every pooled connection (tests / bench hygiene; a one-shot
+        process exits anyway and the kernel reaps the sockets)."""
+        with self._lock:
+            pools, self._pool = self._pool, {}
+        for idle in pools.values():
+            for conn in idle:
+                conn.close()
 
     def _request(self, method, url, *, params=None, data=None, headers=None, timeout=None):
-        import urllib.error
+        import http.client
         import urllib.parse
-        import urllib.request
 
         if params:
             url = f"{url}?{urllib.parse.urlencode(params)}"
+        parts = urllib.parse.urlsplit(url)
+        # Scheme per RFC 3986 is case-insensitive; "HTTPS://…" must select
+        # the TLS connection class like "https://…" does.
+        scheme = parts.scheme.lower()
+        if scheme not in ("http", "https"):
+            raise ClusterAPIError(f"unsupported URL scheme in {url}")
+        host = parts.hostname or ""
+        port = parts.port or (443 if scheme == "https" else 80)
+        path = parts.path or "/"
+        if parts.query:
+            path = f"{path}?{parts.query}"
         hdrs = {**self.headers, **(headers or {})}
         if self.auth and "Authorization" not in hdrs:
             cred = base64.b64encode(f"{self.auth[0]}:{self.auth[1]}".encode()).decode()
             hdrs["Authorization"] = f"Basic {cred}"
         body = data.encode() if isinstance(data, str) else data
-        req = urllib.request.Request(url, data=body, headers=hdrs, method=method)
-        try:
-            # Scheme per RFC 3986 is case-insensitive; startswith("https")
-            # would route "HTTPS://…" to the no-CA opener and fail closed.
-            https = urllib.parse.urlsplit(url).scheme.lower() == "https"
-            with self._get_opener(https).open(req, timeout=timeout) as raw:
-                return _Response(raw.status, raw.read(), url)
-        except urllib.error.HTTPError as exc:
-            # An HTTP error IS a response (3xx included, redirects refused);
-            # surface it through the same raise_for_status contract instead
-            # of a transport exception.
-            with exc:
-                return _Response(exc.code, exc.read(), url)
+        key = (scheme, host, port)
+        retried = False
+        while True:
+            conn, reused = self._acquire(key, timeout)
+            try:
+                conn.request(method, path, body=body, headers=hdrs)
+                raw = conn.getresponse()
+                # Drain the body BEFORE pooling: http.client refuses a new
+                # request while a response is pending on the socket.
+                payload = raw.read()
+            except (
+                http.client.BadStatusLine,  # covers RemoteDisconnected
+                BrokenPipeError,
+                ConnectionResetError,
+                ConnectionAbortedError,
+            ):
+                # The keep-alive peer closed the socket between requests.
+                # Deliberately NOT OSError: a timeout or a refused dial is a
+                # real failure, not a stale pooled socket.
+                conn.close()
+                if reused and method == "GET" and not retried:
+                    # Stale pooled socket on an idempotent request: one
+                    # transparent redial.  Never for PATCH (may have
+                    # applied), never twice, never for a fresh connection.
+                    # Pool-mates idled just as long — flush them so the
+                    # retry dials fresh instead of popping the next corpse.
+                    self._discard_idle(key)
+                    retried = True
+                    continue
+                raise
+            except Exception:
+                conn.close()
+                raise
+            with self._lock:
+                self.requests_sent += 1
+                if reused:
+                    self.requests_reused += 1
+            self._release(key, conn, raw)
+            # Non-2xx needs no exception mapping here: the status (3xx
+            # included — redirects are never followed) rides the _Response
+            # and surfaces through the raise_for_status contract.
+            return _Response(raw.status, payload, url)
 
     def get(self, url, params=None, timeout=None):
         return self._request("GET", url, params=params, timeout=timeout)
@@ -221,13 +351,30 @@ def _materialize(data_b64: str, suffix: str, temp_files: List[str]) -> str:
     return _materialize_bytes(base64.b64decode(data_b64), suffix, temp_files)
 
 
+# Content-addressed materialization cache: (sha256(bytes), suffix) -> path.
+# resolve_cluster_config runs once per watch round; without this, inline
+# ``*-data`` kubeconfigs (the GKE default shape) would mint a NEW temp path
+# every round — so the keep-alive client cache (keyed on the resolved
+# config, credential paths included) would never hit, and /tmp would
+# accumulate one credential file per round until exit.
+_MATERIALIZED: dict = {}
+
+
 def _materialize_bytes(raw: bytes, suffix: str, temp_files: List[str]) -> str:
-    """Write credential bytes to a mode-0600 temp file, return path.
+    """Write credential bytes to a mode-0600 temp file, return path —
+    content-addressed, so identical bytes reuse one stable path per process.
 
     Files hold credential material (client keys), so each is registered for
     unconditional removal at interpreter exit — a cron-driven checker must not
     accumulate key files in /tmp.
     """
+    import hashlib
+
+    cache_key = (hashlib.sha256(raw).hexdigest(), suffix)
+    cached = _MATERIALIZED.get(cache_key)
+    if cached is not None and os.path.exists(cached):
+        temp_files.append(cached)
+        return cached
     fd, path = tempfile.mkstemp(prefix="tpu-node-checker-", suffix=suffix)
     try:
         os.write(fd, raw)
@@ -236,6 +383,7 @@ def _materialize_bytes(raw: bytes, suffix: str, temp_files: List[str]) -> str:
     os.chmod(path, 0o600)
     temp_files.append(path)
     atexit.register(_cleanup_temp, path)
+    _MATERIALIZED[cache_key] = path
     return path
 
 
@@ -487,8 +635,19 @@ class KubeClient:
             )
         return items
 
+    # Events-walk bounds: these fetches run against an API server that is
+    # ALREADY degraded (the node is sick), possibly for several nodes at
+    # once — 10 pages × 100 events is far past any TTL'd per-node stream,
+    # and the hard cap keeps a runaway event storm from turning triage into
+    # more load on the wounded control plane.
+    EVENTS_PAGE_LIMIT = 100
+    EVENTS_MAX_PAGES = 10
+
     def list_node_events(
-        self, name: str, timeout: float = DEFAULT_TIMEOUT_S, limit: int = 20
+        self,
+        name: str,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        limit: int = EVENTS_PAGE_LIMIT,
     ) -> List[dict]:
         """Recent Events for one Node object — the ``kubectl describe node``
         triage block, fetched only for sick nodes under ``--node-events``.
@@ -500,11 +659,11 @@ class KubeClient:
         (410-restart included).  The continue token IS followed to the end
         whenever possible: etcd returns events oldest-first, so abandoning
         the walk early would keep a week-old Normal and drop the fresh
-        SystemOOM that explains the outage.  50 pages (1000 events at the
-        default limit) is far past any TTL'd per-node stream; past it the
-        shortfall is NOTED on stderr — the newest tail may be missing, and
-        pretending otherwise would be worse.  Needs ``events: list`` RBAC
-        (deploy/rbac.yaml).
+        SystemOOM that explains the outage.  ``EVENTS_MAX_PAGES`` pages
+        (1000 events at the default limit) is far past any TTL'd per-node
+        stream; past it the shortfall is NOTED on stderr — the newest tail
+        may be missing, and pretending otherwise would be worse.  Needs
+        ``events: list`` RBAC (deploy/rbac.yaml).
         """
         params = {
             "fieldSelector": (
@@ -513,15 +672,32 @@ class KubeClient:
             "limit": str(limit),
         }
         items, leftover = self._paged_list(
-            "/api/v1/events", params, timeout, max_pages=50
+            "/api/v1/events", params, timeout, max_pages=self.EVENTS_MAX_PAGES
         )
         if leftover:
             print(
-                f"node {name}: event list exceeded 50 pages; the newest "
-                "events may be missing from triage",
+                f"node {name}: event list exceeded {self.EVENTS_MAX_PAGES} "
+                "pages; the newest events may be missing from triage",
                 file=sys.stderr,
             )
         return items
+
+    def transport_stats(self) -> dict:
+        """Connection-pool telemetry from the session, when it keeps any
+        (the stdlib transport does; a drop-in requests.Session reports
+        nothing).  Counters are session-lifetime monotonic."""
+        stats = {}
+        for key in ("connections_opened", "requests_sent", "requests_reused"):
+            value = getattr(self._session, key, None)
+            if isinstance(value, int) and not isinstance(value, bool):
+                stats[key] = value
+        return stats
+
+    def close(self) -> None:
+        """Release pooled connections, when the session pools any."""
+        close = getattr(self._session, "close", None)
+        if callable(close):
+            close()
 
     def cordon_node(self, name: str, timeout: float = DEFAULT_TIMEOUT_S) -> None:
         """``PATCH /api/v1/nodes/{name}`` → ``spec.unschedulable=true``.

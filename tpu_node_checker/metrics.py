@@ -406,6 +406,33 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
                     "Unhealthy hosts beyond the per-host series cap.",
                     [({}, len(unhealthy) - cap)],
                 )
+    transport = payload.get("api_transport")
+    if transport:
+        # Keep-alive pool telemetry (session-lifetime counters): opened
+        # flat + reused climbing = the pooled transport amortizing its
+        # handshakes across watch rounds; opened tracking requests_sent
+        # means the server is dropping keep-alive and every round pays
+        # TCP+TLS again.
+        family(
+            "tpu_node_checker_api_connections_opened_total",
+            "counter",
+            "TCP(+TLS) connections the checker's API session has dialed "
+            "(lifetime of the pooled session).",
+            [({}, transport.get("connections_opened", 0))],
+        )
+        family(
+            "tpu_node_checker_api_requests_total",
+            "counter",
+            "Kubernetes API requests sent over the pooled session.",
+            [({}, transport.get("requests_sent", 0))],
+        )
+        family(
+            "tpu_node_checker_api_requests_reused_total",
+            "counter",
+            "API requests served over an already-open keep-alive "
+            "connection (no handshake paid).",
+            [({}, transport.get("requests_reused", 0))],
+        )
     family(
         "tpu_node_checker_exit_code",
         "gauge",

@@ -149,7 +149,12 @@ REPORT_SPEC: dict = {
     "ring_link_gbps": _NUM_OR_NULL,
     "ring_bad_links": ["str"],
     "ring_err": "str",
-    "collective_legs_ok": {"__values__": "bool"},
+    # Values are bool OR null: a collective probe that CRASHED before
+    # producing per-leg verdicts emits {psum_ok: None, ...} ((coll.details
+    # or {}).get(k) in liveness.py) — that failed-probe report must still
+    # attach and degrade the host, not be refused as a schema violation
+    # (which would silently grade the host HEALTHY).
+    "collective_legs_ok": {"__values__": ("bool", "null")},
     "collective_err": "str",
     "chaos_injected": {"__values__": "str"},
     # The per-axis legs emit null for verdict/topology when the leg itself

@@ -274,12 +274,18 @@ def format_slack_message(
             # surface the top (Warnings-first, newest-first) entry.
             ev = n.events[0]
             # Already whitespace-collapsed and capped by _summarize_events;
-            # only Slack's tighter width applies here.
+            # only Slack's tighter width applies here.  Events need not
+            # carry a reason (only type/message are common to every
+            # writer): fall back to the type, and drop the fragment
+            # entirely rather than render a literal "last event None".
             msg = str(ev.get("message") or "")
-            line += (
-                f" — last event {ev.get('reason')}"
-                + (f": {msg[:90]}{'…' if len(msg) > 90 else ''}" if msg else "")
-            )
+            label = ev.get("reason") or ev.get("type")
+            if label:
+                line += f" — last event {label}" + (
+                    f": {msg[:90]}{'…' if len(msg) > 90 else ''}" if msg else ""
+                )
+            elif msg:
+                line += f" — last event: {msg[:90]}{'…' if len(msg) > 90 else ''}"
         if n.probe is not None and not n.probe.get("ok"):
             # "Failed HOW" is the first question on every alert; the error
             # is truncated so a mass outage still fits Slack's limits.

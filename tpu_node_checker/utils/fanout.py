@@ -1,0 +1,64 @@
+"""Bounded parallel fan-out for per-node Kubernetes API calls.
+
+The checker's per-node loops (``--node-events`` fetches, cordon/uncordon
+PATCHes) were serial: 8 sick nodes × one paged events walk each meant the
+round paid sum(fetches) against an API server that is already degraded.
+This helper runs them through a bounded ``ThreadPoolExecutor`` instead —
+wall-clock ≈ max(single call), concurrency capped by ``--api-concurrency``
+so the checker never becomes its own thundering herd against a wounded
+control plane.
+
+Contract deliberately kept boring so callers stay readable:
+
+* results come back **in input order** (futures are consumed in submission
+  order), so reports and stderr notes stay deterministic regardless of
+  which thread finished first;
+* a worker's exception is CAPTURED, not raised — per-node failures are
+  per-node notes, never fatal to the round (the invariant every caller
+  already holds for its serial loop);
+* ``max_workers <= 1`` (or a single item) degrades to a plain loop — no
+  thread pool, no pool-shutdown latency, identical semantics.
+
+Each worker thread issues its calls through the shared
+:class:`~tpu_node_checker.cluster._StdlibSession`, whose free-list pool
+hands every concurrent worker its own keep-alive connection.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Tuple
+
+DEFAULT_API_CONCURRENCY = 4
+
+
+def bounded_map(
+    fn: Callable, items: Iterable, max_workers: int
+) -> List[Tuple[bool, object]]:
+    """Apply ``fn`` to every item with at most ``max_workers`` in flight.
+
+    Returns ``[(ok, value_or_exception), ...]`` aligned with the input
+    order: ``(True, result)`` for a call that returned, ``(False, exc)``
+    for one that raised.
+    """
+    items = list(items)
+    if not items:
+        return []
+    if max_workers <= 1 or len(items) == 1:
+        out: List[Tuple[bool, object]] = []
+        for item in items:
+            try:
+                out.append((True, fn(item)))
+            except Exception as exc:  # noqa: BLE001 — per-item, never fatal
+                out.append((False, exc))
+        return out
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
+        futures = [pool.submit(fn, item) for item in items]
+        out = []
+        for future in futures:
+            try:
+                out.append((True, future.result()))
+            except Exception as exc:  # noqa: BLE001 — per-item, never fatal
+                out.append((False, exc))
+        return out
