@@ -37,6 +37,15 @@ Keep-alive pool evidence (the transport tentpole):
   behavior, one TLS handshake per page); the fixture server counts accepted
   connections and the run ASSERTS the pooled walk keeps exactly one.
 
+Retry-layer evidence (the graded-retry tentpole):
+
+* the healthy 5k-node walk ASSERTS the retry layer adds zero extra
+  requests (server-side count == pages x rounds) and zero retries;
+* ``nodes5k_fault30_p50_ms`` — the same walk with ~30% of requests hit by
+  injected transient faults (500 / 429+Retry-After / reset): every round
+  must recover within its retry budget with the healthy walk's exact
+  verdict, retries > 0 in the transport telemetry.
+
 Prints ONE JSON line:
   {"metric": "check_latency_p50_ms", "value": <cold e2e p50 ms>, "unit": "ms",
    "vs_baseline": <2000 / p50>,      # >1.0 ⇔ faster than the 2 s target
@@ -298,8 +307,46 @@ def main() -> int:
         result = checker.run_check(big_args)
         big_latencies.append(result.payload["timings_ms"]["total"])
     nodes5k_p50 = statistics.median(big_latencies)
+    # No-fault fast path: with the retry layer ON (default budget), a
+    # healthy walk adds ZERO extra requests — the server saw exactly
+    # pages-per-round × rounds, and the transport counted no retries.
+    assert len(big_requests) == pages * 10, (len(big_requests), pages)
+    assert result.payload["api_transport"]["retries"] == 0, (
+        result.payload["api_transport"]
+    )
     big_server.shutdown()
     os.unlink(big_kubeconfig)
+
+    # Fault-path resilience (the retry tentpole's acceptance shape): the
+    # same 5k-node paged walk with ~30% of arriving requests hit by an
+    # injected transient fault (500 / 429+Retry-After / reset).  Every
+    # round must recover WITHIN its retry budget — same verdict and node
+    # counts as the healthy walk, retries visible in the telemetry — and
+    # the p50 shows what a 30%-degraded apiserver actually costs.
+    checker.reset_client_cache()
+    fault_pattern = ["500", "ok", "ok", "429:0", "ok", "ok", "reset", "ok", "ok"]
+    fault_schedule = fx.FaultSchedule(fault_pattern * 40)  # then healthy
+    fault_server = fx.serve_http(fx.fault_scheduled_handler(big, fault_schedule))
+    fault_kubeconfig = _write_kubeconfig(
+        f"http://127.0.0.1:{fault_server.server_address[1]}"
+    )
+    fault_args = cli.parse_args(["--kubeconfig", fault_kubeconfig, "--json"])
+    fault_latencies = []
+    fault_retries = []
+    for _ in range(5):
+        result = checker.run_check(fault_args)
+        assert result.exit_code == 0, result.exit_code  # recovered, not exit 1
+        assert result.payload["total_nodes"] == 2024, result.payload["total_nodes"]
+        assert result.payload["ready_chips"] == 16 * 256 + 1000 * 8
+        fault_latencies.append(result.payload["timings_ms"]["total"])
+        fault_retries.append(result.payload["api_transport"]["retries"])
+    nodes5k_fault30_p50 = statistics.median(fault_latencies)
+    # Session-lifetime counter climbing every round = the retry layer (not
+    # luck) carried the walk through the fault storm.
+    assert fault_retries[-1] > fault_retries[0] > 0, fault_retries
+    checker.reset_client_cache()
+    fault_server.shutdown()
+    os.unlink(fault_kubeconfig)
 
     # The 5k-node paged walk over HTTPS — where per-page handshakes hurt
     # most (~11 pages/round).  Pooled transport vs the pre-pool equivalent
@@ -395,6 +442,7 @@ def main() -> int:
                     round(warm_tls_p50, 2) if warm_tls_p50 is not None else None
                 ),
                 "nodes5k_paged_internal_p50_ms": round(nodes5k_p50, 2),
+                "nodes5k_fault30_p50_ms": round(nodes5k_fault30_p50, 2),
                 "nodes5k_paged_https_p50_ms": (
                     round(nodes5k_tls_p50, 2) if nodes5k_tls_p50 is not None else None
                 ),

@@ -307,15 +307,171 @@ def json_value_strategy(
     )
 
 
+class FaultSchedule:
+    """Scripted per-request fault sequence for the fake API servers.
+
+    Each arriving request consumes the next fault spec; after the list is
+    exhausted every further request gets ``then`` (default: healthy).  This
+    turns the old single-shot ``FaultyApiServer`` modes into composable
+    scripts — fail-N-then-succeed, 429 + Retry-After, mid-body reset — that
+    the retry tests, the fault-injection suite and bench.py all share.
+
+    Fault specs (strings, optional ``:`` suffix):
+
+    * ``"ok"`` — healthy response;
+    * ``"500"`` / ``"502"`` / ``"503"`` / ``"504"`` — that status with a
+      small Status body;
+    * ``"429"`` / ``"429:N"`` / ``"429:<HTTP-date>"`` — throttle, with the
+      suffix sent as a ``Retry-After`` header (``"503:N"`` works too);
+    * ``"reset"`` — RST the connection before any response bytes;
+    * ``"close"`` — close cleanly without responding (stale-socket shape);
+    * ``"mid_body_reset"`` — send headers + half the body, then slam;
+    * ``"garbage_json"`` — HTTP 200, non-JSON body (broken proxy);
+    * ``"slow:N"`` — trickle one byte then stall N seconds (client timeout).
+
+    Thread-safe (the threaded fixture server handles connections in
+    parallel); ``served`` records what each request actually got, in
+    arrival order — the ground truth retry tests assert against.
+    """
+
+    def __init__(self, faults: Optional[List[str]] = None, then: str = "ok"):
+        import threading
+
+        self._faults = list(faults or [])
+        self._then = then
+        self.served: List[str] = []
+        self._lock = threading.Lock()
+
+    def next(self) -> str:
+        with self._lock:
+            fault = self._faults.pop(0) if self._faults else self._then
+            self.served.append(fault)
+            return fault
+
+
+def _paged_nodelist_body(
+    nodes: List[dict], path: str, requests_seen: Optional[list]
+) -> bytes:
+    """The fake apiserver's ``limit``/``continue`` paging protocol — ONE
+    definition shared by :func:`paged_nodelist_handler` and
+    :func:`fault_scheduled_handler`, so the fault-injection/bench path can
+    never drift onto a different protocol than the pagination tests pin.
+    ``requests_seen`` (optional list) records each request's start offset."""
+    import json as _json
+    from urllib.parse import parse_qs, urlparse
+
+    q = parse_qs(urlparse(path).query)
+    limit = int(q.get("limit", [str(len(nodes) or 1)])[0])
+    start = int(q.get("continue", ["0"])[0])
+    if requests_seen is not None:
+        requests_seen.append(start)
+    doc = {"kind": "NodeList", "items": nodes[start:start + limit]}
+    if start + limit < len(nodes):
+        doc["metadata"] = {"continue": str(start + limit)}
+    return _json.dumps(doc).encode()
+
+
+def fault_scheduled_handler(
+    nodes: List[dict],
+    schedule: FaultSchedule,
+    requests_seen: Optional[list] = None,
+    patches_seen: Optional[list] = None,
+):
+    """Paged-NodeList handler with a :class:`FaultSchedule` in front.
+
+    Healthy requests serve ``nodes`` through :func:`_paged_nodelist_body`
+    (the same ``limit``/``continue`` pagination as
+    :func:`paged_nodelist_handler`); PATCHes (recorded in ``patches_seen``
+    as ``(path, body_bytes)``) answer ``{}``.  Every arriving request —
+    method, path, retry or not — consumes one schedule entry, so a
+    schedule's length IS the server-side request count the non-duplication
+    tests pin.
+    """
+    import socket as _socket
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _ok_body(self) -> bytes:
+            return _paged_nodelist_body(nodes, self.path, requests_seen)
+
+        def _respond(self, status: int, body: bytes, extra=None):
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _rst(self):
+            # RST instead of FIN: connection reset by peer, no response.
+            self.connection.setsockopt(
+                _socket.SOL_SOCKET, _socket.SO_LINGER,
+                b"\x01\x00\x00\x00\x00\x00\x00\x00",
+            )
+            self.connection.close()
+            self.close_connection = True
+
+        def _serve(self, ok_body: bytes):
+            fault = schedule.next()
+            kind, _, arg = fault.partition(":")
+            if kind == "ok":
+                self._respond(200, ok_body)
+            elif kind in ("500", "502", "503", "504", "429"):
+                extra = {"Retry-After": arg} if arg else None
+                body = (
+                    b'{"kind":"Status","message":"injected transient fault"}'
+                )
+                self._respond(int(kind), body, extra)
+            elif kind == "reset":
+                self._rst()
+            elif kind == "close":
+                self.close_connection = True  # FIN without a response
+            elif kind == "mid_body_reset":
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(ok_body)))
+                self.end_headers()
+                self.wfile.write(ok_body[: len(ok_body) // 2])
+                self.wfile.flush()
+                self._rst()
+            elif kind == "garbage_json":
+                self._respond(200, b"<html>proxy error</html>")
+            elif kind == "slow":
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(ok_body)))
+                self.end_headers()
+                self.wfile.write(ok_body[:1])
+                self.wfile.flush()
+                import time as _t
+
+                _t.sleep(float(arg or 10))
+            else:
+                raise AssertionError(f"unknown fault spec {fault!r}")
+
+        def do_GET(self):
+            self._serve(self._ok_body())
+
+        def do_PATCH(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if patches_seen is not None:
+                patches_seen.append((self.path, body))
+            self._serve(b"{}")
+
+        def log_message(self, *args):
+            pass
+
+    return Handler
+
+
 def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = None):
     """Handler class serving ``nodes`` as a NodeList with ``limit``/
-    ``continue`` pagination — the single definition of the fake API
-    server's paging semantics, shared by the pagination tests and
-    ``bench.py``'s 5k-node run.  ``requests_seen`` (optional list) records
-    each request's start offset."""
-    import json as _json
+    ``continue`` pagination — the paging semantics live in
+    :func:`_paged_nodelist_body` (shared with the fault-injecting handler),
+    used by the pagination tests and ``bench.py``'s 5k-node run.
+    ``requests_seen`` (optional list) records each request's start offset."""
     from http.server import BaseHTTPRequestHandler
-    from urllib.parse import parse_qs, urlparse
 
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 so the checker's keep-alive pool can actually reuse the
@@ -324,15 +480,7 @@ def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = No
         protocol_version = "HTTP/1.1"
 
         def do_GET(self):
-            q = parse_qs(urlparse(self.path).query)
-            limit = int(q.get("limit", [str(len(nodes) or 1)])[0])
-            start = int(q.get("continue", ["0"])[0])
-            if requests_seen is not None:
-                requests_seen.append(start)
-            doc = {"kind": "NodeList", "items": nodes[start:start + limit]}
-            if start + limit < len(nodes):
-                doc["metadata"] = {"continue": str(start + limit)}
-            body = _json.dumps(doc).encode()
+            body = _paged_nodelist_body(nodes, self.path, requests_seen)
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -10,76 +10,45 @@ failure never changes the exit code.
 """
 
 import json
-import socket
-import threading
-from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import pytest
 
 from tests import fixtures as fx
 from tpu_node_checker import checker, cli
 from tpu_node_checker.probe import run_local_probe
+from tpu_node_checker.utils import retry as retry_mod
+
+
+@pytest.fixture(autouse=True)
+def _sleepless_retries(monkeypatch):
+    """The graded retry layer is ON by default now; its backoff sleeps must
+    not slow this suite down.  The module seam makes every policy's sleeps
+    free while keeping request/attempt behavior identical."""
+    monkeypatch.setattr(retry_mod, "_sleep", lambda s: None)
 
 
 class FaultyApiServer:
-    """HTTP server with a programmable failure mode per instance."""
+    """HTTP server with a programmable failure mode per instance — a thin
+    wrapper over the scripted fault schedules in tests/fixtures.py (the
+    single-shot legacy modes are just schedules whose every entry is the
+    same fault)."""
 
-    def __init__(self, mode, payload=None):
-        self.mode = mode
-        self.payload = payload or json.dumps(fx.node_list(fx.gpu_pool(1))).encode()
-        outer = self
+    MODES = {
+        "http_500": "500",
+        "garbage_json": "garbage_json",
+        "truncated": "mid_body_reset",
+        "reset": "reset",
+        "slow": "slow:10",
+        "ok": "ok",
+    }
 
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if outer.mode == "http_500":
-                    body = b'{"kind":"Status","message":"etcdserver: timeout"}'
-                    self.send_response(500)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                elif outer.mode == "garbage_json":
-                    body = b"<html>proxy error</html>"
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                elif outer.mode == "truncated":
-                    # Advertise more bytes than are sent, then slam the socket.
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(outer.payload) + 999))
-                    self.end_headers()
-                    self.wfile.write(outer.payload[: len(outer.payload) // 2])
-                    self.wfile.flush()
-                    self.connection.close()
-                elif outer.mode == "reset":
-                    # RST instead of a response: connection reset by peer.
-                    self.connection.setsockopt(
-                        socket.SOL_SOCKET, socket.SO_LINGER,
-                        b"\x01\x00\x00\x00\x00\x00\x00\x00",
-                    )
-                    self.connection.close()
-                elif outer.mode == "slow":
-                    # Trickle one byte, then stall past the client timeout.
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(outer.payload)))
-                    self.end_headers()
-                    self.wfile.write(outer.payload[:1])
-                    self.wfile.flush()
-                    import time as _t
-
-                    _t.sleep(10)
-                else:  # "ok"
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(outer.payload)))
-                    self.end_headers()
-                    self.wfile.write(outer.payload)
-
-            def log_message(self, *args):
-                pass
-
-        self.server = HTTPServer(("127.0.0.1", 0), Handler)
-        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+    def __init__(self, mode, nodes=None):
+        self.schedule = fx.FaultSchedule([], then=self.MODES.get(mode, mode))
+        self.server = fx.serve_http(
+            fx.fault_scheduled_handler(
+                fx.gpu_pool(1) if nodes is None else nodes, self.schedule
+            )
+        )
 
     @property
     def port(self):
@@ -211,3 +180,177 @@ class TestSlackFaultIsolation:
         finally:
             srv.close()
         assert code == 3  # the cluster verdict, not the webhook's
+
+
+class TestGradedRetryRecovery:
+    """Acceptance: transient faults recoverable within budget leave the
+    verdict and payload matching the fault-free run (retries counted in the
+    transport telemetry); an exhausted budget still lands on exit 1 with a
+    machine-readable error — the documented contract, unchanged."""
+
+    NODES = fx.tpu_v5p_64_slice()[:8]  # an 8-node run
+
+    def _run(self, tmp_path, capsys, schedule, extra_flags=()):
+        srv = fx.serve_http(fx.fault_scheduled_handler(self.NODES, schedule))
+        try:
+            code = cli.main(
+                ["--json", *extra_flags,
+                 "--kubeconfig",
+                 kubeconfig_for(tmp_path, srv.server_address[1])]
+            )
+            payload = json.loads(capsys.readouterr().out)
+        finally:
+            srv.shutdown()
+            checker.reset_client_cache()
+        return code, payload
+
+    def test_recoverable_faults_same_grade_and_payload_as_fault_free(
+        self, tmp_path, capsys
+    ):
+        code, control = self._run(tmp_path, capsys, fx.FaultSchedule([]))
+        assert code == 0
+        assert control["api_transport"]["retries"] == 0
+        assert "degraded" not in control  # fault-free: no degradation key
+
+        # One reset (first — on a FRESH connection, so it exercises the
+        # retry layer rather than the transport's reused-socket redial),
+        # one 500, one throttle (Retry-After: 0) — all absorbed within the
+        # default budget; the 4th request succeeds.
+        faulted_schedule = fx.FaultSchedule(["reset", "500", "429:0"])
+        code2, faulted = self._run(tmp_path, capsys, faulted_schedule)
+        assert code2 == 0
+        assert faulted["api_transport"]["retries"] >= 3
+        for key in ("exit_code", "total_nodes", "ready_nodes", "total_chips",
+                    "ready_chips", "nodes", "slices"):
+            assert faulted[key] == control[key], key
+        assert "degraded" not in faulted  # the LIST recovered fully
+
+    def test_exhausted_budget_exits_1_with_machine_readable_error(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        # Fake clock end-to-end: sleeps advance it, the budget reads it.
+        clock = {"t": 0.0}
+        monkeypatch.setattr(
+            retry_mod, "_sleep",
+            lambda s: clock.__setitem__("t", clock["t"] + s),
+        )
+        monkeypatch.setattr(retry_mod, "_monotonic", lambda: clock["t"])
+        # Persistent 500s against a budget smaller than the first backoff:
+        # the first grant drains it, the second failure finds it dry.
+        schedule = fx.FaultSchedule([], then="500")
+        code, payload = self._run(
+            tmp_path, capsys, schedule, extra_flags=("--retry-budget", "0.001")
+        )
+        assert code == 1
+        assert "error" in payload and "500" in payload["error"]
+        # Budget (not the per-call attempt cap) ended the sequence: the
+        # server saw exactly two requests, not DEFAULT_MAX_ATTEMPTS.
+        assert schedule.served == ["500", "500"]
+
+    def test_retry_budget_zero_disables_retries(self, tmp_path, capsys):
+        schedule = fx.FaultSchedule([], then="500")
+        code, payload = self._run(
+            tmp_path, capsys, schedule, extra_flags=("--retry-budget", "0")
+        )
+        assert code == 1
+        assert "error" in payload
+        assert schedule.served == ["500"]  # one shot, the pre-retry contract
+
+    def test_retry_budget_flag_validation(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            cli.parse_args(["--retry-budget", "-1"])
+        assert e.value.code == 2
+        assert "--retry-budget" in capsys.readouterr().err
+        assert cli.parse_args(["--retry-budget", "0"]).retry_budget == 0.0
+
+
+class TestPartialDegradation:
+    """Transient failures in NON-essential phases (events fetch, cordon /
+    uncordon sweeps) mark the round ``degraded: true`` with per-phase error
+    detail — the verdict and exit code stand; only a failed initial node
+    LIST keeps the exit-1 contract."""
+
+    def test_events_fetch_failure_degrades_round_not_exit_code(
+        self, monkeypatch, capsys
+    ):
+        from tpu_node_checker.cluster import ClusterAPIError
+
+        class FlakyEventsClient:
+            def list_node_events(self, name, timeout=None, limit=100):
+                raise ClusterAPIError("HTTP 503: events backend down", 503)
+
+        monkeypatch.setattr(
+            checker, "_resolve_client", lambda args, client: FlakyEventsClient()
+        )
+        args = cli.parse_args(["--node-events", "--json"])
+        result = checker.run_check(args, nodes=fx.tpu_v5p_64_slice(not_ready=2))
+        assert result.exit_code == 0  # 14 Ready hosts: the verdict stands
+        assert result.payload["degraded"] is True
+        events_errors = result.payload["degradation"]["events"]
+        assert len(events_errors) == 2
+        assert all("503" in e for e in events_errors)
+        capsys.readouterr()
+
+    def test_no_cluster_client_for_events_degrades(self, monkeypatch, capsys):
+        def no_client(args, client):
+            raise RuntimeError("no kubeconfig anywhere")
+
+        monkeypatch.setattr(checker, "_resolve_client", no_client)
+        args = cli.parse_args(["--node-events", "--json"])
+        result = checker.run_check(args, nodes=fx.tpu_v5p_64_slice(not_ready=1))
+        assert result.exit_code == 0
+        assert result.payload["degraded"] is True
+        assert "no cluster client" in result.payload["degradation"]["events"][0]
+        capsys.readouterr()
+
+    def test_cordon_patch_failure_degrades_round(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        class DeadPatchClient:
+            def cordon_node(self, name, timeout=None):
+                raise ConnectionResetError("PATCH socket died")
+
+        monkeypatch.setattr(
+            checker, "_resolve_client", lambda args, client: DeadPatchClient()
+        )
+        reports = tmp_path / "reports"
+        reports.mkdir()
+        (reports / "gke-tpu-v5p-0.json").write_text(
+            json.dumps({"ok": False, "level": "compute",
+                        "hostname": "gke-tpu-v5p-0", "error": "chips dead"})
+        )
+        args = cli.parse_args(
+            ["--probe-results", str(reports), "--cordon-failed", "--json"]
+        )
+        result = checker.run_check(args, nodes=fx.tpu_v5p_64_slice())
+        assert result.exit_code == 0  # 15 healthy hosts: verdict stands
+        assert result.payload["degraded"] is True
+        assert "gke-tpu-v5p-0" in result.payload["degradation"]["cordon"][0]
+        assert result.payload["cordon"]["failed"]  # detail preserved too
+        capsys.readouterr()
+
+    def test_healthy_round_has_no_degradation_keys(self, capsys):
+        result = checker.run_check(
+            cli.parse_args(["--json"]), nodes=fx.tpu_v5p_64_slice()
+        )
+        assert "degraded" not in result.payload
+        assert "degradation" not in result.payload
+        capsys.readouterr()
+
+    def test_degraded_round_flagged_in_state_log(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        def no_client(args, client):
+            raise RuntimeError("unreachable")
+
+        monkeypatch.setattr(checker, "_resolve_client", no_client)
+        log = tmp_path / "trend.jsonl"
+        args = cli.parse_args(
+            ["--node-events", "--json", "--log-jsonl", str(log)]
+        )
+        code = checker.one_shot(args, nodes=fx.tpu_v5p_64_slice(not_ready=1))
+        assert code == 0
+        (entry,) = [json.loads(x) for x in log.read_text().splitlines()]
+        assert entry["degraded"] is True
+        assert entry["exit_code"] == 0
+        capsys.readouterr()

@@ -35,6 +35,46 @@ class TestRenderMetrics:
         assert ('tpu_node_checker_slice_ready_chips{nodepool="v5p-pool",'
                 'slice="v5p-pool",topology="4x4x4"} 56') in text
 
+    def test_retry_and_degraded_families(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["api_transport"] = {
+            "connections_opened": 1,
+            "requests_sent": 5,
+            "requests_reused": 4,
+            "retries": 3,
+            "retries_by_reason": {"http_500": 2, "connection_reset": 1},
+        }
+        result.payload["degraded"] = True
+        text = render_metrics(result)
+        assert 'tpu_node_checker_api_retries_total{reason="http_500"} 2' in text
+        assert 'tpu_node_checker_api_retries_total{reason="connection_reset"} 1' in text
+        assert "# TYPE tpu_node_checker_api_retries_total counter" in text
+        assert "tpu_node_checker_round_degraded 1.0" in text
+
+    def test_zero_retries_render_as_zero_not_vanished(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["api_transport"] = {
+            "connections_opened": 1,
+            "requests_sent": 1,
+            "requests_reused": 0,
+            "retries": 0,
+        }
+        text = render_metrics(result)
+        # A healthy round must still emit the family (return-to-zero reads
+        # as recovery, a vanished series reads as nothing).
+        assert 'tpu_node_checker_api_retries_total{reason="none"} 0' in text
+        assert "tpu_node_checker_round_degraded 0.0" in text
+
+    def test_breaker_gauges_rendered_when_state_supplied(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        text = render_metrics(
+            result, breaker={"open": True, "consecutive_failures": 4}
+        )
+        assert "tpu_node_checker_watch_breaker_open 1.0" in text
+        assert "tpu_node_checker_watch_breaker_consecutive_failures 4.0" in text
+        # No breaker state (one-shot renders): no breaker families.
+        assert "watch_breaker" not in render_metrics(result)
+
     def test_probe_telemetry_exported(self):
         result = self._result(fx.tpu_v5e_256_slice())
         result.payload["local_probe"] = {

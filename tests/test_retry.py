@@ -1,0 +1,493 @@
+"""utils/retry.py: classifier, Retry-After, budget, backoff — and the
+transport-level idempotency gate.
+
+Every test here runs on an INJECTED fake clock (policy constructor seams or
+the retry module's ``_sleep``/``_monotonic``/``_wall_now`` globals); the
+autouse guard asserts the suite adds no real sleeps — a backoff that reaches
+``time.sleep`` is a bug in the test *and* a regression risk for the suite's
+runtime.
+"""
+
+import http.client
+import json
+import socket
+import time
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import cluster
+from tpu_node_checker.utils import retry as retry_mod
+from tpu_node_checker.utils.retry import (
+    DEFAULT_MAX_ATTEMPTS,
+    RetryBudget,
+    RetryPolicy,
+    classify_retriable,
+    parse_retry_after,
+    status_retry_reason,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_real_sleeps(monkeypatch):
+    """Wall-clock guard: retry logic must never hit the real sleep from a
+    test — the module seam is replaced with a tripwire, and the whole test
+    is timed (sockets and fakes are milliseconds; a leaked backoff is not).
+    """
+    def _trip(seconds):
+        raise AssertionError(
+            f"retry code reached the REAL sleep ({seconds}s) — inject a fake"
+        )
+
+    monkeypatch.setattr(retry_mod, "_sleep", _trip)
+    t0 = time.perf_counter()
+    yield
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"retry test burned {elapsed:.2f}s of wall-clock"
+
+
+class FakeClock:
+    """Injected time source: sleep() advances monotonic, nothing is real."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def sleep(self, seconds):
+        self.sleeps.append(seconds)
+        self.t += seconds
+
+    def monotonic(self):
+        return self.t
+
+
+def _policy(clock, budget_s=30.0, jitter_hi=True, **kw):
+    """Deterministic policy: jitter pinned to the interval's top (uniform →
+    upper bound) so backoff sequences are exact."""
+    return RetryPolicy(
+        budget=RetryBudget(budget_s),
+        sleep=clock.sleep,
+        monotonic=clock.monotonic,
+        uniform=(lambda a, b: b) if jitter_hi else (lambda a, b: a),
+        **kw,
+    )
+
+
+class TestClassifier:
+    @pytest.mark.parametrize(
+        "exc,reason",
+        [
+            (ConnectionRefusedError(), "connect_refused"),
+            (ConnectionResetError(), "connection_reset"),
+            (ConnectionAbortedError(), "connection_reset"),
+            (BrokenPipeError(), "connection_reset"),
+            (http.client.BadStatusLine(""), "connection_reset"),
+            (http.client.RemoteDisconnected(""), "connection_reset"),
+            (http.client.IncompleteRead(b"x"), "connection_reset"),
+            (socket.timeout(), "timeout"),
+            (TimeoutError(), "timeout"),
+            (cluster.ClusterAPIError("x", status_code=429), "http_429"),
+            (cluster.ClusterAPIError("x", status_code=500), "http_500"),
+            (cluster.ClusterAPIError("x", status_code=503), "http_503"),
+        ],
+    )
+    def test_retriable(self, exc, reason):
+        assert classify_retriable(exc) == reason
+
+    @pytest.mark.parametrize(
+        "exc",
+        [
+            ValueError("not transport"),
+            json.JSONDecodeError("x", "y", 0),  # a proxy's HTML is config, not a blip
+            cluster.ClusterAPIError("x", status_code=404),
+            cluster.ClusterAPIError("x", status_code=403),
+            cluster.ClusterAPIError("x", status_code=410),  # pagination owns 410
+            cluster.ClusterAPIError("no status"),
+            OSError("generic"),
+        ],
+    )
+    def test_not_retriable(self, exc):
+        assert classify_retriable(exc) is None
+
+    def test_requests_style_response_status_read(self):
+        # A drop-in requests.HTTPError carries status on .response, not on
+        # the exception itself.
+        class Resp:
+            status_code = 502
+
+        class HTTPErrorLike(Exception):
+            response = Resp()
+
+        assert classify_retriable(HTTPErrorLike()) == "http_502"
+
+    def test_status_reason_labels(self):
+        assert status_retry_reason(429) == "http_429"
+        assert status_retry_reason(502) == "http_502"
+        assert status_retry_reason(200) is None
+        assert status_retry_reason(410) is None
+
+
+class TestRetryAfter:
+    def test_delta_seconds(self):
+        assert parse_retry_after("7") == 7.0
+        assert parse_retry_after(" 0 ") == 0.0
+
+    def test_http_date(self):
+        # Injected wall clock: 30s before the stamped date.
+        now = 784111777.0 - 30.0
+        assert parse_retry_after("Sun, 06 Nov 1994 08:49:37 GMT", now=now) == 30.0
+
+    def test_past_http_date_clamps_to_zero(self):
+        now = 784111777.0 + 3600.0
+        assert parse_retry_after("Sun, 06 Nov 1994 08:49:37 GMT", now=now) == 0.0
+
+    @pytest.mark.parametrize("raw", [None, "", "soon", "12.5.3", "garbage GMT"])
+    def test_unparseable_degrades_to_none(self, raw):
+        assert parse_retry_after(raw, now=0.0) is None
+
+
+class TestRetryBudget:
+    def test_grant_clips_and_exhausts(self):
+        b = RetryBudget(1.0)
+        assert b.grant(0.4) == 0.4
+        assert b.grant(10.0) == pytest.approx(0.6)  # clipped to what remains
+        assert b.exhausted
+        assert b.grant(0.1) == 0.0  # nothing left, caller must stop
+
+    def test_charge_counts_attempt_cost(self):
+        b = RetryBudget(2.0)
+        b.charge(1.5)  # a failed re-attempt's wall-clock
+        assert b.remaining == pytest.approx(0.5)
+        b.charge(1.0)
+        assert b.exhausted
+
+    def test_zero_budget_grants_nothing(self):
+        b = RetryBudget(0.0)
+        assert b.exhausted
+        assert b.grant(0.1) == 0.0
+
+
+class TestBackoffPolicy:
+    def test_full_jitter_exponential_sequence_capped(self):
+        clock = FakeClock()
+        # Jitter pinned to the ceiling: 0.1, 0.2, 0.4, then the 0.5 cap.
+        p = RetryPolicy(
+            budget=RetryBudget(30.0), max_attempts=6,
+            sleep=clock.sleep, monotonic=clock.monotonic,
+            uniform=lambda a, b: b, max_delay_s=0.5,
+        )
+        delays = [p.plan_retry(i, "http_500") for i in range(5)]
+        assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+    def test_jitter_floor_is_zero(self):
+        clock = FakeClock()
+        p = _policy(clock, jitter_hi=False)  # uniform → lower bound
+        assert p.plan_retry(0, "http_500") == 0.0  # full jitter reaches 0
+
+    def test_attempt_cap_ends_the_sequence(self):
+        clock = FakeClock()
+        p = _policy(clock)
+        assert p.plan_retry(DEFAULT_MAX_ATTEMPTS - 1, "http_500") is None
+
+    def test_budget_exhaustion_ends_the_sequence(self):
+        clock = FakeClock()
+        p = _policy(clock, budget_s=0.15)
+        assert p.plan_retry(0, "http_500") == 0.1
+        # Remaining 0.05 < the 0.2 ask: granted what's left, then dry.
+        assert p.plan_retry(1, "http_500") == pytest.approx(0.05)
+        assert p.plan_retry(2, "http_500") is None
+
+    def test_retry_after_sets_the_floor(self):
+        clock = FakeClock()
+        p = _policy(clock)
+        # Backoff ceiling for attempt 0 is 0.1; the server said 1s — obey.
+        assert p.plan_retry(0, "http_429", retry_after=1.0) == 1.0
+
+    def test_unhonorable_retry_after_stops_retrying(self):
+        clock = FakeClock()
+        p = _policy(clock, budget_s=0.5)
+        # The server demands 60s; the budget cannot honor it — fail NOW
+        # rather than sleep less and re-trip the throttle.
+        assert p.plan_retry(0, "http_429", retry_after=60.0) is None
+
+    def test_wait_uses_injected_sleep_only(self):
+        clock = FakeClock()
+        p = _policy(clock)
+        p.wait(1.25)
+        assert clock.sleeps == [1.25]
+        assert clock.t == 1.25
+
+
+class _CountingSession(cluster._StdlibSession):
+    """Stdlib session whose _attempt is scripted: raises/returns from a
+    queue, counting attempts — the retry loop tested without sockets."""
+
+    def __init__(self, script):
+        super().__init__()
+        self.script = list(script)
+        self.attempts = 0
+
+    def _attempt(self, method, key, path, body, hdrs, timeout, url):
+        self.attempts += 1
+        item = self.script.pop(0)
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+
+def _resp(status, headers=None):
+    return cluster._Response(status, b"{}", "http://x/", headers=headers or {})
+
+
+class TestTransportRetryLoop:
+    def _session(self, script, clock, budget_s=30.0, **kw):
+        s = _CountingSession(script)
+        s.retry_policy = RetryPolicy(
+            budget=RetryBudget(budget_s), sleep=clock.sleep,
+            monotonic=clock.monotonic, uniform=lambda a, b: b, **kw,
+        )
+        return s
+
+    def test_get_retries_transient_exception_then_succeeds(self):
+        clock = FakeClock()
+        s = self._session([ConnectionResetError(), _resp(200)], clock)
+        assert s.get("http://h/x", timeout=5).status_code == 200
+        assert s.attempts == 2
+        assert s.retries == 1
+        assert s.retries_by_reason == {"connection_reset": 1}
+        assert clock.sleeps == [0.1]
+
+    def test_get_retries_5xx_status_then_succeeds(self):
+        clock = FakeClock()
+        s = self._session([_resp(500), _resp(503), _resp(200)], clock)
+        assert s.get("http://h/x", timeout=5).status_code == 200
+        assert s.retries_by_reason == {"http_500": 1, "http_503": 1}
+        assert clock.sleeps == [0.1, 0.2]
+
+    def test_429_retry_after_header_honored(self):
+        clock = FakeClock()
+        s = self._session(
+            [_resp(429, {"retry-after": "3"}), _resp(200)], clock
+        )
+        assert s.get("http://h/x", timeout=5).status_code == 200
+        assert clock.sleeps == [3.0]  # server floor beats the 0.1 backoff
+
+    def test_attempts_exhausted_returns_last_response(self):
+        clock = FakeClock()
+        s = self._session([_resp(500)] * DEFAULT_MAX_ATTEMPTS, clock)
+        resp = s.get("http://h/x", timeout=5)
+        assert resp.status_code == 500  # surfaces through raise_for_status
+        assert s.attempts == DEFAULT_MAX_ATTEMPTS
+        with pytest.raises(cluster.ClusterAPIError):
+            resp.raise_for_status()
+
+    def test_exception_after_attempts_exhausted_propagates(self):
+        clock = FakeClock()
+        s = self._session([ConnectionResetError()] * DEFAULT_MAX_ATTEMPTS, clock)
+        with pytest.raises(ConnectionResetError):
+            s.get("http://h/x", timeout=5)
+        assert s.attempts == DEFAULT_MAX_ATTEMPTS
+
+    def test_non_retriable_error_raises_immediately(self):
+        clock = FakeClock()
+        s = self._session([ValueError("boom"), _resp(200)], clock)
+        with pytest.raises(ValueError):
+            s.get("http://h/x", timeout=5)
+        assert s.attempts == 1
+        assert s.retries == 0
+
+    def test_non_retriable_status_returns_immediately(self):
+        clock = FakeClock()
+        s = self._session([_resp(404), _resp(200)], clock)
+        assert s.get("http://h/x", timeout=5).status_code == 404
+        assert s.attempts == 1
+
+    def test_patch_never_retried_on_sent_request_failure(self):
+        # The socket died AFTER the request may have left: re-sending could
+        # double-apply — the error surfaces, attempt count stays 1.
+        clock = FakeClock()
+        s = self._session([ConnectionResetError(), _resp(200)], clock)
+        with pytest.raises(ConnectionResetError):
+            s.patch("http://h/x", data="{}", timeout=5)
+        assert s.attempts == 1
+        assert s.retries == 0
+
+    def test_patch_5xx_response_not_retried(self):
+        # A 500 to a PATCH is ambiguous (may have half-applied): strict
+        # gating returns it to the caller, never re-sends.
+        clock = FakeClock()
+        s = self._session([_resp(500), _resp(200)], clock)
+        assert s.patch("http://h/x", data="{}", timeout=5).status_code == 500
+        assert s.attempts == 1
+
+    def test_patch_retried_when_provably_never_sent(self):
+        clock = FakeClock()
+        exc = ConnectionRefusedError()
+        exc.request_never_sent = True  # the transport's connect-phase tag
+        s = self._session([exc, _resp(200)], clock)
+        assert s.patch("http://h/x", data="{}", timeout=5).status_code == 200
+        assert s.retries_by_reason == {"connect_refused": 1}
+
+    def test_timeout_attempt_cost_charged_to_budget(self):
+        # Each failed attempt's wall-clock counts as retry overhead: a
+        # server that eats a 5s timeout per attempt exhausts an 8s budget
+        # after ONE retry — never four.
+        clock = FakeClock()
+
+        class TimeoutScript(_CountingSession):
+            def _attempt(self, *a, **kw):
+                self.attempts += 1
+                clock.t += 5.0  # the attempt itself burned 5s
+                raise socket.timeout()
+
+        s = TimeoutScript([])
+        s.retry_policy = RetryPolicy(
+            budget=RetryBudget(8.0), sleep=clock.sleep,
+            monotonic=clock.monotonic, uniform=lambda a, b: b,
+        )
+        with pytest.raises(socket.timeout):
+            s.get("http://h/x", timeout=5)
+        # Attempt 1 fails (5s charged) → retry; attempt 2 fails (10s total
+        # charged > 8s budget) → budget dry, no third attempt.
+        assert s.attempts == 2
+
+    def test_slow_error_response_cost_charged_to_budget(self):
+        # Same invariant on the STATUS path: a 500 the server took 5s to
+        # emit is retry overhead too — an 8s budget allows one retry, not
+        # a full attempt-cap's worth of 5s failures.
+        clock = FakeClock()
+
+        class SlowErrorScript(_CountingSession):
+            def _attempt(self, *a, **kw):
+                self.attempts += 1
+                clock.t += 5.0  # the server dribbled the error out slowly
+                return _resp(500)
+
+        s = SlowErrorScript([])
+        s.retry_policy = RetryPolicy(
+            budget=RetryBudget(8.0), sleep=clock.sleep,
+            monotonic=clock.monotonic, uniform=lambda a, b: b,
+        )
+        resp = s.get("http://h/x", timeout=5)
+        assert resp.status_code == 500
+        assert s.attempts == 2  # budget (10s charged > 8s), not the cap (4)
+
+    def test_no_policy_means_no_retry_no_overhead(self):
+        s = _CountingSession([ConnectionResetError(), _resp(200)])
+        assert s.retry_policy is None
+        with pytest.raises(ConnectionResetError):
+            s.get("http://h/x", timeout=5)
+        assert s.attempts == 1
+
+
+class TestSharedBudgetAcrossWorkers:
+    def test_fanout_workers_draw_from_one_budget(self):
+        # Two "workers" (sequential here; the budget is the shared object)
+        # against a budget that covers only the first one's retries: the
+        # second stops immediately instead of doubling the round's worst
+        # case — a retrying worker can't hold its pool slot past the budget.
+        clock = FakeClock()
+        budget = RetryBudget(0.1)
+        policy = RetryPolicy(
+            budget=budget, sleep=clock.sleep, monotonic=clock.monotonic,
+            uniform=lambda a, b: b,
+        )
+        first = _CountingSession([ConnectionResetError(), _resp(200)])
+        second = _CountingSession([ConnectionResetError(), _resp(200)])
+        first.retry_policy = policy
+        second.retry_policy = policy
+        assert first.get("http://h/x", timeout=5).status_code == 200
+        assert budget.exhausted
+        with pytest.raises(ConnectionResetError):
+            second.get("http://h/x", timeout=5)
+        assert second.attempts == 1
+
+
+class TestPatchNonDuplicationServerSide:
+    """Satellite: under injected mid-request connection drops, a cordon
+    PATCH is never sent twice — counted on the SERVER side."""
+
+    def test_cordon_patch_arrives_exactly_once_on_mid_request_drop(self):
+        patches = []
+        # First PATCH: received, then the socket is slammed with no
+        # response.  The trap: if the client (wrongly) re-sent, the second
+        # request would get "ok" and patches would count 2.
+        schedule = fx.FaultSchedule(["reset"], then="ok")
+        srv = fx.serve_http(
+            fx.fault_scheduled_handler([], schedule, patches_seen=patches)
+        )
+        try:
+            cfg = cluster.ClusterConfig(
+                server=f"http://127.0.0.1:{srv.server_address[1]}"
+            )
+            client = cluster.KubeClient(cfg)
+            clock = FakeClock()
+            client.set_retry_policy(
+                RetryPolicy(
+                    budget=RetryBudget(30.0), sleep=clock.sleep,
+                    monotonic=clock.monotonic,
+                )
+            )
+            with pytest.raises(Exception):
+                client.cordon_node("tpu-0", timeout=5)
+            assert len(patches) == 1  # arrived once, NEVER re-sent
+            assert client.transport_stats()["retries"] == 0
+            client.close()
+        finally:
+            srv.shutdown()
+
+    def test_patch_connect_refused_is_retried_never_duplicated(self):
+        # Nothing listens on the port: every connect is refused before any
+        # byte leaves the socket — the ONE PATCH failure mode that is
+        # safely retriable, and the transport tags it as provably unsent.
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()  # freed: connects now refuse
+        cfg = cluster.ClusterConfig(server=f"http://127.0.0.1:{port}")
+        client = cluster.KubeClient(cfg)
+        clock = FakeClock()
+        client.set_retry_policy(
+            RetryPolicy(
+                budget=RetryBudget(30.0), sleep=clock.sleep,
+                monotonic=clock.monotonic,
+            )
+        )
+        with pytest.raises(ConnectionRefusedError):
+            client.cordon_node("tpu-0", timeout=5)
+        stats = client.transport_stats()
+        assert stats["retries"] == DEFAULT_MAX_ATTEMPTS - 1
+        assert stats["retries_by_reason"] == {
+            "connect_refused": DEFAULT_MAX_ATTEMPTS - 1
+        }
+        client.close()
+
+    def test_get_recovers_through_fail_two_then_succeed_schedule(self):
+        # fail-N-then-succeed: the canonical transient blip, server-side
+        # request count pinned (3 = two faults + the success).  The reset
+        # comes FIRST (fresh connection) so it exercises the retry layer —
+        # a reset on a reused keep-alive socket is absorbed by the
+        # transport's own stale-socket redial instead, costing no budget.
+        schedule = fx.FaultSchedule(["reset", "500"])
+        srv = fx.serve_http(
+            fx.fault_scheduled_handler(fx.cpu_only_cluster(3), schedule)
+        )
+        try:
+            cfg = cluster.ClusterConfig(
+                server=f"http://127.0.0.1:{srv.server_address[1]}"
+            )
+            client = cluster.KubeClient(cfg)
+            clock = FakeClock()
+            client.set_retry_policy(
+                RetryPolicy(
+                    budget=RetryBudget(30.0), sleep=clock.sleep,
+                    monotonic=clock.monotonic,
+                )
+            )
+            nodes = client.list_nodes(timeout=5)
+            assert len(nodes) == 3
+            assert schedule.served == ["reset", "500", "ok"]
+            assert client.transport_stats()["retries"] == 2
+            client.close()
+        finally:
+            srv.shutdown()

@@ -323,7 +323,9 @@ class TestProbeResultsAggregation:
 class TestEmitWatch:
     def test_emit_probe_with_watch_loops(self, tmp_path, monkeypatch, capsys):
         # DaemonSet pattern: --emit-probe --watch re-writes the report each
-        # round instead of exiting after one emission.
+        # round instead of exiting after one emission.  The loop's
+        # inter-round wait is the event-based _wait_for_next_round seam
+        # (returning True = shutdown requested → clean 143 exit).
         emissions = []
         from tpu_node_checker.probe.liveness import ProbeResult
 
@@ -333,14 +335,12 @@ class TestEmitWatch:
             or ProbeResult(ok=True, level="enumerate", hostname="h", elapsed_ms=1.0,
                            device_count=8),
         )
-
-        def fake_sleep(s):
-            if len(emissions) >= 3:
-                raise KeyboardInterrupt
-        monkeypatch.setattr("time.sleep", fake_sleep)
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round", lambda stop, s: len(emissions) >= 3
+        )
         out = tmp_path / "h.json"
         code = cli.main(["--emit-probe", str(out), "--watch", "1"])
-        assert code == 130
+        assert code == 143  # clean SIGTERM-style stop
         assert len(emissions) == 3
         assert json.loads(out.read_text())["ok"] is True
 
@@ -369,18 +369,15 @@ class TestEmitWatch:
             )
 
         monkeypatch.setattr("tpu_node_checker.probe.run_local_probe", fake_probe)
-
-        def fake_sleep(s):
-            if len(emissions) >= 3:
-                raise KeyboardInterrupt
-
-        monkeypatch.setattr("time.sleep", fake_sleep)
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round", lambda stop, s: len(emissions) >= 3
+        )
         out, log = tmp_path / "h.json", tmp_path / "rounds.jsonl"
         code = cli.main([
             "--emit-probe", str(out), "--watch", "1", "--probe-level", "compute",
             "--metrics-port", "0", "--log-jsonl", str(log),
         ])
-        assert code == 130
+        assert code == 143
         # The round log: 3 entries in --trend shape, the sick round naming
         # its cause.
         entries = [json.loads(x) for x in log.read_text().splitlines()]
@@ -422,17 +419,14 @@ class TestEmitWatch:
             )
 
         monkeypatch.setattr("tpu_node_checker.probe.run_local_probe", fake_probe)
-
-        def fake_sleep(s):
-            if len(emissions) >= 3:
-                raise KeyboardInterrupt
-
-        monkeypatch.setattr("time.sleep", fake_sleep)
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round", lambda stop, s: len(emissions) >= 3
+        )
         out, log = tmp_path / "h.json", tmp_path / "rounds.jsonl"
         code = cli.main([
             "--emit-probe", str(out), "--watch", "1", "--log-jsonl", str(log),
         ])
-        assert code == 130
+        assert code == 143
         assert len(emissions) == 3  # the loop outlived the crash
         entries = [json.loads(x) for x in log.read_text().splitlines()]
         assert [e["exit_code"] for e in entries] == [0, 1, 0]
@@ -475,46 +469,42 @@ class TestWatch:
         # Fixed cadence (VERDICT r01 item #7): a round that takes 3s of a 10s
         # interval sleeps only 7s, so real cadence is the interval — not
         # interval + probe time — and probe-report freshness math stays honest.
-        sleeps = []
+        waits = []
         clock = {"t": 100.0}
 
         def fake_run_check(args):
             clock["t"] += 3.0  # the check itself costs 3 virtual seconds
             return checker.CheckResult(exit_code=0)
 
-        def fake_sleep(s):
-            sleeps.append(s)
-            if len(sleeps) >= 2:
-                raise KeyboardInterrupt
+        def fake_wait(stop, s):
+            waits.append(s)
+            return len(waits) >= 2  # then: shutdown requested
 
         monkeypatch.setattr(checker.time, "monotonic", lambda: clock["t"])
-        monkeypatch.setattr(checker.time, "sleep", fake_sleep)
+        monkeypatch.setattr(checker, "_wait_for_next_round", fake_wait)
         monkeypatch.setattr(checker, "run_check", fake_run_check)
-        with pytest.raises(KeyboardInterrupt):
-            checker.watch(cli.parse_args(["--watch", "10"]))
-        assert sleeps == [7.0, 7.0]
+        assert checker.watch(cli.parse_args(["--watch", "10"])) == 143
+        assert waits == [7.0, 7.0]
 
     def test_watch_round_slower_than_interval_never_sleeps_negative(
         self, monkeypatch, capsys
     ):
-        sleeps = []
+        waits = []
         clock = {"t": 0.0}
 
         def fake_run_check(args):
             clock["t"] += 25.0  # slower than the 10s interval
             return checker.CheckResult(exit_code=0)
 
-        def fake_sleep(s):
-            sleeps.append(s)
-            if len(sleeps) >= 2:
-                raise KeyboardInterrupt
+        def fake_wait(stop, s):
+            waits.append(s)
+            return len(waits) >= 2
 
         monkeypatch.setattr(checker.time, "monotonic", lambda: clock["t"])
-        monkeypatch.setattr(checker.time, "sleep", fake_sleep)
+        monkeypatch.setattr(checker, "_wait_for_next_round", fake_wait)
         monkeypatch.setattr(checker, "run_check", fake_run_check)
-        with pytest.raises(KeyboardInterrupt):
-            checker.watch(cli.parse_args(["--watch", "10"]))
-        assert sleeps == [0.0, 0.0]  # back-to-back, no drift and no crash
+        assert checker.watch(cli.parse_args(["--watch", "10"])) == 143
+        assert waits == [0.0, 0.0]  # back-to-back, no drift and no crash
 
     def test_watch_zero_rejected(self, capsys):
         with pytest.raises(SystemExit):
@@ -548,15 +538,12 @@ class TestWatch:
                                elapsed_ms=1.0, device_count=8)
 
         monkeypatch.setattr("tpu_node_checker.probe.run_local_probe", flaky_probe)
-
-        def fake_sleep(s):
-            if len(rounds) >= 3:
-                raise KeyboardInterrupt
-
-        monkeypatch.setattr("time.sleep", fake_sleep)
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round", lambda stop, s: len(rounds) >= 3
+        )
         out = tmp_path / "h.json"
         code = cli.main(["--emit-probe", str(out), "--watch", "1"])
-        assert code == 130
+        assert code == 143
         assert len(rounds) == 3  # the OSError round did not end the loop
         assert "Probe emission failed" in capsys.readouterr().err
 
@@ -578,7 +565,7 @@ class TestWatch:
             notify, "send_slack_message",
             lambda url, message, **kw: sent.append(message.splitlines()[0]) or True,
         )
-        monkeypatch.setattr("time.sleep", lambda s: None)
+        monkeypatch.setattr(checker, "_wait_for_next_round", lambda stop, s: False)
         code = cli.main(
             ["--watch", "1", "--slack-on-change", "--slack-webhook", "https://x"]
         )
@@ -605,7 +592,7 @@ class TestWatch:
             notify, "send_slack_message",
             lambda url, message, **kw: sent.append(message.splitlines()[0]) or True,
         )
-        monkeypatch.setattr("time.sleep", lambda s: None)
+        monkeypatch.setattr(checker, "_wait_for_next_round", lambda stop, s: False)
         code = cli.main(
             ["--watch", "1", "--slack-on-change", "--slack-webhook", "https://x",
              "--log-jsonl", str(log_path)]
@@ -664,12 +651,13 @@ class TestWatch:
             sent.append(message.splitlines()[0])
             return True
 
-        def fake_sleep(s):
+        def fake_wait(stop, s):
             rounds.append(s)
+            return False
 
         monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
         monkeypatch.setattr(notify, "send_slack_message", fake_send)
-        monkeypatch.setattr("time.sleep", fake_sleep)
+        monkeypatch.setattr(checker, "_wait_for_next_round", fake_wait)
         code = cli.main(
             ["--watch", "0.01", "--slack-on-change", "--slack-webhook", "https://x"]
         )
@@ -679,6 +667,209 @@ class TestWatch:
         assert sent[0].startswith("✅")
         assert sent[1].startswith("⚠️")
         assert "State change: exit 0 → 3" in capsys.readouterr().err
+
+
+class TestWatchBreaker:
+    """Circuit breaker over consecutive failed rounds: opens at the
+    threshold with ONE degraded alert, widens the interval (capped), and
+    alerts the recovery transition."""
+
+    def test_state_machine_and_interval_scaling(self):
+        b = checker.WatchBreaker(threshold=3, max_scale=8)
+        assert b.record_failure() is None  # 1
+        assert b.record_failure() is None  # 2
+        assert b.interval_scale() == 1  # still closed
+        assert b.record_failure() == "opened"  # 3 = threshold
+        assert b.open and b.interval_scale() == 2
+        assert b.record_failure() is None  # already open: no re-alert
+        assert b.interval_scale() == 4
+        b.record_failure()
+        assert b.interval_scale() == 8
+        b.record_failure()
+        assert b.interval_scale() == 8  # capped
+        assert b.record_success() == "closed"
+        assert not b.open and b.interval_scale() == 1
+        assert b.consecutive_failures == 0
+        assert b.record_success() is None  # closed→closed: quiet
+
+    def _drive_watch(self, monkeypatch, script, interval="10"):
+        """Run watch over a scripted round sequence ('ok'/'fail'), recording
+        Slack messages and the waited-for intervals; virtual clock (rounds
+        cost zero) so waits equal the breaker-scaled interval exactly."""
+        sent, waits = [], []
+        script = list(script)
+
+        def fake_run_check(args):
+            if not script:
+                raise KeyboardInterrupt
+            step = script.pop(0)
+            if step == "fail":
+                raise RuntimeError("apiserver unreachable")
+            return checker.CheckResult(exit_code=0)
+
+        def fake_wait(stop, s):
+            waits.append(s)
+            return False
+
+        monkeypatch.setattr(checker.time, "monotonic", lambda: 1000.0)
+        monkeypatch.setattr(checker, "run_check", fake_run_check)
+        monkeypatch.setattr(checker, "_wait_for_next_round", fake_wait)
+        monkeypatch.setattr(
+            notify, "send_slack_message",
+            lambda url, message, **kw: sent.append(message) or True,
+        )
+        code = cli.main(["--watch", interval, "--slack-webhook", "https://x"])
+        assert code == 130
+        return sent, waits
+
+    def test_breaker_collapses_alerts_and_widens_interval(
+        self, monkeypatch, capsys
+    ):
+        sent, waits = self._drive_watch(
+            monkeypatch,
+            ["ok", "fail", "fail", "fail", "fail", "fail", "ok"],
+        )
+        # Alerts: the round-1 state render, ❌ per-round for failures 1-2,
+        # ONE degraded alert at open (failure 3), silence for failures 4-5,
+        # then the recovery alert + the ok-round render when the breaker
+        # closes — 6 messages total, not one per round.
+        assert len(sent) == 6
+        assert sum("FAILED to run" in m for m in sent) == 2
+        assert sum("DEGRADED" in m for m in sent) == 1
+        assert sum("RECOVERED" in m for m in sent) == 1
+        degraded = next(m for m in sent if "DEGRADED" in m)
+        assert "3 consecutive" in degraded
+        # Interval: 10s while closed (rounds 1-3), then 20/40/80 while the
+        # breaker widens (open at failure 3), back to 10 after recovery.
+        assert waits == [10.0, 10.0, 10.0, 20.0, 40.0, 80.0, 10.0]
+        err = capsys.readouterr().err
+        assert "Watch breaker OPEN" in err
+        assert "Monitor recovered" in err
+
+    def test_breaker_scale_caps_at_max(self, monkeypatch, capsys):
+        sent, waits = self._drive_watch(
+            monkeypatch, ["fail"] * 8, interval="10"
+        )
+        # Failures 1-2 closed (10s); open at 3 → 20, 40, 80, then capped.
+        assert waits == [10.0, 10.0, 20.0, 40.0, 80.0, 80.0, 80.0, 80.0]
+        assert sum("DEGRADED" in m for m in sent) == 1
+        capsys.readouterr()
+
+    def test_breaker_state_exported_on_metrics(self, monkeypatch, capsys):
+        from tpu_node_checker.metrics import MetricsServer
+
+        captured = {}
+        orig_init = MetricsServer.__init__
+
+        def spy_init(self, port, host="0.0.0.0"):
+            orig_init(self, port, host)
+            captured["server"] = self
+
+        monkeypatch.setattr(MetricsServer, "__init__", spy_init)
+        self._drive_watch_with_metrics(monkeypatch, capsys, captured)
+
+    def _drive_watch_with_metrics(self, monkeypatch, capsys, captured):
+        import urllib.request
+
+        script = ["fail", "fail", "fail"]
+
+        def fake_run_check(args):
+            if not script:
+                raise KeyboardInterrupt
+            script.pop(0)
+            raise RuntimeError("down")
+
+        monkeypatch.setattr(checker, "run_check", fake_run_check)
+        monkeypatch.setattr(checker, "_wait_for_next_round", lambda stop, s: False)
+        code = cli.main(["--watch", "5", "--metrics-port", "0"])
+        assert code == 130
+        port = captured["server"].port
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        captured["server"].close()
+        assert "tpu_node_checker_watch_breaker_open 1.0" in text
+        assert "tpu_node_checker_watch_breaker_consecutive_failures 3.0" in text
+        assert "tpu_node_checker_exit_code 1" in text
+        capsys.readouterr()
+
+
+class TestWatchSigterm:
+    def test_sigterm_mid_round_stops_cleanly_with_state_flushed(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        # SIGTERM lands DURING round 2 (a Deployment rollout): the round
+        # completes, its state log line is flushed, and the loop exits 143
+        # at the next wait instead of dying mid-sleep — the handler + the
+        # event-based wait, end to end through a real signal delivery.
+        import signal
+
+        rounds = []
+
+        def fake_fetch(args, timer):
+            rounds.append(1)
+            if len(rounds) == 2:
+                signal.raise_signal(signal.SIGTERM)
+            return fx.tpu_v5e_single_host(), None
+
+        monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
+        log = tmp_path / "trend.jsonl"
+        # Interval small enough that round 1's (real, event-based) wait is
+        # over quickly; the signal lands during round 2's fetch.
+        code = cli.main(["--watch", "0.05", "--log-jsonl", str(log)])
+        assert code == 143
+        assert len(rounds) == 2  # no third round after the signal
+        entries = [json.loads(x) for x in log.read_text().splitlines()]
+        assert [e["exit_code"] for e in entries] == [0, 0]  # both flushed
+        assert "SIGTERM: watch loop stopped cleanly" in capsys.readouterr().err
+
+    def test_sigterm_stops_emitter_loop_cleanly(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        import signal
+
+        from tpu_node_checker.probe.liveness import ProbeResult
+
+        emissions = []
+
+        def fake_probe(**kw):
+            emissions.append(1)
+            if len(emissions) == 2:
+                signal.raise_signal(signal.SIGTERM)
+            return ProbeResult(ok=True, level="enumerate", hostname="h",
+                               elapsed_ms=1.0, device_count=8)
+
+        monkeypatch.setattr("tpu_node_checker.probe.run_local_probe", fake_probe)
+        out = tmp_path / "h.json"
+        code = cli.main(["--emit-probe", str(out), "--watch", "0.05"])
+        assert code == 143
+        assert len(emissions) == 2
+        assert json.loads(out.read_text())["ok"] is True  # report flushed
+        assert "SIGTERM: emitter loop stopped cleanly" in capsys.readouterr().err
+
+    def test_sigterm_handler_restored_after_watch(self, monkeypatch, capsys):
+        # The loop must not leave its handler installed after returning —
+        # a later embedder's SIGTERM disposition is not ours to keep.
+        import signal
+
+        before = signal.getsignal(signal.SIGTERM)
+        monkeypatch.setattr(
+            checker, "run_check",
+            lambda args: checker.CheckResult(exit_code=0),
+        )
+        monkeypatch.setattr(checker, "_wait_for_next_round", lambda stop, s: True)
+        assert checker.watch(cli.parse_args(["--watch", "5"])) == 143
+        assert signal.getsignal(signal.SIGTERM) is before
+        capsys.readouterr()
+
+    def test_wait_for_next_round_prompt_when_stop_already_set(self):
+        import threading
+
+        stop = threading.Event()
+        stop.set()
+        t0 = __import__("time").perf_counter()
+        assert checker._wait_for_next_round(stop, 60.0) is True
+        assert __import__("time").perf_counter() - t0 < 1.0  # prompt, not 60s
 
 
 @pytest.mark.slow

@@ -101,6 +101,32 @@ _CLIENT_CACHE_MAX = 8  # tests spin many fixture servers; evict, don't grow
 # resolved client) — the source of the payload's api_transport telemetry.
 _ROUND_CLIENT: dict = {"client": None}
 
+# This round's retry policy (fresh shared wall-clock budget per round),
+# installed on whichever client the round resolves — cached clients from a
+# previous round included, so a stale budget never leaks across rounds.
+_ROUND_POLICY: dict = {"policy": None}
+
+
+def _build_retry_policy(args):
+    """``--retry-budget`` → a per-round RetryPolicy (None disables retries).
+
+    The budget is SHARED by every API call in the round — the initial LIST,
+    the events/cordon fan-out workers, everything — so the round's worst-case
+    added latency is bounded by one number, not one number per call.
+    """
+    from tpu_node_checker.utils.retry import (
+        DEFAULT_BUDGET_S,
+        RetryBudget,
+        RetryPolicy,
+    )
+
+    budget_s = getattr(args, "retry_budget", None)
+    if budget_s is None:
+        budget_s = DEFAULT_BUDGET_S
+    if budget_s <= 0:
+        return None  # 0 = retries off: the pre-retry transport, exactly
+    return RetryPolicy(budget=RetryBudget(budget_s))
+
 
 def _client_key(cfg) -> tuple:
     return (
@@ -128,6 +154,8 @@ def _cached_client(cfg):
     else:
         del _CLIENT_CACHE[key]  # re-insert: move-to-end = mark recently used
     _CLIENT_CACHE[key] = client
+    # Fresh budget every round, cached client or not.
+    client.set_retry_policy(_ROUND_POLICY["policy"])
     _ROUND_CLIENT["client"] = client
     return client
 
@@ -426,7 +454,7 @@ def _summarize_events(raw: Sequence) -> list:
     return evs[:_EVENTS_PER_NODE]
 
 
-def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
+def _attach_node_events(args, accel: List[NodeInfo], client) -> List[str]:
     """``--node-events``: recent k8s Events for SICK nodes.
 
     The ``kubectl describe node`` triage block, pushed instead of dug for:
@@ -441,10 +469,15 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
     (``--api-concurrency``, each worker on its own pooled keep-alive
     connection), so 8 sick nodes cost ~max(one walk), not the sum — the
     exact round where latency matters most is the degraded one.
+
+    Returns the failure notes (empty when every fetch landed): events are a
+    non-essential phase, so a transient failure here marks the round
+    ``degraded`` in the payload instead of sinking it to exit 1.
     """
+    errors: List[str] = []
     sick = [n for n in accel if not n.effectively_ready]
     if not sick:
-        return
+        return errors
     # Unplanned faults outrank maintenance drains for the fetch budget: a
     # rolling drain of 8+ cordoned nodes must not starve the one genuinely
     # faulted node of the triage this flag exists for (stable sort keeps
@@ -454,7 +487,8 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
         client = _resolve_client(args, client)
     except Exception as exc:  # noqa: BLE001 — triage extra, never fatal
         print(f"Cannot fetch node events: {exc}", file=sys.stderr)
-        return
+        errors.append(f"no cluster client: {exc}")
+        return errors
     from tpu_node_checker.utils.fanout import bounded_map
 
     targets = sick[:_EVENTS_NODE_CAP]
@@ -468,6 +502,7 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
             n.events = _summarize_events(value)
         else:
             print(f"Cannot fetch events for {n.name}: {value}", file=sys.stderr)
+            errors.append(f"{n.name}: {value}")
     omitted = len(sick) - _EVENTS_NODE_CAP
     if omitted > 0:
         print(
@@ -475,6 +510,7 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> None:
             f"{_EVENTS_NODE_CAP}-node fetch cap",
             file=sys.stderr,
         )
+    return errors
 
 
 def _resolve_client(args, client):
@@ -681,6 +717,12 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     timer = PhaseTimer()
     kube_client = None
     _ROUND_CLIENT["client"] = None  # telemetry tracks THIS round's traffic
+    _ROUND_POLICY["policy"] = _build_retry_policy(args)
+    # Per-phase transient-failure notes from NON-essential phases (events,
+    # cordon/uncordon): they mark the round degraded instead of sinking it.
+    # A failed initial node LIST still raises out of here — the documented
+    # exit-1 contract is untouched.
+    degradation: dict = {}
     if nodes is None:
         nodes, kube_client = _fetch_nodes(args, timer)
     result = CheckResult(exit_code=EXIT_OK)
@@ -696,7 +738,9 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
 
     if getattr(args, "node_events", False):
         with timer.phase("events"):
-            _attach_node_events(args, accel, kube_client)
+            event_errors = _attach_node_events(args, accel, kube_client)
+        if event_errors:
+            degradation["events"] = event_errors[:_EVENTS_NODE_CAP]
 
     # Effective readiness: kubelet Ready minus unschedulable/probe-failed hosts.
     effective_ready = [n for n in ready if n.effectively_ready]
@@ -811,6 +855,19 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
             payload["cordon"] = cordon_report
         if uncordon_report is not None:
             payload["uncordon"] = uncordon_report
+        for phase_name, rep in (("cordon", cordon_report), ("uncordon", uncordon_report)):
+            failed = (rep or {}).get("failed")
+            if failed:
+                degradation[phase_name] = [
+                    f"{f.get('node')}: {f.get('error')}" for f in failed[:_CAUSES_CAP]
+                ]
+        if degradation:
+            # Partial degradation: the round's VERDICT stands (the exit-code
+            # contract is grade-only), but the payload says which
+            # non-essential phases lost data and why — so "triage is
+            # incomplete" is machine-readable, not a buried stderr note.
+            payload["degraded"] = True
+            payload["degradation"] = degradation
         # Keep-alive pool telemetry (session-lifetime counters): reuse
         # climbing while connections_opened stays flat is the pooled
         # transport doing its job across watch rounds; the gap between
@@ -1267,7 +1324,7 @@ def _append_emitter_log(args, entry: dict) -> None:
         _append_jsonl(path, entry)
 
 
-def emit_probe_loop(args) -> None:
+def emit_probe_loop(args) -> int:
     """``--emit-probe FILE --watch SECONDS``: the DaemonSet emitter loop.
 
     Keeps the shared-volume report fresher than the aggregator's
@@ -1286,8 +1343,11 @@ def emit_probe_loop(args) -> None:
     One bad round (shared-volume blip, probe crash) must not kill the
     emitter: a crash-looping pod lets the report go stale, and a healthy
     host would then grade as failed under ``--probe-results-required``.
-    Runs until interrupted.
+    Runs until interrupted; SIGTERM (a DaemonSet rollout) stops the loop
+    cleanly after the current emission and returns 143.
     """
+    import threading
+
     interval = args.watch
     server = None
     if getattr(args, "metrics_port", None) is not None:
@@ -1298,6 +1358,15 @@ def emit_probe_loop(args) -> None:
             f"Serving emitter metrics on port {server.port} (/metrics).",
             file=sys.stderr,
         )
+    stop = threading.Event()
+    prev_handler = _install_stop_signal(stop)
+    try:
+        return _emit_probe_rounds(args, interval, server, stop)
+    finally:
+        _restore_stop_signal(prev_handler)
+
+
+def _emit_probe_rounds(args, interval, server, stop) -> int:
     while True:
         round_start = time.monotonic()
         try:
@@ -1320,18 +1389,130 @@ def emit_probe_loop(args) -> None:
         _append_emitter_log(args, entry)
         # Fixed cadence: probe time comes out of the interval so report
         # freshness keeps the margin the aggregator's max-age math assumes.
-        time.sleep(max(0.0, interval - (time.monotonic() - round_start)))
+        # Event-based wait: SIGTERM wakes it immediately.
+        if _wait_for_next_round(
+            stop, max(0.0, interval - (time.monotonic() - round_start))
+        ):
+            print(
+                "SIGTERM: emitter loop stopped cleanly (last report and "
+                "round log flushed).",
+                file=sys.stderr,
+            )
+            return 128 + 15
 
 
-def watch(args) -> None:
+# Circuit-breaker tuning for watch mode: the breaker OPENS after this many
+# CONSECUTIVE failed rounds (run_check raised — "the monitor is down", not a
+# degraded fleet verdict), and while open the inter-round interval widens by
+# doubling, capped at this multiple of the configured interval.  Three
+# failures distinguishes "one blip the retry layer couldn't absorb" from
+# "the API path is down"; the 8x cap keeps even a long outage's recovery
+# detection latency bounded (a 5-minute interval probes at most every 40).
+BREAKER_THRESHOLD = 3
+BREAKER_MAX_SCALE = 8
+
+
+class WatchBreaker:
+    """Watch-mode circuit breaker over consecutive failed rounds.
+
+    State machine::
+
+        CLOSED --(threshold consecutive failures)--> OPEN   ["opened"]
+        OPEN   --(any successful round)-----------> CLOSED  ["closed"]
+
+    While OPEN: the effective interval widens (``interval_scale``), and the
+    per-round "monitor failed" alerts are suppressed — ONE "monitor
+    degraded" alert fired at the open transition covers them, and the close
+    transition alerts recovery.  A breaker round is never written as fleet
+    state: the trend log keeps recording exit-1 rounds as before.
+    """
+
+    def __init__(self, threshold: int = BREAKER_THRESHOLD, max_scale: int = BREAKER_MAX_SCALE):
+        self.threshold = max(1, threshold)
+        self.max_scale = max(1, max_scale)
+        self.consecutive_failures = 0
+        self.open = False
+
+    def record_failure(self) -> Optional[str]:
+        """Returns "opened" when this failure trips the breaker."""
+        self.consecutive_failures += 1
+        if not self.open and self.consecutive_failures >= self.threshold:
+            self.open = True
+            return "opened"
+        return None
+
+    def record_success(self) -> Optional[str]:
+        """Returns "closed" when this success recovers an open breaker."""
+        self.consecutive_failures = 0
+        if self.open:
+            self.open = False
+            return "closed"
+        return None
+
+    def interval_scale(self) -> int:
+        """Multiplier on the configured interval: 1 while closed; doubling
+        from 2 per further failed round while open, capped."""
+        if not self.open:
+            return 1
+        return min(self.max_scale, 2 ** (self.consecutive_failures - self.threshold + 1))
+
+    def as_dict(self) -> dict:
+        return {
+            "open": self.open,
+            "consecutive_failures": self.consecutive_failures,
+        }
+
+
+def _install_stop_signal(stop) -> object:
+    """SIGTERM → set ``stop`` so the loop exits at the next wait instead of
+    dying mid-``sleep`` with the round's state unlogged (a Deployment
+    rollout sends SIGTERM, waits terminationGracePeriodSeconds, then KILLs).
+    Returns the previous handler for restoration, or None where signals
+    aren't installable (non-POSIX, non-main thread — tests)."""
+    import signal
+
+    def _handler(signum, frame):
+        stop.set()
+
+    try:
+        return signal.signal(signal.SIGTERM, _handler)
+    except (AttributeError, ValueError, OSError):
+        return None
+
+
+def _restore_stop_signal(prev) -> None:
+    if prev is None:
+        return
+    import signal
+
+    try:
+        signal.signal(signal.SIGTERM, prev)
+    except (AttributeError, ValueError, OSError):
+        pass
+
+
+def _wait_for_next_round(stop, seconds: float) -> bool:
+    """Event-based inter-round wait: returns True when shutdown was
+    requested (promptly — mid-wait, not after sleeping the interval out).
+    The seam the loop tests fake their clock through."""
+    return stop.wait(max(0.0, seconds))
+
+
+def watch(args) -> int:
     """``--watch SECONDS``: run the check repeatedly (daemon mode).
 
     The reference delegates periodic operation to cron (its README's cron
     scenario); this mode is for running as a Deployment.  With
     ``--slack-on-change`` notifications fire only when the exit code changes
-    (state-transition alerting) instead of every round.  Runs until
-    interrupted; errors in a round are reported and the loop continues.
+    (state-transition alerting) instead of every round.  Errors in a round
+    are reported and the loop continues; consecutive failures trip a
+    circuit breaker (see :class:`WatchBreaker`) that widens the interval
+    and collapses per-round failure alerts into one degraded/recovered
+    pair.  Runs until interrupted — SIGTERM stops the loop cleanly after
+    the current round (state log flushed) and returns 143.
     """
+    import threading
+
     interval = args.watch
     on_change = getattr(args, "slack_on_change", False)
     webhook = notify.get_slack_webhook_url(getattr(args, "slack_webhook", None))
@@ -1352,53 +1533,112 @@ def watch(args) -> None:
                 f"(recovered from {args.log_jsonl})",
                 file=sys.stderr,
             )
-    while True:
-        round_start = time.monotonic()
-        # The try covers ONLY the check itself: a failure here means "the
-        # monitor is down" — a state of its own (EXIT_ERROR) so that recovery
-        # also registers as a transition.  Render/notify problems afterwards
-        # are reported but do not reclassify a successful round.
-        try:
-            result = run_check(args)
-        except KeyboardInterrupt:
-            raise
-        except Exception as exc:  # noqa: BLE001 — a bad round must not kill the daemon
-            code = EXIT_ERROR
-            print(f"Check round failed: {exc}", file=sys.stderr)
-            # The cached keep-alive client just failed a round: drop it so
-            # the next round redials (and re-resolves credentials) instead
-            # of re-trusting a pool that may hold only dead sockets.
-            reset_client_cache()
-            if metrics_server is not None:
-                metrics_server.mark_error(EXIT_ERROR)
-            _append_state_log(args, None, error=str(exc))
-            changed = last_code is None or code != last_code
-            if webhook and ((not on_change) or changed):
-                notify.send_slack_message(
-                    webhook,
-                    f"❌ *Accelerator node check FAILED to run*: {exc}",
-                    username=getattr(args, "slack_username", notify.DEFAULT_USERNAME),
-                    max_retries=0,  # don't stall the watch loop on retries
-                )
-        else:
-            code = result.exit_code
-            if metrics_server is not None:
-                metrics_server.update(result)
-            _append_state_log(args, result)
-            changed = last_code is None or code != last_code
+    breaker = WatchBreaker()
+    stop = threading.Event()
+    prev_handler = _install_stop_signal(stop)
+    username = getattr(args, "slack_username", notify.DEFAULT_USERNAME)
+    try:
+        while True:
+            round_start = time.monotonic()
+            # The try covers ONLY the check itself: a failure here means "the
+            # monitor is down" — a state of its own (EXIT_ERROR) so that
+            # recovery also registers as a transition.  Render/notify problems
+            # afterwards are reported but do not reclassify a successful round.
             try:
-                render_and_notify(args, result, notify_enabled=(not on_change) or changed)
-            except Exception as exc:  # noqa: BLE001 — e.g. stdout pipe gone
-                print(f"Render/notify failed (check itself OK): {exc}", file=sys.stderr)
-        if last_code is not None and code != last_code:
-            print(f"State change: exit {last_code} → {code}", file=sys.stderr)
-        last_code = code
-        # Fixed cadence, not fixed gap: the round's own cost (a workload-level
-        # probe can take minutes) comes out of the interval, so round N starts
-        # ~N*interval after the first and --probe-results-max-age freshness
-        # math stays honest.  A round slower than the interval runs back to
-        # back rather than drifting further.
-        time.sleep(max(0.0, interval - (time.monotonic() - round_start)))
+                result = run_check(args)
+            except KeyboardInterrupt:
+                raise
+            except Exception as exc:  # noqa: BLE001 — a bad round must not kill the daemon
+                code = EXIT_ERROR
+                print(f"Check round failed: {exc}", file=sys.stderr)
+                # The cached keep-alive client just failed a round: drop it so
+                # the next round redials (and re-resolves credentials) instead
+                # of re-trusting a pool that may hold only dead sockets.
+                reset_client_cache()
+                transition = breaker.record_failure()
+                if metrics_server is not None:
+                    metrics_server.set_breaker(breaker.as_dict())
+                    metrics_server.mark_error(EXIT_ERROR)
+                _append_state_log(args, None, error=str(exc))
+                changed = last_code is None or code != last_code
+                if webhook:
+                    if transition == "opened":
+                        # ONE degraded alert covers the whole open stretch —
+                        # not one page per failed round.
+                        notify.send_slack_message(
+                            webhook,
+                            f"🚨 *Accelerator node monitor DEGRADED*: "
+                            f"{breaker.consecutive_failures} consecutive check "
+                            f"rounds failed (last: {exc}). Widening the check "
+                            "interval; further failure alerts suppressed "
+                            "until recovery.",
+                            username=username,
+                            max_retries=0,  # don't stall the watch loop
+                        )
+                    elif breaker.open:
+                        pass  # suppressed: the degraded alert covers it
+                    elif (not on_change) or changed:
+                        notify.send_slack_message(
+                            webhook,
+                            f"❌ *Accelerator node check FAILED to run*: {exc}",
+                            username=username,
+                            max_retries=0,  # don't stall the watch loop on retries
+                        )
+            else:
+                code = result.exit_code
+                transition = breaker.record_success()
+                if metrics_server is not None:
+                    metrics_server.set_breaker(breaker.as_dict())
+                    metrics_server.update(result)
+                _append_state_log(args, result)
+                changed = last_code is None or code != last_code
+                if transition == "closed":
+                    print(
+                        "Monitor recovered: check rounds succeeding again; "
+                        "interval restored.",
+                        file=sys.stderr,
+                    )
+                    if webhook:
+                        notify.send_slack_message(
+                            webhook,
+                            "✅ *Accelerator node monitor RECOVERED*: check "
+                            "rounds are succeeding again (interval restored).",
+                            username=username,
+                            max_retries=0,
+                        )
+                try:
+                    render_and_notify(args, result, notify_enabled=(not on_change) or changed)
+                except Exception as exc:  # noqa: BLE001 — e.g. stdout pipe gone
+                    print(f"Render/notify failed (check itself OK): {exc}", file=sys.stderr)
+            if last_code is not None and code != last_code:
+                print(f"State change: exit {last_code} → {code}", file=sys.stderr)
+            last_code = code
+            effective_interval = interval * breaker.interval_scale()
+            if breaker.open:
+                print(
+                    f"Watch breaker OPEN ({breaker.consecutive_failures} "
+                    f"consecutive failed rounds): next round in "
+                    f"{effective_interval:g}s.",
+                    file=sys.stderr,
+                )
+            # Fixed cadence, not fixed gap: the round's own cost (a
+            # workload-level probe can take minutes) comes out of the
+            # interval, so round N starts ~N*interval after the first and
+            # --probe-results-max-age freshness math stays honest.  A round
+            # slower than the interval runs back to back rather than
+            # drifting further.  The wait is EVENT-based: SIGTERM wakes it
+            # immediately instead of serving out the sleep.
+            if _wait_for_next_round(
+                stop, max(0.0, effective_interval - (time.monotonic() - round_start))
+            ):
+                print(
+                    "SIGTERM: watch loop stopped cleanly (last round's state "
+                    "log flushed).",
+                    file=sys.stderr,
+                )
+                return 128 + 15  # conventional SIGTERM exit
+    finally:
+        _restore_stop_signal(prev_handler)
 
 
 def _recover_last_code(args) -> Optional[int]:
@@ -1824,6 +2064,11 @@ def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] 
             slices=len(p.get("slices", [])),
             duration_ms=p.get("timings_ms", {}).get("total"),
         )
+        if p.get("degraded"):
+            # Partial degradation (a non-essential phase lost data): the
+            # grade stands, but the trend record must not read as a fully
+            # clean round.
+            entry["degraded"] = True
         if result.exit_code != EXIT_OK:
             causes = _round_causes(p)
             if causes:

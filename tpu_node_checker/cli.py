@@ -84,6 +84,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "fan-outs (--node-events fetches, cordon/uncordon patches); "
                    "each worker keeps its own pooled keep-alive connection "
                    "(default 4; 1 = serial)")
+    p.add_argument("--retry-budget", type=float, default=None, metavar="SECONDS",
+                   help="shared wall-clock budget for transparent API retries "
+                   "per check round (default 15; 0 disables): transient "
+                   "faults — connect refused/reset, socket timeout, HTTP "
+                   "429/500/502/503/504 — retry with full-jitter exponential "
+                   "backoff (Retry-After honored) until the budget or the "
+                   "per-call attempt cap runs out; GETs retry freely, a "
+                   "PATCH only when the request provably never left the "
+                   "socket")
     p.add_argument("--node-events", action="store_true",
                    help="fetch recent k8s Events for sick nodes (the kubectl-"
                    "describe triage block: OOM kills, evictions, plugin crash "
@@ -260,6 +269,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--watch interval must be a positive number of seconds")
     if args.api_concurrency is not None and args.api_concurrency < 1:
         p.error("--api-concurrency must be at least 1 (1 = serial)")
+    if args.retry_budget is not None and args.retry_budget < 0:
+        p.error("--retry-budget must be >= 0 (0 disables retries)")
     if args.metrics_port is not None and args.watch is None:
         p.error("--metrics-port requires --watch (one-shot runs serve no scrapes)")
     if args.slack_on_change and args.watch is None:
@@ -469,12 +480,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.watch is not None:
                 # The DaemonSet emitter loop: periodic re-emission with the
                 # emitter's own metrics scrape and round log (checker.py).
-                checker.emit_probe_loop(args)  # returns only via signals
-                return checker.EXIT_ERROR  # pragma: no cover
+                # Returns only on SIGTERM (143) or via exceptions.
+                return checker.emit_probe_loop(args)
             return checker.emit_probe(args)
         if getattr(args, "watch", None) is not None:
-            checker.watch(args)  # returns only via signals/exceptions
-            return checker.EXIT_ERROR  # pragma: no cover
+            # Returns only on SIGTERM (143) or via signals/exceptions.
+            return checker.watch(args)
         return checker.one_shot(args)
     except KeyboardInterrupt:
         return 130  # conventional SIGINT exit; watch mode ends this way

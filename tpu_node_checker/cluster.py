@@ -60,12 +60,16 @@ class ClusterAPIError(RuntimeError):
 
 
 class _Response:
-    """Minimal requests-Response-shaped result for :class:`_StdlibSession`."""
+    """Minimal requests-Response-shaped result for :class:`_StdlibSession`.
 
-    def __init__(self, status_code: int, body: bytes, url: str):
+    ``headers`` carries the response headers with lower-cased names — the
+    retry layer reads ``retry-after`` from throttling responses."""
+
+    def __init__(self, status_code: int, body: bytes, url: str, headers=None):
         self.status_code = status_code
         self._body = body
         self._url = url
+        self.headers = headers or {}
 
     def raise_for_status(self) -> None:
         # Anything non-2xx is an error — INCLUDING 3xx: redirects are never
@@ -136,6 +140,14 @@ class _StdlibSession:
         self.connections_opened = 0
         self.requests_sent = 0
         self.requests_reused = 0
+        # Graded retry layer (utils/retry.py), installed per check round by
+        # the checker (`KubeClient.set_retry_policy`) so every round gets a
+        # fresh shared wall-clock budget.  None = no retries: the transport
+        # behaves exactly as before (the stale-socket redial below is
+        # connection management, not a retry, and stays either way).
+        self.retry_policy = None
+        self.retries = 0
+        self.retries_by_reason: dict = {}
         self._ssl_ctx = None
         self._pool: dict = {}  # (scheme, host, port) -> [idle connections]
         self._lock = threading.Lock()
@@ -248,7 +260,6 @@ class _StdlibSession:
                 conn.close()
 
     def _request(self, method, url, *, params=None, data=None, headers=None, timeout=None):
-        import http.client
         import urllib.parse
 
         if params:
@@ -270,9 +281,96 @@ class _StdlibSession:
             hdrs["Authorization"] = f"Basic {cred}"
         body = data.encode() if isinstance(data, str) else data
         key = (scheme, host, port)
+        policy = self.retry_policy
+        if policy is None:
+            # No-retry fast path: identical to the pre-retry transport.
+            return self._attempt(method, key, path, body, hdrs, timeout, url)
+        from tpu_node_checker.utils import retry as retry_mod
+
+        attempt = 0
+        while True:
+            t0 = policy.monotonic()
+            try:
+                resp = self._attempt(method, key, path, body, hdrs, timeout, url)
+            except Exception as exc:  # noqa: BLE001 — classifier decides
+                reason = retry_mod.classify_retriable(exc)
+                if reason is not None and method != "GET" and not getattr(
+                    exc, "request_never_sent", False
+                ):
+                    # Strict idempotency gate: a non-idempotent request whose
+                    # bytes (may have) left the socket is NEVER re-sent — the
+                    # server may have applied it.  Only connect-phase
+                    # failures, tagged by _attempt, qualify.
+                    reason = None
+                if reason is None:
+                    raise
+                # The failed attempt's own wall-clock (a 10 s timeout, say)
+                # is retry overhead too: charge it so a timeout-looping
+                # server exhausts the budget by attempt cost alone.
+                policy.budget.charge(policy.monotonic() - t0)
+                delay = policy.plan_retry(attempt, reason)
+                if delay is None:
+                    raise
+                self._count_retry(reason)
+                policy.wait(delay)
+                attempt += 1
+                continue
+            reason = (
+                retry_mod.status_retry_reason(resp.status_code)
+                if method == "GET"
+                else None  # status-retries are idempotent-only, like above
+            )
+            if reason is not None:
+                # Same rule as the exception path: a failed attempt's own
+                # wall-clock (a 500 the server took seconds to emit) is
+                # retry overhead and must count against the budget.
+                policy.budget.charge(policy.monotonic() - t0)
+                delay = policy.plan_retry(
+                    attempt,
+                    reason,
+                    retry_after=retry_mod.parse_retry_after(
+                        resp.headers.get("retry-after"), now=policy.now()
+                    ),
+                )
+                if delay is not None:
+                    self._count_retry(reason)
+                    policy.wait(delay)
+                    attempt += 1
+                    continue
+            # Out of retries (or nothing to retry): the response surfaces
+            # through the unchanged raise_for_status contract — an exhausted
+            # budget still lands on the documented exit-1 path.
+            return resp
+
+    def _count_retry(self, reason: str) -> None:
+        with self._lock:
+            self.retries += 1
+            self.retries_by_reason[reason] = self.retries_by_reason.get(reason, 0) + 1
+
+    def _attempt(self, method, key, path, body, hdrs, timeout, url):
+        """One transport-level try: acquire/dial, send, drain, pool.
+
+        The in-built stale-socket redial (a REUSED keep-alive socket the
+        peer quietly closed, idempotent GETs only) lives here — it is
+        connection management, not a retry, and costs no budget.  Fresh
+        dials are connected EXPLICITLY so a connect-phase failure can be
+        tagged ``request_never_sent`` — the proof the retry layer's
+        idempotency gate demands before re-sending a PATCH.
+        """
+        import http.client
+
         retried = False
         while True:
             conn, reused = self._acquire(key, timeout)
+            if conn.sock is None:
+                try:
+                    conn.connect()
+                except Exception as exc:  # noqa: BLE001 — tag, then surface
+                    conn.close()
+                    # Bytes provably never left this socket: safe to retry
+                    # even for non-idempotent methods.
+                    exc.request_never_sent = True
+                    raise
             try:
                 conn.request(method, path, body=body, headers=hdrs)
                 raw = conn.getresponse()
@@ -310,7 +408,12 @@ class _StdlibSession:
             # Non-2xx needs no exception mapping here: the status (3xx
             # included — redirects are never followed) rides the _Response
             # and surfaces through the raise_for_status contract.
-            return _Response(raw.status, payload, url)
+            return _Response(
+                raw.status,
+                payload,
+                url,
+                headers={k.lower(): v for k, v in raw.getheaders()},
+            )
 
     def get(self, url, params=None, timeout=None):
         return self._request("GET", url, params=params, timeout=timeout)
@@ -682,15 +785,28 @@ class KubeClient:
             )
         return items
 
+    def set_retry_policy(self, policy) -> None:
+        """Install (or clear) the graded retry policy on the transport.
+
+        Called by the checker once per round with a fresh shared budget.
+        Sessions that don't declare the attribute (a drop-in
+        ``requests.Session``) are left untouched — they bring their own
+        retry story."""
+        if hasattr(self._session, "retry_policy"):
+            self._session.retry_policy = policy
+
     def transport_stats(self) -> dict:
-        """Connection-pool telemetry from the session, when it keeps any
-        (the stdlib transport does; a drop-in requests.Session reports
+        """Connection-pool + retry telemetry from the session, when it keeps
+        any (the stdlib transport does; a drop-in requests.Session reports
         nothing).  Counters are session-lifetime monotonic."""
         stats = {}
-        for key in ("connections_opened", "requests_sent", "requests_reused"):
+        for key in ("connections_opened", "requests_sent", "requests_reused", "retries"):
             value = getattr(self._session, key, None)
             if isinstance(value, int) and not isinstance(value, bool):
                 stats[key] = value
+        by_reason = getattr(self._session, "retries_by_reason", None)
+        if isinstance(by_reason, dict) and by_reason:
+            stats["retries_by_reason"] = dict(by_reason)
         return stats
 
     def close(self) -> None:

@@ -60,8 +60,39 @@ def _line(name: str, value: float, labels: Optional[dict] = None) -> str:
     return f"{name} {value}"
 
 
-def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
-    """CheckResult → Prometheus text exposition (version 0.0.4)."""
+def _breaker_lines(breaker: dict) -> List[str]:
+    """The watch-breaker gauge families — ONE definition, shared by the
+    normal render and mark_error's no-result-yet branch (a pod that comes
+    up against a dead API server is exactly when these matter)."""
+    return [
+        "# HELP tpu_node_checker_watch_breaker_open 1 while the watch-mode "
+        "circuit breaker is open (consecutive failed check rounds; interval "
+        "widened, alerts collapsed).",
+        "# TYPE tpu_node_checker_watch_breaker_open gauge",
+        _line(
+            "tpu_node_checker_watch_breaker_open",
+            1.0 if breaker.get("open") else 0.0,
+        ),
+        "# HELP tpu_node_checker_watch_breaker_consecutive_failures "
+        "Consecutive failed watch rounds (resets to 0 on success).",
+        "# TYPE tpu_node_checker_watch_breaker_consecutive_failures gauge",
+        _line(
+            "tpu_node_checker_watch_breaker_consecutive_failures",
+            float(breaker.get("consecutive_failures", 0)),
+        ),
+    ]
+
+
+def render_metrics(
+    result,
+    exit_code_override: Optional[int] = None,
+    breaker: Optional[dict] = None,
+) -> str:
+    """CheckResult → Prometheus text exposition (version 0.0.4).
+
+    ``breaker`` (watch mode only) is the WatchBreaker state dict — rendered
+    as its own gauges so "the monitor itself is degraded" is alertable
+    separately from "the fleet is degraded"."""
     lines: List[str] = []
 
     def family(name: str, mtype: str, help_text: str, samples: List[Tuple[dict, float]]):
@@ -433,6 +464,37 @@ def render_metrics(result, exit_code_override: Optional[int] = None) -> str:
             "connection (no handshake paid).",
             [({}, transport.get("requests_reused", 0))],
         )
+        if "retries" in transport:
+            # Graded-retry telemetry (utils/retry.py): a climbing series
+            # means the API path is absorbing transient faults — the
+            # monitor staying green while this rises is the retry layer
+            # doing its job; the reason label says which fault class.
+            by_reason = transport.get("retries_by_reason") or {}
+            samples = [({"reason": r}, n) for r, n in sorted(by_reason.items())]
+            if not samples:
+                samples = [({"reason": "none"}, 0)]
+            family(
+                "tpu_node_checker_api_retries_total",
+                "counter",
+                "Transparent API request retries by transient-fault reason "
+                "(connect_refused, connection_reset, timeout, http_429, "
+                "http_5xx; 'none' = zero retries so far).",
+                samples,
+            )
+    if "total_nodes" in payload:
+        # Partial degradation: 1 when a NON-essential phase (events fetch,
+        # cordon/uncordon sweep) lost data this round.  The grade gauges
+        # stay truthful; this one says the triage detail around them is
+        # incomplete.
+        family(
+            "tpu_node_checker_round_degraded",
+            "gauge",
+            "1 when a non-essential phase (events, cordon/uncordon) failed "
+            "transiently this round — verdict stands, triage is partial.",
+            [({}, 1.0 if payload.get("degraded") else 0.0)],
+        )
+    if breaker is not None:
+        lines.extend(_breaker_lines(breaker))
     family(
         "tpu_node_checker_exit_code",
         "gauge",
@@ -500,8 +562,13 @@ class MetricsServer:
     def port(self) -> int:
         return self._server.server_address[1]
 
+    def set_breaker(self, state: Optional[dict]) -> None:
+        """Record the watch breaker's state for subsequent renders (watch
+        mode calls this every round, before update()/mark_error())."""
+        self._breaker = state
+
     def update(self, result) -> None:
-        body = render_metrics(result).encode()
+        body = render_metrics(result, breaker=getattr(self, "_breaker", None)).encode()
         with self._lock:
             self._body = body
             self._last_result = result
@@ -513,17 +580,21 @@ class MetricsServer:
         UNKNOWN, not zero) but ``exit_code`` flips so alerts on it fire, and
         ``last_run_timestamp_seconds`` deliberately goes stale.
         """
+        breaker = getattr(self, "_breaker", None)
         last = getattr(self, "_last_result", None)
         if last is None:
-            body = (
+            head = (
                 "# HELP tpu_node_checker_exit_code Exit code (1 = monitor error).\n"
                 "# TYPE tpu_node_checker_exit_code gauge\n"
                 f"tpu_node_checker_exit_code {exit_code}\n"
-            ).encode()
+            )
+            if breaker is not None:
+                head += "\n".join(_breaker_lines(breaker)) + "\n"
+            body = head.encode()
         else:
             # Re-render WITHOUT refreshing the timestamp: drop that family's
             # sample line so its staleness mirrors reality.
-            text = render_metrics(last, exit_code_override=exit_code)
+            text = render_metrics(last, exit_code_override=exit_code, breaker=breaker)
             body = "\n".join(
                 line
                 for line in text.splitlines()
