@@ -1,0 +1,774 @@
+"""Hysteresis FSM tests: unit-level state machine + the wired quarantine
+lifecycle (--history gating cordon/uncordon, the CHRONIC flap trap, Slack
+transitions, metrics, --trend-nodes) against a fake API server.
+"""
+
+import json
+import time
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, notify
+from tpu_node_checker.history.fsm import (
+    CHRONIC,
+    FAILED,
+    HEALTHY,
+    HealthFSM,
+    RECOVERING,
+    SUSPECT,
+)
+
+
+class TestHealthFSMUnit:
+    def test_defaults_collapse_to_per_round_behavior(self):
+        # K = M = 1: one bad round lands FAILED, one good round lands
+        # HEALTHY — exactly the pre-history snapshot policy.
+        fsm = HealthFSM()
+        assert fsm.observe("n", False) == (HEALTHY, FAILED)
+        assert fsm.cordon_eligible("n")
+        assert fsm.observe("n", True) == (FAILED, HEALTHY)
+        assert fsm.uncordon_eligible("n")
+
+    def test_cordon_after_debounces(self):
+        fsm = HealthFSM(cordon_after=3)
+        assert fsm.observe("n", False) == (HEALTHY, SUSPECT)
+        assert not fsm.cordon_eligible("n")
+        assert fsm.observe("n", False) is None  # SUSPECT, streak 2
+        assert fsm.observe("n", False) == (SUSPECT, FAILED)
+        assert fsm.cordon_eligible("n")
+
+    def test_one_good_round_clears_suspect(self):
+        fsm = HealthFSM(cordon_after=3)
+        fsm.observe("n", False)
+        assert fsm.observe("n", True) == (SUSPECT, HEALTHY)
+        # The bad streak restarted: two MORE bad rounds are not enough.
+        fsm.observe("n", False)
+        fsm.observe("n", False)
+        assert fsm.health("n").state == SUSPECT
+
+    def test_uncordon_after_requires_consecutive_good(self):
+        fsm = HealthFSM(uncordon_after=3, flap_threshold=10, flap_window=10)
+        fsm.observe("n", False)  # FAILED (K=1)
+        assert fsm.observe("n", True) == (FAILED, RECOVERING)
+        assert not fsm.uncordon_eligible("n")
+        fsm.observe("n", True)
+        assert fsm.health("n").state == RECOVERING
+        assert fsm.observe("n", True) == (RECOVERING, HEALTHY)
+        assert fsm.uncordon_eligible("n")
+
+    def test_bad_round_mid_recovery_restarts_the_clock(self):
+        fsm = HealthFSM(cordon_after=2, uncordon_after=2,
+                        flap_threshold=10, flap_window=10)
+        fsm.observe("n", False)
+        fsm.observe("n", False)  # FAILED (K=2)
+        fsm.observe("n", True)  # RECOVERING streak 1 (< M)
+        assert fsm.observe("n", False) == (RECOVERING, SUSPECT)
+        # The good streak is gone: recovery restarts from scratch.
+        fsm.observe("n", False)
+        assert fsm.health("n").state == FAILED
+
+    def test_flap_detector_trips_chronic_and_sticks(self):
+        fsm = HealthFSM(cordon_after=2, uncordon_after=3)
+        verdicts = [False, True, False, True, False]
+        for v in verdicts[:-1]:
+            fsm.observe("n", v)
+            assert fsm.health("n").state != CHRONIC
+        assert fsm.observe("n", verdicts[-1]) == (HEALTHY, CHRONIC)
+        assert fsm.cordon_eligible("n")
+        # Sticky: good rounds never lift CHRONIC.
+        for _ in range(10):
+            fsm.observe("n", True)
+        assert fsm.health("n").state == CHRONIC
+        assert not fsm.uncordon_eligible("n")
+
+    def test_out_of_band_uncordon_resets_to_recovering_not_healthy(self):
+        fsm = HealthFSM(uncordon_after=3, flap_threshold=10, flap_window=10)
+        fsm.observe("n", False)  # FAILED
+        t = fsm.observe("n", True, uncordoned_out_of_band=True)
+        assert t == (FAILED, RECOVERING)
+        assert fsm.health("n").state == RECOVERING
+        assert not fsm.uncordon_eligible("n")
+
+    def test_out_of_band_releases_chronic_into_recovering(self):
+        fsm = HealthFSM(uncordon_after=2)
+        for v in [False, True, False, True, False]:
+            fsm.observe("n", v)
+        assert fsm.health("n").state == CHRONIC
+        fsm.observe("n", True, uncordoned_out_of_band=True)
+        assert fsm.health("n").state == RECOVERING
+        t = [x for x in fsm.transitions if x["from"] == CHRONIC]
+        assert t and t[-1]["actionable"]
+
+    def test_none_verdict_holds_all_state(self):
+        fsm = HealthFSM(cordon_after=2)
+        fsm.observe("n", False)
+        h_before = (fsm.health("n").state, fsm.health("n").streak,
+                    list(fsm.health("n").verdicts))
+        assert fsm.observe("n", None) is None
+        h_after = (fsm.health("n").state, fsm.health("n").streak,
+                   list(fsm.health("n").verdicts))
+        assert h_before == h_after
+
+    def test_actionable_classification(self):
+        fsm = HealthFSM(cordon_after=2, uncordon_after=2,
+                        flap_threshold=10, flap_window=10)
+        for v in [False, False, True, True]:
+            fsm.observe("n", v)
+        flagged = {(t["from"], t["to"]): t["actionable"] for t in fsm.transitions}
+        assert flagged[(HEALTHY, SUSPECT)] is False
+        assert flagged[(SUSPECT, FAILED)] is True
+        assert flagged[(FAILED, RECOVERING)] is False
+        assert flagged[(RECOVERING, HEALTHY)] is True
+
+    def test_seed_restores_state_and_flap_window(self):
+        fsm = HealthFSM(cordon_after=2, flap_threshold=4, flap_window=10)
+        entries = [
+            {"ok": ok, "state": SUSPECT, "streak": 1, "flaps_total": 3}
+            for ok in [False, True, False, True]
+        ]
+        fsm.seed("n", entries)
+        h = fsm.health("n")
+        assert h.state == SUSPECT and h.flaps == 3 and h.flaps_total == 3
+        # The next flip is the fourth inside the window: CHRONIC.
+        fsm.observe("n", False)
+        assert h.state == CHRONIC
+
+    def test_seed_unknown_state_degrades_to_healthy(self):
+        fsm = HealthFSM()
+        fsm.seed("n", [{"ok": False, "state": "BOGUS_FUTURE_STATE", "streak": 9}])
+        assert fsm.health("n").state == HEALTHY
+        assert fsm.health("n").streak == 0
+
+    def test_state_counts_cover_every_state(self):
+        fsm = HealthFSM()
+        fsm.observe("a", False)
+        counts = fsm.state_counts()
+        assert counts[FAILED] == 1
+        assert set(counts) == {HEALTHY, SUSPECT, FAILED, RECOVERING, CHRONIC}
+
+
+@pytest.fixture
+def fake_api(tmp_path):
+    """Fake API server recording PATCHes + a kubeconfig pointing at it
+    (same seam as tests/test_cordon.py)."""
+    patches = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_PATCH(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            patches.append({"path": self.path, "body": json.loads(body)})
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *args):
+            pass
+
+    server = fx.serve_http(Handler)
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: t\n"
+        "contexts: [{name: t, context: {cluster: t, user: t}}]\n"
+        "clusters: [{name: t, cluster: {server: "
+        f'"http://127.0.0.1:{server.server_address[1]}"}}}}]\n'
+        "users: [{name: t, user: {token: tok}}]\n"
+    )
+    yield {"patches": patches, "kubeconfig": str(kubeconfig)}
+    server.shutdown()
+
+
+def _tpu_node(name="tpu-0", **kw):
+    return fx.make_node(
+        name,
+        allocatable={"google.com/tpu": "4"},
+        labels={
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-nodepool": "p",
+        },
+        **kw,
+    )
+
+
+def _probe_dir(tmp_path, verdicts, tag):
+    d = tmp_path / f"probes-{tag}"
+    d.mkdir()
+    for host, ok in verdicts.items():
+        (d / f"{host}.json").write_text(
+            json.dumps(
+                {
+                    "ok": ok,
+                    "level": "compute",
+                    "hostname": host,
+                    "written_at": time.time(),
+                    "error": None if ok else "matmul numerics failed",
+                }
+            )
+        )
+    return str(d)
+
+
+class TestFlapScenario:
+    """The acceptance scenario: one node alternating fail/pass per round
+    under K=2 / M=3 produces exactly one cordon PATCH, zero uncordon
+    PATCHes, one Slack CHRONIC-transition message, and ends CHRONIC."""
+
+    def test_alternating_node_is_trapped_not_churned(
+        self, tmp_path, fake_api, monkeypatch, capsys
+    ):
+        sent = []
+        monkeypatch.setattr(
+            notify, "send_slack_message",
+            lambda url, message, **kw: sent.append(message) or True,
+        )
+        hist = str(tmp_path / "history.jsonl")
+        cordoned = False  # mirrors what the fake API applied
+        final_payload = None
+        for i, ok in enumerate([False, True, False, True, False, True, False]):
+            nodes = [_tpu_node(unschedulable=cordoned)]
+            if cordoned:
+                nodes[0]["metadata"]["annotations"] = {
+                    "tpu-node-checker.io/quarantined": "1700000000"
+                }
+            nodes_json = tmp_path / f"nodes-{i}.json"
+            nodes_json.write_text(json.dumps(fx.node_list(nodes)))
+            args = cli.parse_args(
+                [
+                    "--nodes-json", str(nodes_json),
+                    "--kubeconfig", fake_api["kubeconfig"],
+                    "--probe-results", _probe_dir(tmp_path, {"tpu-0": ok}, i),
+                    "--history", hist,
+                    "--cordon-after", "2",
+                    "--uncordon-after", "3",
+                    "--cordon-failed", "--uncordon-recovered",
+                    "--slack-webhook", "https://hooks.example/x",
+                    "--json",
+                ]
+            )
+            checker.one_shot(args)
+            final_payload = json.loads(capsys.readouterr().out)
+            if final_payload["cordon"]["cordoned"]:
+                cordoned = True
+            if final_payload["uncordon"]["uncordoned"]:
+                cordoned = False
+        # Exactly ONE PATCH total: the cordon at the CHRONIC transition —
+        # no cordon/uncordon churn, despite seven alternating rounds.
+        assert [p["path"] for p in fake_api["patches"]] == ["/api/v1/nodes/tpu-0"]
+        assert fake_api["patches"][0]["body"]["spec"] == {"unschedulable": True}
+        # Exactly one Slack message carries the CHRONIC transition line.
+        assert sum("went CHRONIC" in m for m in sent) == 1
+        # The node ends CHRONIC, visible on every surface.
+        assert final_payload["nodes"][0]["health"]["state"] == "CHRONIC"
+        assert final_payload["history"]["chronic"] == ["tpu-0"]
+        assert final_payload["history"]["states"]["CHRONIC"] == 1
+
+    def test_without_history_payload_is_byte_identical(self, tmp_path, capsys):
+        # The no-flag contract on the 8-node fixture: --history absent →
+        # the payload has no history key and no per-node health entries,
+        # and turning the flag ON changes NOTHING else — stripping the two
+        # additive keys (and the wall-clock timings) yields byte-identical
+        # JSON and the same exit code.
+        nodes = fx.tpu_v5p_64_slice()[:8]
+
+        def run(extra=()):
+            args = cli.parse_args(["--json", *extra])
+            code = checker.one_shot(
+                args, nodes=[json.loads(json.dumps(n)) for n in nodes]
+            )
+            return code, json.loads(capsys.readouterr().out)
+
+        code_off, off = run()
+        code_on, on = run(["--history", str(tmp_path / "h.jsonl")])
+        assert "history" not in off
+        assert all("health" not in n for n in off["nodes"])
+        assert code_on == code_off
+        on.pop("history")
+        for n in on["nodes"]:
+            n.pop("health")
+        off.pop("timings_ms"), on.pop("timings_ms")
+        assert json.dumps(on, sort_keys=True) == json.dumps(off, sort_keys=True)
+
+    def test_chronic_rides_trend_causes(self, tmp_path, capsys):
+        # A CHRONIC node is an exit-3-style cause: when the fleet grades
+        # degraded, the cause list names the flapper as its own class.
+        from tpu_node_checker.checker import _cause_class, _round_causes
+
+        payload = {
+            "nodes": [{"name": "tpu-0", "ready": False}],
+            "history": {"chronic": ["tpu-0"]},
+        }
+        causes = _round_causes(payload)
+        assert "chronic-flapper: tpu-0" in causes
+        assert _cause_class("chronic-flapper: tpu-0") == "chronic-flapper"
+
+
+class TestHysteresisGating:
+    def _run(self, tmp_path, fake_api, capsys, tag, ok, extra=(), node=None):
+        nodes_json = tmp_path / f"nodes-{tag}.json"
+        nodes_json.write_text(json.dumps(fx.node_list([node or _tpu_node()])))
+        args = cli.parse_args(
+            [
+                "--nodes-json", str(nodes_json),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", _probe_dir(tmp_path, {"tpu-0": ok}, tag),
+                "--history", str(tmp_path / "history.jsonl"),
+                "--json",
+                *extra,
+            ]
+        )
+        code = checker.one_shot(args)
+        return code, json.loads(capsys.readouterr().out)
+
+    def test_single_bad_round_under_k2_is_not_cordoned(
+        self, tmp_path, fake_api, capsys
+    ):
+        _, payload = self._run(
+            tmp_path, fake_api, capsys, 0, ok=False,
+            extra=["--cordon-after", "2", "--cordon-failed"],
+        )
+        assert fake_api["patches"] == []
+        assert payload["cordon"]["cordoned"] == []
+        assert payload["nodes"][0]["health"]["state"] == "SUSPECT"
+
+    def test_kth_consecutive_bad_round_cordons(self, tmp_path, fake_api, capsys):
+        self._run(tmp_path, fake_api, capsys, 0, ok=False,
+                  extra=["--cordon-after", "2", "--cordon-failed"])
+        _, payload = self._run(
+            tmp_path, fake_api, capsys, 1, ok=False,
+            extra=["--cordon-after", "2", "--cordon-failed"],
+        )
+        assert [p["path"] for p in fake_api["patches"]] == ["/api/v1/nodes/tpu-0"]
+        assert payload["cordon"]["cordoned"] == ["tpu-0"]
+        assert payload["nodes"][0]["health"]["state"] == "FAILED"
+
+    def test_quarantined_node_needs_m_good_rounds_to_lift(
+        self, tmp_path, fake_api, capsys
+    ):
+        q = _tpu_node(unschedulable=True)
+        q["metadata"]["annotations"] = {
+            "tpu-node-checker.io/quarantined": "1700000000"
+        }
+        extra = ["--uncordon-after", "3", "--uncordon-recovered"]
+        # Seed the machine FAILED (while not yet cordoned in the fixture).
+        self._run(tmp_path, fake_api, capsys, 0, ok=False)
+        for tag in (1, 2):
+            _, payload = self._run(
+                tmp_path, fake_api, capsys, tag, ok=True, extra=extra,
+                node=json.loads(json.dumps(q)),
+            )
+            assert fake_api["patches"] == []  # still RECOVERING
+            assert payload["uncordon"]["uncordoned"] == []
+            assert payload["nodes"][0]["health"]["state"] == "RECOVERING"
+        _, payload = self._run(
+            tmp_path, fake_api, capsys, 3, ok=True, extra=extra,
+            node=json.loads(json.dumps(q)),
+        )
+        assert [p["path"] for p in fake_api["patches"]] == ["/api/v1/nodes/tpu-0"]
+        assert fake_api["patches"][0]["body"]["spec"] == {"unschedulable": False}
+        assert payload["uncordon"]["uncordoned"] == ["tpu-0"]
+        assert payload["nodes"][0]["health"]["state"] == "HEALTHY"
+
+    def test_out_of_band_uncordon_resets_to_recovering_and_clears_annotation(
+        self, tmp_path, fake_api, capsys
+    ):
+        # Regression (satellite): the stale-annotation sweep and the FSM
+        # must agree — `kubectl uncordon` mid-quarantine leaves the node
+        # RECOVERING (re-earning HEALTHY over M rounds), never HEALTHY,
+        # while the sweep strips the stale annotation.
+        self._run(tmp_path, fake_api, capsys, 0, ok=False)  # FAILED
+        ooband = _tpu_node()  # schedulable again, annotation left behind
+        ooband["metadata"]["annotations"] = {
+            "tpu-node-checker.io/quarantined": "1700000000"
+        }
+        _, payload = self._run(
+            tmp_path, fake_api, capsys, 1, ok=True,
+            extra=["--uncordon-after", "3", "--uncordon-recovered"],
+            node=ooband,
+        )
+        assert payload["nodes"][0]["health"]["state"] == "RECOVERING"
+        assert payload["uncordon"]["stale_annotations_cleared"] == ["tpu-0"]
+        # The sweep's annotation-strip PATCH went out; no uncordon PATCH.
+        assert len(fake_api["patches"]) == 1
+        assert "spec" not in fake_api["patches"][0]["body"]
+
+    def test_quarantined_node_without_report_holds_state(
+        self, tmp_path, fake_api, capsys
+    ):
+        # Absence is not evidence in EITHER direction: a quarantined node
+        # with no probe report this round neither heals toward
+        # --uncordon-after nor accrues bad rounds.
+        self._run(tmp_path, fake_api, capsys, 0, ok=False)  # FAILED
+        q = _tpu_node(unschedulable=True)
+        q["metadata"]["annotations"] = {
+            "tpu-node-checker.io/quarantined": "1700000000"
+        }
+        nodes_json = tmp_path / "nodes-noreport.json"
+        nodes_json.write_text(json.dumps(fx.node_list([q])))
+        empty = tmp_path / "probes-empty"
+        empty.mkdir()
+        args = cli.parse_args(
+            [
+                "--nodes-json", str(nodes_json),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--probe-results", str(empty),
+                "--history", str(tmp_path / "history.jsonl"),
+                "--uncordon-after", "2", "--uncordon-recovered",
+                "--json",
+            ]
+        )
+        checker.one_shot(args)
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["nodes"][0]["health"]["state"] == "FAILED"
+        assert fake_api["patches"] == []
+
+
+class TestReviewRegressions:
+    def test_missing_reports_do_not_bank_rounds_toward_cordon(
+        self, tmp_path, fake_api, capsys
+    ):
+        # K-1 rounds of ABSENT reports (--probe-results-required synthesizes
+        # level="missing") plus one real failure must not reach FAILED: the
+        # debounce promises K consecutive rounds of real evidence.
+        hist = str(tmp_path / "history.jsonl")
+        node_json = tmp_path / "nodes.json"
+        node_json.write_text(json.dumps(fx.node_list([_tpu_node()])))
+
+        def run(tag, reports):
+            args = cli.parse_args(
+                [
+                    "--nodes-json", str(node_json),
+                    "--kubeconfig", fake_api["kubeconfig"],
+                    "--probe-results", reports, "--probe-results-required",
+                    "--history", hist, "--cordon-after", "2",
+                    "--cordon-failed", "--json",
+                ]
+            )
+            checker.one_shot(args)
+            return json.loads(capsys.readouterr().out)
+
+        empty = tmp_path / "probes-none"
+        empty.mkdir()
+        p1 = run(0, str(empty))  # missing: no evidence
+        assert p1["nodes"][0]["health"]["state"] == "HEALTHY"
+        p2 = run(1, _probe_dir(tmp_path, {"tpu-0": False}, "real"))
+        # One real bad round: SUSPECT (streak 1 of 2), NOT FAILED/cordoned.
+        assert p2["nodes"][0]["health"]["state"] == "SUSPECT"
+        assert fake_api["patches"] == []
+
+    def test_unwritable_store_still_advances_hysteresis_in_process(
+        self, tmp_path, capsys
+    ):
+        # The never-fatal contract end to end: with the store path
+        # unwritable (a directory), consecutive rounds in ONE process must
+        # still accumulate state through the cached in-memory machine — a
+        # full disk must not freeze the debounce clock.
+        nodes = [_tpu_node()]
+
+        def run(tag, ok):
+            args = cli.parse_args(
+                [
+                    "--probe-results", _probe_dir(tmp_path, {"tpu-0": ok}, tag),
+                    "--history", str(tmp_path),  # a DIRECTORY: writes fail
+                    "--cordon-after", "2", "--json",
+                ]
+            )
+            return checker.run_check(args, nodes=[json.loads(json.dumps(n)) for n in nodes])
+
+        r1 = run("a", False)
+        assert r1.payload["nodes"][0]["health"]["state"] == "SUSPECT"
+        r2 = run("b", False)
+        assert r2.payload["nodes"][0]["health"]["state"] == "FAILED"
+        assert "Cannot append history store" in capsys.readouterr().err
+
+    def test_recovering_to_healthy_alerts_under_slack_on_change(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        # The lift-enabling transition leaves neither the exit code nor the
+        # sick set changed (the node left FAILED rounds earlier), yet it
+        # must page: actionable transitions are part of the change test.
+        from tpu_node_checker import notify as notify_mod
+
+        sent = []
+        monkeypatch.setattr(
+            notify_mod, "send_slack_message",
+            lambda url, message, **kw: sent.append(message) or True,
+        )
+        monkeypatch.setattr(checker, "_wait_for_next_round", lambda stop, s: False)
+        verdicts = [False, True, True, True]  # FAILED → R → R → HEALTHY
+
+        def fake_fetch(args, timer):
+            if not verdicts:
+                raise KeyboardInterrupt
+            ok = verdicts.pop(0)
+            # A healthy companion keeps the AGGREGATE exit at 0 throughout:
+            # only the hysteresis transition can page.
+            d = _probe_dir(
+                tmp_path, {"tpu-0": ok, "tpu-1": True}, f"w{len(verdicts)}"
+            )
+            args.probe_results = d
+            return [
+                json.loads(json.dumps(_tpu_node())),
+                json.loads(json.dumps(_tpu_node("tpu-1"))),
+            ], None
+
+        monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
+        code = cli.main(
+            [
+                "--watch", "1", "--slack-on-change",
+                "--slack-webhook", "https://x",
+                "--probe-results", str(tmp_path),
+                "--history", str(tmp_path / "h.jsonl"),
+                "--uncordon-after", "3",
+            ]
+        )
+        assert code == 130
+        # Round 1 (first state + →FAILED), round 2 (tpu-0 leaves the sick
+        # set: FAILED→RECOVERING), round 3 silent (RECOVERING wobble is
+        # sub-threshold), round 4 pages the re-earned HEALTHY despite an
+        # unchanged exit code AND unchanged (empty) sick set.
+        assert len(sent) == 3
+        assert "→ HEALTHY" in sent[-1]
+        capsys.readouterr()
+
+    def test_trend_nodes_survives_malformed_dict_lines(self, tmp_path, capsys):
+        # A hand-edited line with a string ts / string flaps_total is a
+        # dict (passes the tolerant loader) but must degrade, not crash.
+        hist = tmp_path / "h.jsonl"
+        hist.write_text(
+            json.dumps({"schema": 1, "node": "a", "ts": "oops", "ok": False,
+                        "state": "FAILED", "flaps_total": "3"}) + "\n"
+            + json.dumps({"schema": 1, "node": "a", "ts": 1_700_000_060.0,
+                          "ok": True, "state": "HEALTHY", "streak": 1,
+                          "flaps": 0, "flaps_total": 1}) + "\n"
+        )
+        assert cli.main(["--trend-nodes", str(hist), "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["nodes"]["a"]["rounds"] == 2
+
+    def test_flap_window_default_checked_against_small_max_rounds(self, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--history", "h", "--history-max-rounds", "4"])
+        assert "cannot exceed --history-max-rounds" in capsys.readouterr().err
+
+    def test_flaps_counter_is_monotonic_across_node_departure(self, tmp_path):
+        # flaps_total sums over every node the STORE remembers: a departed
+        # flapper's flips must not vanish (Prometheus would read the drop
+        # as a counter reset → spurious rate spike on scale-down).
+        from tpu_node_checker.checker import _history_payload
+        from tpu_node_checker.history import HealthFSM, HistoryStore
+        from tpu_node_checker.detect import NodeInfo
+
+        fsm = HealthFSM()
+        for v in (False, True, False, True):
+            fsm.observe("departed", v)
+        flaps = fsm.health("departed").flaps_total
+        assert flaps == 3
+        survivor = NodeInfo(name="alive", ready=True, accelerators=4,
+                            breakdown={}, families=("tpu",), labels={},
+                            taints=[])
+        fsm.observe("alive", True)
+        payload = _history_payload(
+            {"fsm": fsm, "store": HistoryStore(str(tmp_path / "h"))},
+            [survivor],
+        )
+        assert payload["flaps_total"] == flaps  # departed node still counted
+        assert payload["states"]["HEALTHY"] == 1  # gauges: fleet-only
+
+
+class TestHistorySurfaces:
+    def test_metrics_families(self, tmp_path):
+        from tpu_node_checker.metrics import render_metrics
+
+        result = checker.CheckResult(
+            exit_code=0,
+            payload={
+                "total_nodes": 2,
+                "ready_nodes": 1,
+                "nodes": [],
+                "slices": [],
+                "history": {
+                    "states": {"HEALTHY": 1, "CHRONIC": 1},
+                    "chronic": ["tpu-1"],
+                    "flaps_total": 7,
+                    "transitions": [],
+                },
+            },
+        )
+        text = render_metrics(result)
+        assert 'tpu_node_checker_node_state{state="HEALTHY"} 1.0' in text
+        assert 'tpu_node_checker_node_state{state="CHRONIC"} 1.0' in text
+        # Every state emits, 0 included — recovery is a return to zero.
+        assert 'tpu_node_checker_node_state{state="SUSPECT"} 0.0' in text
+        assert "tpu_node_checker_node_flaps_total 7.0" in text
+
+    def test_no_history_no_families(self):
+        from tpu_node_checker.metrics import render_metrics
+
+        result = checker.CheckResult(
+            exit_code=0,
+            payload={"total_nodes": 1, "ready_nodes": 1, "nodes": [], "slices": []},
+        )
+        text = render_metrics(result)
+        assert "tpu_node_checker_node_state" not in text
+        assert "tpu_node_checker_node_flaps_total" not in text
+
+    def test_slack_only_on_error_pages_on_actionable_transition(self):
+        # Exit 0 + a node going CHRONIC must page through
+        # --slack-only-on-error: the aggregate code never moves for one
+        # flapper in a big fleet.
+        assert notify.should_send_slack_message(
+            "https://x", True, healthy=True, transitions=True
+        )
+        assert not notify.should_send_slack_message(
+            "https://x", True, healthy=True, transitions=False
+        )
+
+    def test_emitter_mode_records_history(self, tmp_path, monkeypatch):
+        from tpu_node_checker.probe.liveness import ProbeResult
+
+        emissions = []
+
+        def fake_probe(**kw):
+            emissions.append(1)
+            sick = len(emissions) % 2 == 1  # alternating: a flapping chip
+            return ProbeResult(
+                ok=not sick, level="enumerate", hostname="h", elapsed_ms=1.0,
+                device_count=8, error="dead" if sick else None,
+            )
+
+        monkeypatch.setattr("tpu_node_checker.probe.run_local_probe", fake_probe)
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round", lambda stop, s: len(emissions) >= 6
+        )
+        out = tmp_path / "h.json"
+        log = tmp_path / "rounds.jsonl"
+        hist = tmp_path / "history.jsonl"
+        code = cli.main([
+            "--emit-probe", str(out), "--watch", "1",
+            "--history", str(hist), "--log-jsonl", str(log),
+        ])
+        assert code == 143
+        entries = [json.loads(x) for x in log.read_text().splitlines()]
+        # The emitter's own round log carries the hysteresis state…
+        assert [e["state"] for e in entries[:2]] == ["FAILED", "HEALTHY"]
+        # …and the flapping chip ends CHRONIC in the store.
+        stored = [json.loads(x) for x in hist.read_text().splitlines()]
+        assert stored[-1]["node"] == "h"
+        assert stored[-1]["state"] == "CHRONIC"
+
+    def test_trend_nodes_view(self, tmp_path, capsys):
+        hist = tmp_path / "history.jsonl"
+        t0 = 1_700_000_000.0
+        lines = []
+        # tpu-0: fails rounds 2-3 of 6 (one outage, repaired) …
+        for i, ok in enumerate([True, True, False, False, True, True]):
+            lines.append({"schema": 1, "node": "tpu-0", "ts": t0 + 60 * i,
+                          "ok": ok, "causes": [] if ok else ["probe-failed"],
+                          "state": "HEALTHY" if ok else "FAILED",
+                          "streak": 1, "flaps": 0, "flaps_total": 0})
+        # …tpu-1: a chronic flapper.
+        for i, ok in enumerate([False, True, False, True, False, True]):
+            lines.append({"schema": 1, "node": "tpu-1", "ts": t0 + 60 * i,
+                          "ok": ok, "causes": [] if ok else ["probe-failed"],
+                          "state": "CHRONIC", "streak": 0, "flaps": 5,
+                          "flaps_total": 5})
+        hist.write_text("\n".join(json.dumps(x) for x in lines) + "\n")
+        assert cli.main(["--trend-nodes", str(hist), "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["chronic"] == ["tpu-1"]
+        assert s["worst_offenders"][0] == "tpu-1"  # 50% < 66.67%
+        n0 = s["nodes"]["tpu-0"]
+        assert n0["availability_pct"] == pytest.approx(66.67, abs=0.01)
+        assert n0["failures"] == 1
+        assert n0["mttr_s"] == 120.0  # failed at t+120, good again at t+240
+        n1 = s["nodes"]["tpu-1"]
+        assert n1["failures"] == 3
+        assert n1["mtbf_s"] == 120.0  # onsets at t+0, t+120, t+240
+        assert n1["top_causes"] == ["probe-failed"]
+        # Human rendering: worst offender leads the table.
+        assert cli.main(["--trend-nodes", str(hist)]) == 0
+        out = capsys.readouterr().out
+        assert "chronic flappers: tpu-1" in out
+        assert out.index("tpu-1") < out.index("tpu-0  ")
+
+    def test_trend_nodes_empty_and_unreadable_are_machine_readable(
+        self, tmp_path, capsys
+    ):
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("\n  \n")
+        assert cli.main(["--trend-nodes", str(empty), "--json"]) == 1
+        captured = capsys.readouterr()
+        assert json.loads(captured.out)["nodes"] == {}
+        assert "Traceback" not in captured.err
+        assert cli.main(["--trend-nodes", str(tmp_path / "absent"), "--json"]) == 1
+        assert "error" in json.loads(capsys.readouterr().out)
+
+    def test_trend_surfaces_chronic_from_round_log(self, tmp_path, capsys):
+        # --history rounds record standing chronic flappers in the trend
+        # log even on exit-0 rounds; --trend must surface the current set.
+        log = tmp_path / "trend.jsonl"
+        log.write_text(
+            json.dumps({"ts": 1_700_000_000, "exit_code": 0}) + "\n"
+            + json.dumps({"ts": 1_700_000_060, "exit_code": 0,
+                          "chronic": ["tpu-3"]}) + "\n"
+        )
+        assert cli.main(["--trend", str(log), "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["chronic_nodes"] == ["tpu-3"]
+        assert cli.main(["--trend", str(log)]) == 0
+        assert "chronic flappers held in quarantine: tpu-3" in (
+            capsys.readouterr().out
+        )
+
+    def test_state_log_records_chronic_on_exit0_rounds(
+        self, tmp_path, monkeypatch
+    ):
+        # The state-log side of the same contract: chronic rides the entry
+        # even when the round grades 0 (causes only exist on bad rounds).
+        log = tmp_path / "t.jsonl"
+        args = cli.parse_args(["--log-jsonl", str(log)])
+        result = checker.CheckResult(
+            exit_code=0,
+            payload={
+                "total_nodes": 2, "ready_nodes": 1, "total_chips": 8,
+                "ready_chips": 4, "slices": [],
+                "history": {"chronic": ["tpu-1"]},
+            },
+        )
+        checker._append_state_log(args, result)
+        (entry,) = [json.loads(x) for x in log.read_text().splitlines()]
+        assert entry["exit_code"] == 0
+        assert entry["chronic"] == ["tpu-1"]
+
+    def test_trend_nodes_runs_alone(self, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--trend-nodes", "f", "--probe"])
+        assert "--trend-nodes runs alone" in capsys.readouterr().err
+
+
+class TestHistoryCli:
+    @pytest.mark.parametrize(
+        "argv, fragment",
+        [
+            (["--cordon-after", "2"], "requires --history"),
+            (["--uncordon-after", "2"], "requires --history"),
+            (["--flap-threshold", "4"], "requires --history"),
+            (["--flap-window", "10"], "requires --history"),
+            (["--history-max-rounds", "8"], "requires --history"),
+            (["--history", "h", "--cordon-after", "0"], "at least 1"),
+            (["--history", "h", "--flap-threshold", "1"], "at least 2"),
+            (["--history", "h", "--flap-window", "1"], "at least 2"),
+            (
+                ["--history", "h", "--flap-window", "8",
+                 "--history-max-rounds", "4"],
+                "cannot exceed --history-max-rounds",
+            ),
+            (["--trend", "t", "--history", "h"], "--trend runs alone"),
+        ],
+    )
+    def test_flag_validation(self, argv, fragment, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(argv)
+        assert fragment in capsys.readouterr().err

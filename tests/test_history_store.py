@@ -1,0 +1,206 @@
+"""History store tests: round-trip, bounds, compaction, torn-line tolerance.
+
+Includes the tier-1 property test (seeded stdlib ``random`` — no external
+fuzzing dependency): arbitrary append sequences must round-trip through
+load, survive compaction byte-for-byte in content, and never lose more
+than the bound says they may.
+"""
+
+import json
+import random
+
+import pytest
+
+from tpu_node_checker.history.store import (
+    DEFAULT_MAX_ROUNDS,
+    HISTORY_SCHEMA_VERSION,
+    HistoryStore,
+    read_jsonl_tolerant,
+)
+
+
+def _entry(node, i, ok=True, **extra):
+    return {
+        "node": node,
+        "ts": 1_700_000_000.0 + i,
+        "ok": ok,
+        "causes": [] if ok else ["probe-failed"],
+        "state": "HEALTHY" if ok else "SUSPECT",
+        "streak": 1,
+        "flaps": 0,
+        "flaps_total": 0,
+        **extra,
+    }
+
+
+class TestReadJsonlTolerant:
+    def test_skips_torn_final_line(self, tmp_path):
+        p = tmp_path / "h.jsonl"
+        p.write_text(json.dumps({"a": 1}) + "\n" + '{"torn": tru')
+        entries, skipped = read_jsonl_tolerant(str(p))
+        assert entries == [{"a": 1}]
+        assert skipped == 1
+
+    def test_whitespace_only_file_is_empty_not_error(self, tmp_path):
+        p = tmp_path / "h.jsonl"
+        p.write_text("\n   \n\t\n")
+        assert read_jsonl_tolerant(str(p)) == ([], 0)
+
+    def test_non_dict_roots_are_skipped(self, tmp_path):
+        # "3" and "[1]" are valid JSON; every consumer indexes by key.
+        p = tmp_path / "h.jsonl"
+        p.write_text('3\n[1, 2]\n{"ok": true}\n')
+        entries, skipped = read_jsonl_tolerant(str(p))
+        assert entries == [{"ok": True}]
+        assert skipped == 2
+
+    def test_garbage_mid_file_costs_only_its_line(self, tmp_path):
+        p = tmp_path / "h.jsonl"
+        p.write_text('{"a": 1}\nnot json at all\n{"b": 2}\n')
+        entries, skipped = read_jsonl_tolerant(str(p))
+        assert entries == [{"a": 1}, {"b": 2}]
+        assert skipped == 1
+
+    def test_missing_file_raises_for_caller_policy(self, tmp_path):
+        with pytest.raises(OSError):
+            read_jsonl_tolerant(str(tmp_path / "absent.jsonl"))
+
+
+class TestHistoryStore:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "h.jsonl")
+        store = HistoryStore(path)
+        for i in range(3):
+            store.record(_entry("tpu-0", i))
+        store.record(_entry("tpu-1", 0, ok=False))
+        store.flush()
+        fresh = HistoryStore(path)
+        by_node = fresh.load()
+        assert set(by_node) == {"tpu-0", "tpu-1"}
+        assert len(by_node["tpu-0"]) == 3
+        assert by_node["tpu-1"][0]["ok"] is False
+        # Every persisted line carries the schema major.
+        assert all(
+            e["schema"] == HISTORY_SCHEMA_VERSION
+            for tail in by_node.values()
+            for e in tail
+        )
+
+    def test_missing_file_loads_empty(self, tmp_path):
+        store = HistoryStore(str(tmp_path / "absent.jsonl"))
+        assert store.load() == {}
+
+    def test_load_bounds_per_node_tail(self, tmp_path):
+        path = str(tmp_path / "h.jsonl")
+        with open(path, "w") as f:
+            for i in range(50):
+                f.write(json.dumps(_entry("tpu-0", i)) + "\n")
+        store = HistoryStore(path, max_rounds=8)
+        by_node = store.load()
+        assert len(by_node["tpu-0"]) == 8
+        assert by_node["tpu-0"][-1]["ts"] == 1_700_000_000.0 + 49
+
+    def test_torn_final_line_tolerated(self, tmp_path):
+        path = str(tmp_path / "h.jsonl")
+        store = HistoryStore(path)
+        store.record(_entry("tpu-0", 0))
+        store.flush()
+        with open(path, "a") as f:
+            f.write('{"node": "tpu-0", "ts": 1700000001.0, "ok": tr')  # crash
+        fresh = HistoryStore(path)
+        by_node = fresh.load()
+        assert len(by_node["tpu-0"]) == 1
+        assert fresh.skipped_lines == 1
+
+    def test_future_schema_major_refused_not_misread(self, tmp_path, capsys):
+        path = str(tmp_path / "h.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(_entry("tpu-0", 0)) + "\n")
+            f.write(
+                json.dumps(
+                    {**_entry("tpu-0", 1), "schema": HISTORY_SCHEMA_VERSION + 1}
+                )
+                + "\n"
+            )
+        store = HistoryStore(path)
+        by_node = store.load()
+        assert len(by_node["tpu-0"]) == 1  # the foreign line did not load
+        assert store.refused_lines == 1
+        assert "schema major" in capsys.readouterr().err
+
+    def test_schemaless_line_accepted(self, tmp_path):
+        # Pre-versioning lines (no "schema" key) keep loading — the same
+        # rolling-upgrade posture as the probe report contract.
+        path = str(tmp_path / "h.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(_entry("tpu-0", 0)) + "\n")
+        assert "schema" not in json.loads(open(path).read())
+        assert len(HistoryStore(path).load()["tpu-0"]) == 1
+
+    def test_compaction_is_atomic_and_bounded(self, tmp_path):
+        path = str(tmp_path / "h.jsonl")
+        store = HistoryStore(path, max_rounds=4)
+        # Enough appends to blow way past the 2×bound threshold (min 256).
+        for i in range(400):
+            store.record(_entry("tpu-0", i, ok=(i % 2 == 0)))
+            store.flush()
+        lines = open(path).read().splitlines()
+        assert len(lines) <= 256  # compacted, not still 400 lines
+        assert not (tmp_path / "h.jsonl.tmp").exists()
+        by_node = HistoryStore(path, max_rounds=4).load()
+        assert len(by_node["tpu-0"]) == 4
+        assert by_node["tpu-0"][-1]["ts"] == 1_700_000_000.0 + 399
+
+    def test_write_failure_is_not_fatal(self, tmp_path, capsys):
+        store = HistoryStore(str(tmp_path))  # a DIRECTORY: open() will fail
+        store.record(_entry("tpu-0", 0))
+        store.flush()  # must not raise
+        assert "Cannot append history store" in capsys.readouterr().err
+
+
+class TestStoreProperty:
+    """Tier-1 round-trip + compaction property test (seeded, no deps)."""
+
+    def test_random_append_reload_compact_invariants(self, tmp_path):
+        rng = random.Random(0xC0FFEE)
+        for case in range(10):
+            path = str(tmp_path / f"h{case}.jsonl")
+            max_rounds = rng.randint(1, 12)
+            nodes = [f"n{i}" for i in range(rng.randint(1, 5))]
+            expected = {}
+            store = HistoryStore(path, max_rounds=max_rounds)
+            store.load()
+            ticks = rng.randint(1, 120)
+            for t in range(ticks):
+                for node in nodes:
+                    if rng.random() < 0.7:
+                        e = _entry(node, t, ok=rng.random() < 0.5)
+                        store.record(e)
+                        expected.setdefault(node, []).append(
+                            {"schema": HISTORY_SCHEMA_VERSION, **e}
+                        )
+                store.flush()
+                if rng.random() < 0.1:
+                    # Mid-history process restart: reload from disk.
+                    store = HistoryStore(path, max_rounds=max_rounds)
+                    store.load()
+            # Invariant 1: a fresh load reproduces exactly the bounded tail
+            # of everything recorded, in order.
+            loaded = HistoryStore(path, max_rounds=max_rounds).load()
+            for node, seq in expected.items():
+                assert loaded.get(node) == seq[-max_rounds:], (
+                    f"case {case} node {node}"
+                )
+            # Invariant 2: explicit compaction changes nothing observable.
+            store = HistoryStore(path, max_rounds=max_rounds)
+            store.load()
+            store.compact()
+            recompacted = HistoryStore(path, max_rounds=max_rounds).load()
+            for node, seq in expected.items():
+                assert recompacted.get(node) == seq[-max_rounds:]
+            # Invariant 3: the file never holds more than the compaction
+            # bound allows right after a compaction.
+            assert len(open(path).read().splitlines()) <= max_rounds * len(nodes)
+
+    def test_default_bound_is_sane(self):
+        assert DEFAULT_MAX_ROUNDS >= 10

@@ -533,6 +533,53 @@ class TestTrendSummary:
         out = capsys.readouterr().out
         assert "top causes: slice incomplete ×2" in out
 
+    def test_empty_log_machine_readable_no_traceback(self, tmp_path, capsys):
+        # An empty (or never-written-to) log is a normal first-day state for
+        # automation polling --trend --json: stdout must still parse, exit 1
+        # is the signal, and no traceback leaks.
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        assert cli.main(["--trend", str(p), "--json"]) == 1
+        captured = capsys.readouterr()
+        s = json.loads(captured.out)
+        assert s["rounds"] == 0
+        assert "no usable rounds" in s["error"]
+        assert "Traceback" not in captured.err
+        # Human mode: the stderr note, still no traceback, nothing on stdout.
+        assert cli.main(["--trend", str(p)]) == 1
+        captured = capsys.readouterr()
+        assert captured.out == ""
+        assert "Traceback" not in captured.err
+
+    def test_whitespace_only_log_machine_readable(self, tmp_path, capsys):
+        p = tmp_path / "ws.jsonl"
+        p.write_text("\n   \n\t\n")
+        assert cli.main(["--trend", str(p), "--json"]) == 1
+        s = json.loads(capsys.readouterr().out)
+        assert s == {"rounds": 0, "skipped_lines": 0, "error": "has no usable rounds"}
+
+    def test_torn_final_line_counted_not_fatal(self, tmp_path, capsys):
+        # A crash mid-append tears the last line; the analysis must proceed
+        # over the intact rounds and count the torn one — the exact same
+        # loader the history store uses (history/store.read_jsonl_tolerant).
+        p = tmp_path / "torn.jsonl"
+        p.write_text(
+            json.dumps({"ts": 1_700_000_000, "exit_code": 0}) + "\n"
+            + json.dumps({"ts": 1_700_000_060, "exit_code": 3}) + "\n"
+            + '{"ts": 1700000120, "exit_co'
+        )
+        assert cli.main(["--trend", str(p), "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["rounds"] == 2
+        assert s["skipped_lines"] == 1
+
+    def test_unreadable_log_machine_readable_in_json_mode(self, tmp_path, capsys):
+        assert cli.main(["--trend", str(tmp_path / "absent.jsonl"), "--json"]) == 1
+        captured = capsys.readouterr()
+        s = json.loads(captured.out)
+        assert s["rounds"] == 0 and "unreadable" in s["error"]
+        assert "Traceback" not in captured.err
+
     def test_trend_over_emitter_round_log(self, tmp_path, capsys):
         # The emitter loop's --log-jsonl shape is --trend-compatible: a
         # DaemonSet pod's own probe history trends like an aggregator's.

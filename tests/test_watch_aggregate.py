@@ -669,6 +669,59 @@ class TestWatch:
         assert "State change: exit 0 → 3" in capsys.readouterr().err
 
 
+class TestSlackOnChangeFingerprint:
+    """--slack-on-change fingerprints the sick-node SET, not just the exit
+    code: a same-round node swap (A recovers, B fails, aggregate code
+    unchanged) is two pages' worth of news and must not be silent."""
+
+    def _nodes(self, sick_name):
+        return [
+            fx.make_node(f"gpu-{i}", ready=(f"gpu-{i}" != sick_name),
+                         allocatable={"nvidia.com/gpu": "1"})
+            for i in range(2)
+        ]
+
+    def _drive(self, monkeypatch, node_sets):
+        sent = []
+
+        def fake_fetch(args, timer):
+            if not node_sets:
+                raise KeyboardInterrupt
+            return node_sets.pop(0), None
+
+        monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
+        monkeypatch.setattr(
+            notify, "send_slack_message",
+            lambda url, message, **kw: sent.append(message) or True,
+        )
+        monkeypatch.setattr(checker, "_wait_for_next_round", lambda stop, s: False)
+        code = cli.main(
+            ["--watch", "1", "--slack-on-change", "--slack-webhook", "https://x"]
+        )
+        assert code == 130
+        return sent
+
+    def test_node_swap_with_unchanged_exit_code_alerts(self, monkeypatch, capsys):
+        # Rounds: gpu-1 sick → gpu-0 sick (exit 0 both) → gpu-0 sick again.
+        sent = self._drive(
+            monkeypatch,
+            [self._nodes("gpu-1"), self._nodes("gpu-0"), self._nodes("gpu-0")],
+        )
+        # Round 1 (first state) and round 2 (swap) alert; round 3 is silent.
+        assert len(sent) == 2
+        assert "`gpu-1`" in sent[0]
+        assert "`gpu-0`" in sent[1]
+        err = capsys.readouterr().err
+        assert "sick-node set" in err and "exit 0 unchanged" in err
+
+    def test_unchanged_set_stays_silent(self, monkeypatch, capsys):
+        sent = self._drive(
+            monkeypatch, [self._nodes("gpu-1"), self._nodes("gpu-1")]
+        )
+        assert len(sent) == 1  # only the first round's state render
+        capsys.readouterr()
+
+
 class TestWatchBreaker:
     """Circuit breaker over consecutive failed rounds: opens at the
     threshold with ONE degraded alert, widens the interval (capped), and

@@ -528,7 +528,175 @@ def _resolve_client(args, client):
     )
 
 
-def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None) -> dict:
+# Live history tracker, cached across rounds within one process (watch
+# mode): the FSM must keep advancing IN MEMORY even when the store file
+# cannot be written (full disk — the store's never-fatal contract), and a
+# 5k-node fleet must not re-parse nodes × max_rounds JSON lines every
+# round.  Keyed by every knob that shapes the machine, so a changed flag
+# (tests, embedders) rebuilds instead of riding a mis-tuned FSM.
+_HISTORY_CACHE: dict = {"key": None, "tracker": None}
+
+
+def _build_history(args):
+    """``--history FILE`` → ``{"store", "fsm"}`` (None when the flag is off).
+
+    Opens the per-node health store and seeds one hysteresis machine per
+    recorded node, so state — quarantine streaks, the flap window — survives
+    process restarts the same way ``--slack-on-change`` survives them
+    through the trend log.  Shared by the aggregator (one-shot and
+    ``--watch``) and emitter modes.  Within one process the tracker is
+    cached: later rounds reuse the in-memory machine (with a fresh
+    per-round transition log) instead of reseeding from disk.
+    """
+    path = getattr(args, "history", None)
+    if not path:
+        return None
+    from tpu_node_checker.history import HealthFSM, HistoryStore
+    from tpu_node_checker.history.fsm import (
+        DEFAULT_CORDON_AFTER,
+        DEFAULT_FLAP_THRESHOLD,
+        DEFAULT_FLAP_WINDOW,
+        DEFAULT_UNCORDON_AFTER,
+    )
+    from tpu_node_checker.history.store import DEFAULT_MAX_ROUNDS
+
+    key = (
+        os.path.abspath(path),
+        getattr(args, "history_max_rounds", None) or DEFAULT_MAX_ROUNDS,
+        getattr(args, "cordon_after", None) or DEFAULT_CORDON_AFTER,
+        getattr(args, "uncordon_after", None) or DEFAULT_UNCORDON_AFTER,
+        getattr(args, "flap_threshold", None) or DEFAULT_FLAP_THRESHOLD,
+        getattr(args, "flap_window", None) or DEFAULT_FLAP_WINDOW,
+    )
+    if _HISTORY_CACHE["key"] == key:
+        tracker = _HISTORY_CACHE["tracker"]
+        tracker["fsm"].transitions.clear()  # the log is per-round
+        return tracker
+    store = HistoryStore(key[0], key[1])
+    fsm = HealthFSM(
+        cordon_after=key[2],
+        uncordon_after=key[3],
+        flap_threshold=key[4],
+        flap_window=key[5],
+    )
+    for node, entries in store.load().items():
+        fsm.seed(node, entries)
+    tracker = {"store": store, "fsm": fsm}
+    _HISTORY_CACHE["key"], _HISTORY_CACHE["tracker"] = key, tracker
+    return tracker
+
+
+def _node_round_causes(n: NodeInfo) -> List[str]:
+    """Compact cause tokens for one node's round, recorded in the history
+    store (the per-node twin of the trend log's ``causes``)."""
+    causes: List[str] = []
+    if not n.ready:
+        causes.append("not-ready")
+    elif not n.schedulable:
+        causes.append("no-allocatable")
+    if n.probe is not None and not n.probe.get("ok"):
+        causes.append(
+            "no-probe-report" if n.probe.get("level") == "missing" else "probe-failed"
+        )
+    return causes
+
+
+def _update_history(history: dict, accel: List[NodeInfo]) -> None:
+    """Feed this round's verdicts through the FSM and queue store lines.
+
+    Verdict rules:
+
+    * a node's round is good iff it is *effectively* ready (kubelet Ready,
+      schedulable, chips alive when probed) — the same readiness the exit
+      code consumes;
+    * a node WE quarantined with no probe evidence this round observes
+      ``None``: state holds — absence must neither heal (an evidence-free
+      "good" round counting toward ``--uncordon-after``) nor sicken;
+    * likewise a kubelet-healthy node whose only badness is a MISSING
+      probe report (``--probe-results-required`` synthesizes
+      ``level="missing"``) observes ``None`` — a wedged emitter rollout
+      must not bank rounds toward ``--cordon-after``, or K-1 rounds of
+      absence plus one real failure would defeat the debounce;
+    * a quarantined-by-us node that is no longer cordoned was uncordoned
+      out-of-band (`kubectl uncordon` leaves our annotation behind): the
+      FSM resets it to RECOVERING, never straight to HEALTHY — the
+      stale-annotation sweep and the machine must agree that an override
+      is a decision, not evidence.
+    """
+    import time as _time
+
+    fsm, store = history["fsm"], history["store"]
+    now = round(_time.time(), 3)
+    for n in accel:
+        verdict: Optional[bool] = n.effectively_ready
+        if n.quarantined_by_us and n.probe is None:
+            verdict = None
+        elif (
+            not verdict
+            and n.ready
+            and n.schedulable
+            and n.probe is not None
+            and n.probe.get("level") == "missing"
+        ):
+            # Bad SOLELY because no report arrived: no evidence either way.
+            verdict = None
+        fsm.observe(
+            n.name,
+            verdict,
+            uncordoned_out_of_band=n.quarantined_by_us and not n.cordoned,
+        )
+        h = fsm.health(n.name)
+        n.health = {"state": h.state, "streak": h.streak, "flaps": h.flaps}
+        store.record(
+            {
+                "node": n.name,
+                "ts": now,
+                "ok": verdict,
+                "causes": _node_round_causes(n),
+                "state": h.state,
+                "streak": h.streak,
+                "flaps": h.flaps,
+                "flaps_total": h.flaps_total,
+            }
+        )
+
+
+def _history_payload(history: dict, accel: List[NodeInfo]) -> dict:
+    """The payload's ``history`` block.
+
+    State GAUGES cover this round's fleet only — a departed node's
+    lingering store tail must not keep a CHRONIC gauge lit for hardware
+    that no longer exists.  ``flaps_total`` is a COUNTER and sums over
+    every node the store remembers instead: dropping a departed node's
+    flips would make the series decrease, which Prometheus reads as a
+    reset and turns into a spurious rate() spike on every scale-down.
+    """
+    from tpu_node_checker.history.fsm import CHRONIC, STATES
+
+    fsm = history["fsm"]
+    states = {s: 0 for s in STATES}
+    chronic = []
+    for n in accel:
+        h = fsm.health(n.name)
+        states[h.state] += 1
+        if h.state == CHRONIC:
+            chronic.append(n.name)
+    flaps_total = sum(h.flaps_total for h in fsm.nodes.values())
+    return {
+        "states": states,
+        "chronic": sorted(chronic),
+        "flaps_total": flaps_total,
+        "transitions": list(fsm.transitions),
+        "thresholds": {
+            "cordon_after": fsm.cordon_after,
+            "uncordon_after": fsm.uncordon_after,
+            "flap_threshold": fsm.flap_threshold,
+            "flap_window": fsm.flap_window,
+        },
+    }
+
+
+def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> dict:
     """``--uncordon-recovered``: lift OUR quarantines once chips pass again.
 
     The closing half of the quarantine lifecycle.  A node qualifies only
@@ -537,6 +705,12 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None) -> dict:
     kubelet reports Ready, and a *fresh passing* probe verdict vouches for
     the chips.  No budget: uncordoning restores capacity and each lift is
     individually evidence-backed.  Shares ``--cordon-dry-run``.
+
+    With ``--history`` the hysteresis machine is consulted ON TOP of the
+    evidence rules: the lift additionally needs the node to have re-earned
+    HEALTHY (``--uncordon-after`` consecutive good rounds), and a CHRONIC
+    flapper never qualifies — its passing round is the setup for its next
+    failure, the exact churn the FSM exists to stop.
     """
     candidates = [
         n
@@ -546,6 +720,7 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None) -> dict:
         and n.ready
         and n.probe is not None
         and n.probe.get("ok")
+        and (fsm is None or fsm.uncordon_eligible(n.name))
     ]
     # Annotation hygiene: an annotated-but-SCHEDULABLE node means someone
     # lifted our quarantine out-of-band (`kubectl uncordon` only flips
@@ -625,7 +800,7 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None) -> dict:
     return report_entry
 
 
-def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None) -> dict:
+def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> dict:
     """``--cordon-failed``: mark probe-failed nodes unschedulable.
 
     Auto-quarantine for the one failure mode only this tool can see — a
@@ -649,17 +824,36 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None) -> dict:
     Returns the report dict for the payload.  ``client`` reuses the LIST
     call's :class:`~tpu_node_checker.cluster.KubeClient`; offline runs
     (``--nodes-json``) resolve one on demand.
+
+    With ``--history`` the raw this-round verdict is replaced by the
+    hysteresis machine: a node qualifies only once it has been bad for
+    ``--cordon-after`` consecutive rounds (FAILED) or tripped the flap
+    detector (CHRONIC) — one bad probe is a data point, not a diagnosis.
+    The evidence rule survives the swap: a PATCH still requires a real
+    probe report this round (``level="missing"`` is absence, not evidence).
     """
-    candidates = [
-        n
-        for n in accel
-        if n.ready
-        and n.schedulable  # dead-plugin nodes must not consume the budget
-        and not n.cordoned
-        and n.probe is not None
-        and not n.probe.get("ok")
-        and n.probe.get("level") != "missing"  # absent report ≠ dead chips
-    ]
+    if fsm is None:
+        candidates = [
+            n
+            for n in accel
+            if n.ready
+            and n.schedulable  # dead-plugin nodes must not consume the budget
+            and not n.cordoned
+            and n.probe is not None
+            and not n.probe.get("ok")
+            and n.probe.get("level") != "missing"  # absent report ≠ dead chips
+        ]
+    else:
+        candidates = [
+            n
+            for n in accel
+            if n.ready
+            and n.schedulable
+            and not n.cordoned
+            and n.probe is not None
+            and n.probe.get("level") != "missing"
+            and fsm.cordon_eligible(n.name)
+        ]
     cap = getattr(args, "cordon_max", 1)
     already = sum(1 for n in accel if n.cordoned)
     budget = max(0, cap - already)
@@ -742,6 +936,15 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
         if event_errors:
             degradation["events"] = event_errors[:_EVENTS_NODE_CAP]
 
+    # Per-node health history + hysteresis (--history): verdicts feed the
+    # FSM here — after every probe surface attached, before any remediation
+    # consults the debounced states.  None when the flag is off, and then
+    # nothing below changes behavior or payload by a single byte.
+    history = _build_history(args)
+    if history is not None:
+        with timer.phase("history"):
+            _update_history(history, accel)
+
     # Effective readiness: kubelet Ready minus unschedulable/probe-failed hosts.
     effective_ready = [n for n in ready if n.effectively_ready]
     result.ready = effective_ready
@@ -776,14 +979,21 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     if getattr(args, "cordon_failed", False) or getattr(args, "uncordon_recovered", False):
         # Before render, so payload["nodes"] reflects post-cordon state.
         with timer.phase("cordon"):
+            fsm = history["fsm"] if history is not None else None
             if getattr(args, "uncordon_recovered", False):
                 # Uncordon FIRST: a recovered node leaving quarantine frees
                 # --cordon-max budget for this round's new failures.
                 uncordon_report = _uncordon_recovered_nodes(
-                    args, accel, client=kube_client
+                    args, accel, client=kube_client, fsm=fsm
                 )
             if getattr(args, "cordon_failed", False):
-                cordon_report = _cordon_failed_nodes(args, accel, client=kube_client)
+                cordon_report = _cordon_failed_nodes(
+                    args, accel, client=kube_client, fsm=fsm
+                )
+    if history is not None:
+        # Flush AFTER remediation: the persisted round already carries the
+        # out-of-band RECOVERING resets the sweep acted on.
+        history["store"].flush()
 
     with timer.phase("render"):
         payload = report.build_json_payload(
@@ -855,6 +1065,11 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
             payload["cordon"] = cordon_report
         if uncordon_report is not None:
             payload["uncordon"] = uncordon_report
+        if history is not None:
+            # Per-node state/streak/flaps already ride on each node entry
+            # (NodeInfo.health); this is the fleet roll-up plus the round's
+            # transition log — what Slack and the metrics families consume.
+            payload["history"] = _history_payload(history, accel)
         for phase_name, rep in (("cordon", cordon_report), ("uncordon", uncordon_report)):
             failed = (rep or {}).get("failed")
             if failed:
@@ -1298,8 +1513,46 @@ def emit_probe(args) -> int:
     loop (and ``--trend``) uses.
     """
     rc, doc = _emit_probe_once(args)
-    _append_emitter_log(args, _emitter_round_entry(rc, doc))
+    entry = _emitter_round_entry(rc, doc)
+    _emitter_history_round(_build_history(args), doc, entry)
+    _append_emitter_log(args, entry)
     return rc
+
+
+def _emitter_history_round(history, doc: dict, entry: dict) -> None:
+    """Emitter-mode ``--history``: the single-host hysteresis machine.
+
+    A DaemonSet pod tracks its OWN chips' history (keyed by the report's
+    hostname, the same key the aggregator would use), so a flapping chip is
+    visible as CHRONIC at the host edge even before the aggregator round
+    sees it — and the verdict rides in the emitter's ``--log-jsonl`` line.
+    """
+    if history is None:
+        return
+    import time as _time
+
+    fsm, store = history["fsm"], history["store"]
+    fsm.transitions.clear()  # per-emission log; nothing consumes older rounds
+    node = doc.get("hostname") or "local"
+    ok = bool(doc.get("ok"))
+    fsm.observe(node, ok)
+    h = fsm.health(node)
+    store.record(
+        {
+            "node": node,
+            "ts": round(_time.time(), 3),
+            "ok": ok,
+            "causes": [] if ok else ["probe-failed"],
+            "state": h.state,
+            "streak": h.streak,
+            "flaps": h.flaps,
+            "flaps_total": h.flaps_total,
+        }
+    )
+    store.flush()
+    entry["state"] = h.state
+    if h.flaps:
+        entry["flaps"] = h.flaps
 
 
 def _append_jsonl(path: str, entry: dict) -> None:
@@ -1367,6 +1620,9 @@ def emit_probe_loop(args) -> int:
 
 
 def _emit_probe_rounds(args, interval, server, stop) -> int:
+    # One store/FSM for the loop's lifetime: state (and the flap window)
+    # accumulates across emissions, and survives restarts via the file.
+    history = _build_history(args)
     while True:
         round_start = time.monotonic()
         try:
@@ -1382,6 +1638,7 @@ def _emit_probe_rounds(args, interval, server, stop) -> int:
                 server.mark_error()
         else:
             entry = _emitter_round_entry(rc, doc)
+            _emitter_history_round(history, doc, entry)
             if server is not None:
                 server.update(
                     CheckResult(exit_code=rc, payload={"local_probe": doc})
@@ -1523,6 +1780,10 @@ def watch(args) -> int:
         metrics_server = MetricsServer(args.metrics_port)
         print(f"Serving /metrics on port {metrics_server.port}", file=sys.stderr)
     last_code: Optional[int] = None
+    # The previous round's sick-node set (None = unknown: first round,
+    # resumed from a log that records only the code, or an error round).
+    # Part of the change fingerprint so a same-code node swap still alerts.
+    last_sick: Optional[tuple] = None
     if on_change:
         # Resume across restarts: recover the last recorded outcome from the
         # trend log so a pod restart doesn't re-alert on an unchanged state.
@@ -1560,6 +1821,7 @@ def watch(args) -> int:
                     metrics_server.set_breaker(breaker.as_dict())
                     metrics_server.mark_error(EXIT_ERROR)
                 _append_state_log(args, None, error=str(exc))
+                sick = None  # an error round observed no nodes
                 changed = last_code is None or code != last_code
                 if webhook:
                     if transition == "opened":
@@ -1591,7 +1853,29 @@ def watch(args) -> int:
                     metrics_server.set_breaker(breaker.as_dict())
                     metrics_server.update(result)
                 _append_state_log(args, result)
-                changed = last_code is None or code != last_code
+                sick = _round_sick_set(result)
+                # Change fingerprint = exit code + sick-node set: a node
+                # swap inside an unchanged code is still a transition.  The
+                # set half compares only when both sides are known — after
+                # a restart the log yields the code alone, and an unchanged
+                # code must not re-alert just because the set is unknown.
+                # An actionable hysteresis transition is a change by itself:
+                # a RECOVERING node re-earning HEALTHY left the sick set
+                # rounds ago, so neither half above moves when its
+                # quarantine finally lifts — yet that lift must page.
+                hist = result.payload.get("history")
+                actionable = bool(
+                    hist
+                    and any(
+                        t.get("actionable") for t in hist.get("transitions", [])
+                    )
+                )
+                changed = (
+                    last_code is None
+                    or code != last_code
+                    or actionable
+                    or (last_sick is not None and sick != last_sick)
+                )
                 if transition == "closed":
                     print(
                         "Monitor recovered: check rounds succeeding again; "
@@ -1612,7 +1896,14 @@ def watch(args) -> int:
                     print(f"Render/notify failed (check itself OK): {exc}", file=sys.stderr)
             if last_code is not None and code != last_code:
                 print(f"State change: exit {last_code} → {code}", file=sys.stderr)
+            elif last_sick is not None and sick is not None and sick != last_sick:
+                print(
+                    f"State change: sick-node set {list(last_sick)} → "
+                    f"{list(sick)} (exit {code} unchanged)",
+                    file=sys.stderr,
+                )
             last_code = code
+            last_sick = sick
             effective_interval = interval * breaker.interval_scale()
             if breaker.open:
                 print(
@@ -1639,6 +1930,33 @@ def watch(args) -> int:
                 return 128 + 15  # conventional SIGTERM exit
     finally:
         _restore_stop_signal(prev_handler)
+
+
+def _round_sick_set(result: CheckResult) -> tuple:
+    """The round's sick-node fingerprint for ``--slack-on-change``.
+
+    The exit code alone under-fingerprints: a same-round node swap (A
+    recovers, B fails) keeps the aggregate code and would stay silent, yet
+    both events are pages.  Without history, the set is the raw
+    not-effectively-ready nodes; with ``--history`` it is the DEBOUNCED
+    (name, state) pairs in FAILED/CHRONIC — sub-threshold SUSPECT/
+    RECOVERING wobble must not re-create the per-round alert churn the
+    hysteresis exists to absorb (and FAILED→CHRONIC, same sick set, still
+    alerts because the state rides in the pair).
+    """
+    if result.payload.get("history") is not None:
+        from tpu_node_checker.history.fsm import CHRONIC, FAILED
+
+        return tuple(
+            sorted(
+                (n.name, (n.health or {}).get("state") or "")
+                for n in result.accel
+                if (n.health or {}).get("state") in (FAILED, CHRONIC)
+            )
+        )
+    # The same effectively_ready the exit code consumed — NOT a payload
+    # re-derivation that could drift from it.
+    return tuple(sorted(n.name for n in result.accel if not n.effectively_ready))
 
 
 def _recover_last_code(args) -> Optional[int]:
@@ -1706,36 +2024,47 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
     per-round entries: availability (fraction of rounds at exit 0), every
     state TRANSITION with its timestamp, the longest non-0 stretch, and
     chip-level availability (mean ready/total chips).  Malformed lines are
-    skipped with a count (a crash mid-append must not sink the analysis);
-    an unreadable or empty log exits 1.
+    skipped with a count via the same torn-line-tolerant loader the history
+    store uses (a crash mid-append must not sink the analysis); an
+    unreadable or empty log exits 1 — with a machine-readable summary on
+    stdout in ``--json`` mode, never a traceback.
     """
-    try:
-        with open(path) as f:
-            raw_lines = f.read().splitlines()
-    except OSError as exc:
-        print(f"trend log {path} unreadable: {exc}", file=sys.stderr)
+    from tpu_node_checker.history.store import read_jsonl_tolerant
+
+    def _empty(reason: str) -> int:
+        print(f"trend log {path} {reason}", file=sys.stderr)
+        if json_mode:
+            # Automation reads stdout: an empty / whitespace-only /
+            # unreadable log must still parse (rounds=0 plus the reason),
+            # with exit 1 as the signal — not a bare stderr note.
+            print(
+                json.dumps(
+                    {"rounds": 0, "skipped_lines": skipped, "error": reason},
+                    ensure_ascii=False,
+                )
+            )
         return 1
-    rounds = []
+
     skipped = 0
-    for line in raw_lines:
-        if not line.strip():
-            continue
+    try:
+        entries, skipped = read_jsonl_tolerant(path)
+    except OSError as exc:
+        return _empty(f"unreadable: {exc}")
+    rounds = []
+    for e in entries:
         try:
-            e = json.loads(line)
             ts = float(e["ts"])
             if not math.isfinite(ts):
                 # NaN/inf ts would poison interval math and crash the UTC
                 # formatter downstream.
                 raise ValueError(f"non-finite ts {ts!r}")
             rounds.append((ts, int(e["exit_code"]), e))
-        except (json.JSONDecodeError, KeyError, TypeError, ValueError,
-                OverflowError):
+        except (KeyError, TypeError, ValueError, OverflowError):
             # OverflowError: json round-trips Infinity, and int(inf) raises
             # it — a malformed line must be SKIPPED, never sink the analysis.
             skipped += 1
     if not rounds:
-        print(f"trend log {path} has no usable rounds", file=sys.stderr)
-        return 1
+        return _empty("has no usable rounds")
     rounds.sort(key=lambda r: r[0])
     ok_rounds = sum(1 for _, code, _ in rounds if code == EXIT_OK)
     transitions = []
@@ -1863,6 +2192,12 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         "last_exit_code": rounds[-1][1],
         "last_ts": round(rounds[-1][0], 3),
     }
+    last_chronic = rounds[-1][2].get("chronic")
+    if isinstance(last_chronic, list) and last_chronic:
+        # --history rounds record standing chronic flappers even at exit 0;
+        # the current set belongs in the post-incident picture (per-node
+        # depth lives in --trend-nodes against the history store).
+        summary["chronic_nodes"] = [str(n) for n in last_chronic]
     if json_mode:
         print(json.dumps(summary, ensure_ascii=False, indent=2))
         return 0
@@ -1911,6 +2246,11 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         f"longest outage {summary['longest_outage_s']}s; "
         f"current state: exit {summary['last_exit_code']}"
     )
+    if summary.get("chronic_nodes"):
+        print(
+            "chronic flappers held in quarantine: "
+            + ", ".join(summary["chronic_nodes"])
+        )
     if top_causes:
         omitted = cause_classes_total - len(top_causes)
         print(
@@ -1926,6 +2266,168 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         if t.get("causes"):
             suffix = "  (" + "; ".join(t["causes"]) + ")"
         print(f"  {_fmt(t['ts'])}  exit {t['from']} → {t['to']}{suffix}")
+    return 0
+
+
+def trend_nodes(path: str, json_mode: bool = False) -> int:
+    """``--trend-nodes FILE``: per-node analysis of a ``--history`` store.
+
+    The fleet questions the per-round trend log cannot answer — WHICH nodes
+    are the problem: per-node availability (fraction of evidence rounds
+    good), MTBF (mean seconds between failure onsets), MTTR (mean seconds
+    from a failure onset to the next good round), flap counts, and current
+    hysteresis state — with the worst offenders ranked first.  Chronic
+    offenders with 95% availability are exactly the hardware MTBF/MTTR
+    surfaces and a snapshot checker cannot.
+
+    Same degradation contract as ``--trend``: torn/malformed lines are
+    skipped with a count, an unreadable or empty store exits 1 (with a
+    machine-readable object on stdout in ``--json`` mode).
+    """
+    from tpu_node_checker.history.fsm import CHRONIC
+    from tpu_node_checker.history.store import (
+        HISTORY_SCHEMA_VERSION,
+        read_jsonl_tolerant,
+    )
+
+    def _empty(reason: str) -> int:
+        print(f"history store {path} {reason}", file=sys.stderr)
+        if json_mode:
+            print(
+                json.dumps(
+                    {"nodes": {}, "skipped_lines": skipped, "error": reason},
+                    ensure_ascii=False,
+                )
+            )
+        return 1
+
+    skipped = 0
+    try:
+        entries, skipped = read_jsonl_tolerant(path)
+    except OSError as exc:
+        return _empty(f"unreadable: {exc}")
+    by_node: dict = {}
+    for e in entries:
+        schema = e.get("schema")
+        node = e.get("node")
+        if (schema is not None and schema != HISTORY_SCHEMA_VERSION) or not isinstance(
+            node, str
+        ) or not node:
+            skipped += 1
+            continue
+        by_node.setdefault(node, []).append(e)
+    if not by_node:
+        return _empty("has no usable rounds")
+
+    def _num(v):
+        return v if isinstance(v, (int, float)) and not isinstance(v, bool) and math.isfinite(v) else None
+
+    def _int(v):
+        return v if isinstance(v, int) and not isinstance(v, bool) else None
+
+    nodes: dict = {}
+    for node, seq in sorted(by_node.items()):
+        # Malformed-but-dict lines (a hand-edited "ts": "oops") must degrade
+        # like torn lines, never crash the analysis: every read is coerced.
+        seq.sort(key=lambda e: _num(e.get("ts")) or 0.0)
+        evidence = [e for e in seq if isinstance(e.get("ok"), bool)]
+        ok_rounds = sum(1 for e in evidence if e["ok"])
+        # Failure onsets (good→bad edges, or a bad first round) and the
+        # matching repairs (the next good round) — the MTBF/MTTR inputs.
+        onsets: List[float] = []
+        repairs: List[float] = []  # seconds from onset to recovery
+        failing_since: Optional[float] = None
+        for e in evidence:
+            ts = _num(e.get("ts"))
+            if ts is None:
+                continue
+            if not e["ok"] and failing_since is None:
+                failing_since = ts
+                onsets.append(ts)
+            elif e["ok"] and failing_since is not None:
+                repairs.append(ts - failing_since)
+                failing_since = None
+        last = seq[-1]
+        cause_counts: dict = {}
+        for e in evidence:
+            for c in e.get("causes") or []:
+                cause_counts[str(c)] = cause_counts.get(str(c), 0) + 1
+        nodes[node] = {
+            "rounds": len(evidence),
+            "ok_rounds": ok_rounds,
+            "availability_pct": (
+                round(100.0 * ok_rounds / len(evidence), 2) if evidence else None
+            ),
+            "failures": len(onsets),
+            "mtbf_s": (
+                round(
+                    (onsets[-1] - onsets[0]) / (len(onsets) - 1), 1
+                )
+                if len(onsets) >= 2
+                else None
+            ),
+            "mttr_s": (
+                round(sum(repairs) / len(repairs), 1) if repairs else None
+            ),
+            "state": last.get("state") if isinstance(last.get("state"), str) else None,
+            "flaps": _int(last.get("flaps")),
+            "flaps_total": _int(last.get("flaps_total")),
+            "top_causes": [
+                c
+                for c, _ in sorted(
+                    cause_counts.items(), key=lambda kv: (-kv[1], kv[0])
+                )[:3]
+            ],
+        }
+    # Worst first: lowest availability, then most flaps — the repair queue.
+    worst = sorted(
+        nodes,
+        key=lambda n: (
+            nodes[n]["availability_pct"]
+            if nodes[n]["availability_pct"] is not None
+            else 100.0,
+            -(nodes[n]["flaps_total"] or 0),
+            n,
+        ),
+    )
+    summary = {
+        "nodes": nodes,
+        "worst_offenders": worst[:10],
+        "chronic": sorted(n for n in nodes if nodes[n]["state"] == CHRONIC),
+        "rounds_total": sum(v["rounds"] for v in nodes.values()),
+        "skipped_lines": skipped,
+    }
+    if json_mode:
+        print(json.dumps(summary, ensure_ascii=False, indent=2))
+        return 0
+    print(
+        f"{len(nodes)} node(s), {summary['rounds_total']} evidence rounds"
+        + (f", {skipped} malformed/foreign lines skipped" if skipped else "")
+    )
+    if summary["chronic"]:
+        print("chronic flappers: " + ", ".join(summary["chronic"]))
+    print()
+    rows = []
+    for n in worst:
+        v = nodes[n]
+        rows.append(
+            [
+                n,
+                v["state"] or "?",
+                f"{v['availability_pct']}%" if v["availability_pct"] is not None else "-",
+                str(v["failures"]),
+                f"{v['mtbf_s']}s" if v["mtbf_s"] is not None else "-",
+                f"{v['mttr_s']}s" if v["mttr_s"] is not None else "-",
+                str(v["flaps_total"] if v["flaps_total"] is not None else "-"),
+                ", ".join(v["top_causes"]) or "-",
+            ]
+        )
+    print(
+        report.render_columns(
+            ["NODE", "STATE", "AVAIL", "FAILS", "MTBF", "MTTR", "FLAPS", "TOP CAUSES"],
+            rows,
+        )
+    )
     return 0
 
 
@@ -1972,6 +2474,10 @@ def _round_causes(payload: dict) -> List[str]:
         causes.append(f"probe-failed: {h}")
     for h in summary.get("hosts_missing", []):
         causes.append(f"no probe report: {h}")
+    for h in (payload.get("history") or {}).get("chronic", []):
+        # The flap trap's exit-3-style cause: a chronic offender is its own
+        # incident class even on a round where its chips happened to pass.
+        causes.append(f"chronic-flapper: {h}")
     for n in payload.get("nodes", []):
         if not n.get("ready"):
             # "Why" from the Ready condition (KubeletNotReady vs
@@ -2069,6 +2575,12 @@ def _append_state_log(args, result: Optional[CheckResult], error: Optional[str] 
             # grade stands, but the trend record must not read as a fully
             # clean round.
             entry["degraded"] = True
+        chronic = (p.get("history") or {}).get("chronic")
+        if chronic:
+            # Chronic flappers persist across exit-0 rounds (they sit
+            # cordoned while the rest of the fleet grades healthy); the
+            # trend record must carry them even when no cause list does.
+            entry["chronic"] = list(chronic)
         if result.exit_code != EXIT_OK:
             causes = _round_causes(p)
             if causes:
@@ -2095,9 +2607,22 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
     accel, ready, slices = result.accel, result.ready, result.slices
 
     healthy = result.exit_code == EXIT_OK
+    history = result.payload.get("history")
+    # Transitions, not raw rounds, drive alerting: a hysteresis transition
+    # worth acting on (→FAILED, →CHRONIC, a re-earned HEALTHY) pages even
+    # under --slack-only-on-error on an exit-0 round — one flapping node in
+    # a big fleet never moves the exit code, and silence there would hide
+    # exactly the event this subsystem exists to surface.
+    transitions = bool(
+        history
+        and any(t.get("actionable") for t in history.get("transitions", []))
+    )
     webhook = notify.get_slack_webhook_url(getattr(args, "slack_webhook", None))
     if notify_enabled and notify.should_send_slack_message(
-        webhook, getattr(args, "slack_only_on_error", False), healthy
+        webhook,
+        getattr(args, "slack_only_on_error", False),
+        healthy,
+        transitions=transitions,
     ):
         message = report.format_slack_message(
             accel,
@@ -2107,6 +2632,7 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
             multislices=result.multislices,
             cordon=result.payload.get("cordon"),
             uncordon=result.payload.get("uncordon"),
+            history=history,
         )
         sent = notify.send_slack_message(
             webhook,

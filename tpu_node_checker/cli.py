@@ -217,6 +217,44 @@ def build_parser() -> argparse.ArgumentParser:
                        "consumers validating --emit-probe output (the checker "
                        "itself validates with the same spec); runs alone")
 
+    history = p.add_argument_group("Health history & hysteresis (flap-proof quarantine)")
+    history.add_argument("--history", metavar="FILE",
+                         help="persist per-node health history to FILE "
+                         "(schema-versioned append-only JSONL, bounded, "
+                         "compacted in place) and grade quarantine decisions "
+                         "through a hysteresis state machine "
+                         "(HEALTHY→SUSPECT→FAILED→RECOVERING, plus a CHRONIC "
+                         "flap trap) instead of one round's snapshot; works "
+                         "in one-shot, --watch and --emit-probe modes")
+    history.add_argument("--history-max-rounds", type=int, default=None,
+                         metavar="N",
+                         help="with --history: per-node rounds kept in the "
+                         "store (default 64); older lines are dropped at the "
+                         "next atomic compaction")
+    history.add_argument("--cordon-after", type=int, default=None, metavar="K",
+                         help="with --history: consecutive bad rounds before "
+                         "a node is FAILED and a --cordon-failed PATCH is "
+                         "eligible (default 1 = the pre-history per-round "
+                         "behavior)")
+    history.add_argument("--uncordon-after", type=int, default=None, metavar="M",
+                         help="with --history: consecutive good rounds before "
+                         "a RECOVERING node re-earns HEALTHY and "
+                         "--uncordon-recovered may lift its quarantine "
+                         "(default 1)")
+    history.add_argument("--flap-threshold", type=int, default=None, metavar="F",
+                         help="with --history: verdict flips inside the flap "
+                         "window that mark a node CHRONIC — held cordoned, "
+                         "excluded from auto-uncordon, its own Slack line and "
+                         "trend cause (default 4)")
+    history.add_argument("--flap-window", type=int, default=None, metavar="W",
+                         help="with --history: sliding window (rounds) the "
+                         "flap detector counts flips over (default 10)")
+    history.add_argument("--trend-nodes", metavar="FILE",
+                         help="summarize a --history store per node: "
+                         "availability, MTBF/MTTR, flap counts, current "
+                         "hysteresis state, worst offenders first — and exit "
+                         "(post-incident analysis; runs alone)")
+
     cordon = p.add_argument_group("Auto-quarantine (data-plane failures)")
     cordon.add_argument("--cordon-failed", action="store_true",
                         help="mark kubelet-Ready nodes whose chip probe FAILED as "
@@ -291,11 +329,74 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.slack_only_on_error
         or args.strict_slices
         or args.expected_chips
+        or args.history
+        or args.trend_nodes
     ):
         # Same silent-no-op rule as --report-fresh below: a summary-only mode
         # must not absorb check/emit/notify/quarantine flags the operator
         # thinks ran.
         p.error("--trend runs alone (only --json may accompany it)")
+    if args.trend_nodes and (
+        args.emit_probe
+        or args.node_events
+        or args.probe
+        or args.watch is not None
+        or args.probe_results
+        or args.cordon_failed
+        or args.uncordon_recovered
+        or args.report_fresh
+        or args.log_jsonl
+        or args.slack_webhook
+        or args.slack_only_on_error
+        or args.strict_slices
+        or args.expected_chips
+        or args.history
+    ):
+        # Same rule as --trend: a per-node summary mode must not absorb
+        # check/emit/notify/quarantine flags the operator thinks ran.
+        p.error("--trend-nodes runs alone (only --json may accompany it)")
+    for flag, val in (
+        ("--history-max-rounds", args.history_max_rounds),
+        ("--cordon-after", args.cordon_after),
+        ("--uncordon-after", args.uncordon_after),
+        ("--flap-threshold", args.flap_threshold),
+        ("--flap-window", args.flap_window),
+    ):
+        if val is not None and not args.history:
+            # Hysteresis knobs without the store would silently grade
+            # per-round — the operator thinks debouncing is on.
+            p.error(f"{flag} requires --history FILE")
+    if args.history_max_rounds is not None and args.history_max_rounds < 1:
+        p.error("--history-max-rounds must be at least 1")
+    for flag, val in (
+        ("--cordon-after", args.cordon_after),
+        ("--uncordon-after", args.uncordon_after),
+    ):
+        if val is not None and val < 1:
+            p.error(f"{flag} must be at least 1")
+    for flag, val in (
+        ("--flap-threshold", args.flap_threshold),
+        ("--flap-window", args.flap_window),
+    ):
+        if val is not None and val < 2:
+            # One flip is any single failure; a window of one can hold no
+            # flip at all — both would disable the detector silently.
+            p.error(f"{flag} must be at least 2")
+    if args.history:
+        # Checked whenever history is ON (defaults included): a store bound
+        # smaller than the flap window — e.g. --history-max-rounds 4 with
+        # the default 10-round window — could never hold enough verdicts to
+        # trip the detector, silently disabling it.
+        from tpu_node_checker.history.fsm import DEFAULT_FLAP_WINDOW
+        from tpu_node_checker.history.store import DEFAULT_MAX_ROUNDS
+
+        window = args.flap_window or DEFAULT_FLAP_WINDOW
+        if window > (args.history_max_rounds or DEFAULT_MAX_ROUNDS):
+            p.error(
+                "--flap-window cannot exceed --history-max-rounds (a "
+                "restarted checker reseeds from the store, which could "
+                "never hold enough rounds to trip the detector)"
+            )
     if args.selftest and (
         args.emit_probe
         or args.node_events
@@ -306,6 +407,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.uncordon_recovered
         or args.report_fresh
         or args.trend
+        or args.trend_nodes
+        or args.history
         or args.calibrate is not None
         or args.slack_webhook
         or args.log_jsonl
@@ -335,6 +438,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             or args.uncordon_recovered
             or args.report_fresh
             or args.trend
+            or args.trend_nodes
+            or args.history
             or args.slack_webhook
             or args.slack_only_on_error
             or args.log_jsonl
@@ -377,6 +482,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.probe_results
         or args.cordon_failed
         or args.uncordon_recovered
+        or args.history
+        or args.trend_nodes
     ):
         # A liveness verdict must stay a liveness verdict: combined check /
         # emit / quarantine flags would silently do nothing (main() returns
@@ -461,6 +568,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if getattr(args, "trend", None):
             return checker.trend_summary(args.trend, json_mode=args.json)
+        if getattr(args, "trend_nodes", None):
+            return checker.trend_nodes(args.trend_nodes, json_mode=args.json)
         if getattr(args, "selftest", False):
             return checker.selftest(args)
         if getattr(args, "calibrate", None) is not None:

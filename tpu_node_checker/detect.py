@@ -274,6 +274,10 @@ class NodeInfo:
     # not fetched): [{type, reason, message, count, last_seen}], newest
     # first — the `kubectl describe node` triage block, pushed not dug for.
     events: Optional[list] = None
+    # Hysteresis verdict from the --history subsystem (None = no history):
+    # {state, streak, flaps} per history/fsm.py — the debounced view the
+    # cordon/uncordon path consults instead of this round's raw snapshot.
+    health: Optional[dict] = None
 
     @property
     def is_tpu(self) -> bool:
@@ -363,6 +367,8 @@ class NodeInfo:
             d["probe"] = self.probe
         if self.events is not None:
             d["events"] = list(self.events)
+        if self.health is not None:
+            d["health"] = dict(self.health)
         return d
 
 

@@ -1,0 +1,25 @@
+"""Per-node health history: durable store + hysteresis state machine.
+
+The layer between probing and remediation (DESIGN.md §9).  Everything in
+this package is reached only through ``--history FILE``; without the flag
+the checker's per-round behavior is untouched.
+"""
+
+from tpu_node_checker.history.fsm import (  # noqa: F401
+    CHRONIC,
+    DEFAULT_CORDON_AFTER,
+    DEFAULT_FLAP_THRESHOLD,
+    DEFAULT_FLAP_WINDOW,
+    DEFAULT_UNCORDON_AFTER,
+    FAILED,
+    HEALTHY,
+    HealthFSM,
+    RECOVERING,
+    SUSPECT,
+)
+from tpu_node_checker.history.store import (  # noqa: F401
+    DEFAULT_MAX_ROUNDS,
+    HISTORY_SCHEMA_VERSION,
+    HistoryStore,
+    read_jsonl_tolerant,
+)

@@ -437,6 +437,30 @@ def render_metrics(
                     "Unhealthy hosts beyond the per-host series cap.",
                     [({}, len(unhealthy) - cap)],
                 )
+    history = payload.get("history")
+    if history is not None:
+        # Hysteresis roll-up (--history): EVERY state always emits (0
+        # included) so a node leaving CHRONIC reads as a return to zero,
+        # not a vanished series — same policy as probe_hosts.
+        from tpu_node_checker.history.fsm import STATES
+
+        states = history.get("states") or {}
+        family(
+            "tpu_node_checker_node_state",
+            "gauge",
+            "Accelerator nodes by hysteresis state (HEALTHY/SUSPECT/FAILED/"
+            "RECOVERING/CHRONIC; CHRONIC = flap detector tripped, held "
+            "cordoned).",
+            [({"state": s}, float(states.get(s, 0))) for s in STATES],
+        )
+        family(
+            "tpu_node_checker_node_flaps_total",
+            "counter",
+            "Lifetime verdict flips summed across the fleet's history "
+            "store — a rising rate is quarantine churn in progress even "
+            "while every round's aggregate grade stays green.",
+            [({}, float(history.get("flaps_total", 0)))],
+        )
     transport = payload.get("api_transport")
     if transport:
         # Keep-alive pool telemetry (session-lifetime counters): opened

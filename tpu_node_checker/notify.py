@@ -39,7 +39,8 @@ def get_slack_webhook_url(flag_value: Optional[str]) -> Optional[str]:
 
 
 def should_send_slack_message(
-    webhook_url: Optional[str], only_on_error: bool, healthy: bool
+    webhook_url: Optional[str], only_on_error: bool, healthy: bool,
+    transitions: bool = False,
 ) -> bool:
     """Gating policy (check-gpu-node.py:147-157): no URL → never;
     only-on-error → only when the check failed; else always.
@@ -48,11 +49,17 @@ def should_send_slack_message(
     check outcome (exit code 0), so strict-slice and probe failures also
     count as errors — otherwise ``--strict-slices --slack-only-on-error``
     could exit 3 while Slack stays silent.
+
+    ``transitions`` extends the same no-silent-failure rule to the
+    ``--history`` hysteresis layer: an actionable per-node state transition
+    (→FAILED, →CHRONIC, a re-earned HEALTHY) pages through
+    ``--slack-only-on-error`` even on an exit-0 round — one node going
+    chronic in a big fleet never moves the aggregate exit code.
     """
     if not webhook_url:
         return False
     if only_on_error:
-        return not healthy
+        return (not healthy) or transitions
     return True
 
 

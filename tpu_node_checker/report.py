@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Sequence
 from tpu_node_checker.detect import NodeInfo, SliceInfo
 
 
-def _render_columns(headers: Sequence[str], rows: Sequence[Sequence[str]]) -> str:
+def render_columns(headers: Sequence[str], rows: Sequence[Sequence[str]]) -> str:
     """Dynamic-width text table, same technique as check-gpu-node.py:234-249."""
     widths = [len(h) for h in headers]
     for row in rows:
@@ -65,7 +65,7 @@ def format_node_table(nodes: Sequence[NodeInfo]) -> str:
         if n.probe is not None:
             probe = "ok" if n.probe.get("ok") else "FAIL"
         rows.append([n.name, _status(n), str(n.accelerators), keys, topo, probe])
-    return _render_columns(["NAME", "READY", "ACCEL", "KEYS", "TPU", "PROBE"], rows)
+    return render_columns(["NAME", "READY", "ACCEL", "KEYS", "TPU", "PROBE"], rows)
 
 
 def _degraded(s: SliceInfo) -> str:
@@ -96,7 +96,7 @@ def format_slice_table(slices: Sequence[SliceInfo]) -> str:
                 "complete" if s.complete else _degraded(s),
             ]
         )
-    return _render_columns(
+    return render_columns(
         ["SLICE(NODEPOOL)", "ACCELERATOR", "TOPOLOGY", "HOSTS", "CHIPS", "STATUS"], rows
     )
 
@@ -118,7 +118,7 @@ def format_multislice_table(multislices: Sequence) -> str:
                 "complete" if m.complete else "DEGRADED",
             ]
         )
-    return _render_columns(
+    return render_columns(
         ["MULTISLICE(GROUP)", "SLICES", "HOSTS", "CHIPS", "STATUS"], rows
     )
 
@@ -207,6 +207,58 @@ def _named_list(names: Sequence[str], cap: int = 10) -> str:
     return ", ".join(shown) + (f" (+{extra} more)" if extra > 0 else "")
 
 
+def _history_lines(history: Optional[dict]) -> List[str]:
+    """Hysteresis surface of the Slack message (``--history``).
+
+    Transition lines render only for ACTIONABLE transitions (→FAILED,
+    →CHRONIC, a re-earned HEALTHY, a human override releasing CHRONIC) —
+    sub-threshold SUSPECT/RECOVERING wobble is the churn the FSM absorbs
+    and must not re-emit here.  Standing CHRONIC offenders get their own
+    line every message: a flapper sitting cordoned is an open incident,
+    not a one-time event.
+    """
+    if not history:
+        return []
+    lines: List[str] = []
+    thresholds = history.get("thresholds") or {}
+    k = thresholds.get("cordon_after")
+    m = thresholds.get("uncordon_after")
+    f = thresholds.get("flap_threshold")
+    w = thresholds.get("flap_window")
+    for t in history.get("transitions", []):
+        if not t.get("actionable"):
+            continue
+        node, to, frm = t.get("node"), t.get("to"), t.get("from")
+        if to == "CHRONIC":
+            lines.append(
+                f"🔁 `{node}` went CHRONIC: ≥{f} verdict flips inside "
+                f"{w} rounds — staying cordoned, auto-uncordon disabled "
+                "until a human investigates"
+            )
+        elif to == "FAILED":
+            lines.append(
+                f"⛔ `{node}` health {frm} → FAILED "
+                f"({k} consecutive bad round(s)): cordon-eligible"
+            )
+        elif to == "HEALTHY":
+            lines.append(
+                f"♻️ `{node}` health {frm} → HEALTHY "
+                f"({m} consecutive good round(s)): quarantine can lift"
+            )
+        elif frm == "CHRONIC" and to == "RECOVERING":
+            lines.append(
+                f"🤝 `{node}` CHRONIC quarantine lifted out-of-band: now "
+                f"RECOVERING — must re-earn HEALTHY ({m} good round(s))"
+            )
+    chronic = history.get("chronic") or []
+    if chronic:
+        lines.append(
+            f"🔁 {len(chronic)} chronic flapper(s) held in quarantine "
+            f"(excluded from auto-uncordon): {_named_list(chronic)}"
+        )
+    return lines
+
+
 def format_slack_message(
     accel: Sequence[NodeInfo],
     ready: Sequence[NodeInfo],
@@ -215,6 +267,7 @@ def format_slack_message(
     multislices: Sequence = (),
     cordon: Optional[dict] = None,
     uncordon: Optional[dict] = None,
+    history: Optional[dict] = None,
 ) -> str:
     """Slack mrkdwn message.
 
@@ -377,4 +430,5 @@ def format_slack_message(
                 f"⚠️ uncordon failed — capacity still quarantined: "
                 f"{_named_list(names)}"
             )
+    lines.extend(_history_lines(history))
     return "\n".join(lines)
