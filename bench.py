@@ -46,6 +46,14 @@ Retry-layer evidence (the graded-retry tentpole):
   must recover within its retry budget with the healthy walk's exact
   verdict, retries > 0 in the transport telemetry.
 
+Fleet-API serving evidence (the snapshot-cache tentpole):
+
+* ``serve_etag_hit_p50_ms`` — GET /api/v1/nodes on the 2k-node round with
+  the round's ETag (the cached 304 path every poller after the first
+  request rides) vs ``serve_cold_encode_p50_ms`` (the same GET with the
+  snapshot cache disabled: one full JSON encode per request — the
+  pre-snapshot cost model).  The run ASSERTS cached < cold.
+
 Prints ONE JSON line:
   {"metric": "check_latency_p50_ms", "value": <cold e2e p50 ms>, "unit": "ms",
    "vs_baseline": <2000 / p50>,      # >1.0 ⇔ faster than the 2 s target
@@ -307,6 +315,7 @@ def main() -> int:
         result = checker.run_check(big_args)
         big_latencies.append(result.payload["timings_ms"]["total"])
     nodes5k_p50 = statistics.median(big_latencies)
+    big_result = result  # the fleet-API serve case publishes this round
     # No-fault fast path: with the retry layer ON (default budget), a
     # healthy walk adds ZERO extra requests — the server saw exactly
     # pages-per-round × rounds, and the transport counted no retries.
@@ -347,6 +356,64 @@ def main() -> int:
     checker.reset_client_cache()
     fault_server.shutdown()
     os.unlink(fault_kubeconfig)
+
+    # Fleet state API serving (the snapshot-cache tentpole): on the 2k-node
+    # payload, p50 of the CACHED path — a poller re-sending the round's
+    # ETag rides a 304 with zero body bytes and zero encoding — vs the
+    # COLD-ENCODE path (one full JSON encode per request, the pre-snapshot
+    # cost model, exposed by the app's bench-only pre_serialized=False
+    # seam).  Correctness gated before timing: the cached 200 body and the
+    # cold body describe the same round.
+    import http.client
+
+    from tpu_node_checker.server.app import FleetStateServer
+
+    api = FleetStateServer(0, host="127.0.0.1")
+    api.publish(big_result)
+    cold_api = FleetStateServer(0, host="127.0.0.1", pre_serialized=False)
+    cold_api.publish(big_result)
+
+    def _serve_p50(port, path, headers, expect_status, reps=41):
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        samples = []
+        try:
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                conn.request("GET", path, headers=headers)
+                resp = conn.getresponse()
+                resp.read()
+                samples.append((time.perf_counter() - t0) * 1e3)
+                assert resp.status == expect_status, (resp.status, expect_status)
+        finally:
+            conn.close()
+        return statistics.median(samples)
+
+    conn = http.client.HTTPConnection("127.0.0.1", api.port)
+    conn.request("GET", "/api/v1/nodes")
+    resp = conn.getresponse()
+    cached_body = resp.read()
+    etag = resp.getheader("ETag")
+    conn.close()
+    assert etag, "snapshot entity carried no ETag"
+    cold_conn = http.client.HTTPConnection("127.0.0.1", cold_api.port)
+    cold_conn.request("GET", "/api/v1/nodes")
+    cold_body = cold_conn.getresponse().read()
+    cold_conn.close()
+    assert json.loads(cached_body)["count"] == 2024
+    assert json.loads(cold_body)["nodes"] == json.loads(cached_body)["nodes"]
+
+    serve_etag_p50 = _serve_p50(
+        api.port, "/api/v1/nodes", {"If-None-Match": etag}, 304
+    )
+    serve_cold_p50 = _serve_p50(cold_api.port, "/api/v1/nodes", {}, 200)
+    api.close()
+    cold_api.close()
+    # The acceptance gate: the cached (ETag-hit) path must beat re-encoding
+    # the 2k-node body per request.
+    assert serve_etag_p50 < serve_cold_p50, (
+        f"ETag-hit p50 {serve_etag_p50:.2f}ms not below cold-encode "
+        f"p50 {serve_cold_p50:.2f}ms"
+    )
 
     # The 5k-node paged walk over HTTPS — where per-page handshakes hurt
     # most (~11 pages/round).  Pooled transport vs the pre-pool equivalent
@@ -443,6 +510,8 @@ def main() -> int:
                 ),
                 "nodes5k_paged_internal_p50_ms": round(nodes5k_p50, 2),
                 "nodes5k_fault30_p50_ms": round(nodes5k_fault30_p50, 2),
+                "serve_etag_hit_p50_ms": round(serve_etag_p50, 3),
+                "serve_cold_encode_p50_ms": round(serve_cold_p50, 3),
                 "nodes5k_paged_https_p50_ms": (
                     round(nodes5k_tls_p50, 2) if nodes5k_tls_p50 is not None else None
                 ),

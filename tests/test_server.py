@@ -1,0 +1,1129 @@
+"""Fleet state API server (``--serve``): snapshot swap, negotiation, auth.
+
+The serving contract under test:
+
+* every GET is answered from an IMMUTABLE pre-serialized snapshot — the
+  hammer test polls all endpoints from 16 threads while rounds swap
+  snapshots underneath and asserts zero torn/invalid JSON, zero 500s, and
+  ETags that are stable within a round and different across rounds;
+* writes are deny-by-default (no token → 403, bad token → 401) and
+  evidence-gated (FSM rules → 409), with the live PATCH observed
+  server-side exactly once;
+* ``/api/v1/trend`` is cached — rebuilt on publication or file change,
+  never per request;
+* without ``--serve`` nothing changes: payload bytes and metrics output
+  are identical whether the flag surface exists or not.
+
+Wall-clock guard (same policy as tests/test_retry.py): nothing here sleeps
+for real — waits are event-based or bounded socket I/O — and every test is
+timed; a leaked sleep or a wedged handler fails the suite, not just slows it.
+"""
+
+import gzip
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+from tpu_node_checker.server import app as server_app
+from tpu_node_checker.server.app import FleetStateServer
+from tpu_node_checker.server.auth import check_write_auth, resolve_serve_token
+from tpu_node_checker.server.router import Response, Router, negotiate
+from tpu_node_checker.server.snapshot import (
+    Entity,
+    build_snapshot,
+    build_store_snapshot,
+)
+
+WALL_CLOCK_BUDGET_S = 20.0
+
+
+@pytest.fixture(autouse=True)
+def _wall_clock_guard():
+    t0 = time.perf_counter()
+    yield
+    elapsed = time.perf_counter() - t0
+    assert elapsed < WALL_CLOCK_BUDGET_S, (
+        f"server test burned {elapsed:.1f}s of wall-clock — a real sleep or "
+        "a wedged handler leaked in"
+    )
+
+
+def _result(nodes=None, extra=()):
+    args = cli.parse_args(["--json", *extra])
+    return checker.run_check(
+        args,
+        nodes=[json.loads(json.dumps(n)) for n in (nodes or fx.tpu_v5e_256_slice())],
+    )
+
+
+def _req(port, method, path, headers=None, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.headers.items()), resp.read()
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def server():
+    srv = FleetStateServer(0, host="127.0.0.1")
+    yield srv
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Router + negotiation units
+# ---------------------------------------------------------------------------
+
+
+class TestRouter:
+    def _router(self):
+        r = Router()
+        r.add("GET", "/api/v1/nodes", lambda req: Response(200, b"list"))
+        r.add("GET", "/api/v1/nodes/{name}", lambda req: Response(200, b"one"))
+        r.add("POST", "/api/v1/nodes/{name}/cordon", lambda req: Response(200))
+        return r
+
+    def test_param_capture_and_percent_decoding(self):
+        handler, params, pattern = self._router().resolve(
+            "GET", "/api/v1/nodes/gke-tpu%2F0"
+        )
+        assert params == {"name": "gke-tpu/0"}
+        assert pattern == "/api/v1/nodes/{name}"
+
+    def test_unknown_path_is_404(self):
+        resp = self._router().resolve("GET", "/api/v2/nodes")
+        assert isinstance(resp, Response) and resp.status == 404
+
+    def test_wrong_method_is_405_with_allow(self):
+        resp = self._router().resolve("DELETE", "/api/v1/nodes")
+        assert isinstance(resp, Response) and resp.status == 405
+        assert resp.headers["Allow"] == "GET, HEAD"
+
+    def test_head_resolves_through_get(self):
+        handler, params, pattern = self._router().resolve("HEAD", "/api/v1/nodes")
+        assert pattern == "/api/v1/nodes"
+
+
+class TestNegotiate:
+    def test_strong_etag_304(self):
+        entity = Entity(b"x" * 400)
+        hit = negotiate(entity, {"If-None-Match": entity.etag})
+        assert hit.status == 304 and hit.body == b""
+        assert hit.headers["ETag"] == entity.etag
+
+    @pytest.mark.parametrize(
+        "header",
+        ['"nope", {etag}', "W/{etag}", "*"],
+    )
+    def test_etag_list_weak_and_star_forms(self, header):
+        entity = Entity(b"y" * 400)
+        got = negotiate(entity, {"If-None-Match": header.format(etag=entity.etag)})
+        assert got.status == 304
+
+    def test_miss_serves_body_with_etag(self):
+        entity = Entity(b"z" * 400)
+        got = negotiate(entity, {"If-None-Match": '"something-else"'})
+        assert got.status == 200 and got.body == entity.raw
+        assert got.headers["Vary"] == "Accept-Encoding"
+
+    def test_gzip_only_when_accepted_and_smaller(self):
+        big = Entity(json.dumps({"k": ["v"] * 200}).encode())
+        plain = negotiate(big, {})
+        assert plain.body == big.raw and "Content-Encoding" not in plain.headers
+        gz = negotiate(big, {"Accept-Encoding": "gzip, br"})
+        assert gz.headers["Content-Encoding"] == "gzip"
+        assert gzip.decompress(gz.body) == big.raw
+        # Tiny bodies skip gzip entirely (the header would cost more).
+        small = Entity(b"{}")
+        assert small.gz is None
+        got = negotiate(small, {"Accept-Encoding": "gzip"})
+        assert got.body == small.raw and "Content-Encoding" not in got.headers
+
+
+class TestAuth:
+    def test_no_token_configured_is_403_final(self):
+        status, reason = check_write_auth(None, "Bearer anything")
+        assert status == 403 and "disabled" in reason
+
+    def test_missing_or_malformed_header_is_401(self):
+        assert check_write_auth("s3cret", None)[0] == 401
+        assert check_write_auth("s3cret", "Basic s3cret")[0] == 401
+
+    def test_wrong_token_is_401_right_token_passes(self):
+        assert check_write_auth("s3cret", "Bearer wrong")[0] == 401
+        assert check_write_auth("s3cret", "Bearer s3cret") == (None, "")
+
+    def test_env_fallback_flag_wins(self, monkeypatch):
+        monkeypatch.setenv("TNC_SERVE_TOKEN", "from-env")
+        assert resolve_serve_token(None) == "from-env"
+        assert resolve_serve_token("from-flag") == "from-flag"
+        monkeypatch.delenv("TNC_SERVE_TOKEN")
+        assert resolve_serve_token(None) is None
+
+
+# ---------------------------------------------------------------------------
+# Read surface
+# ---------------------------------------------------------------------------
+
+
+class TestReadSurface:
+    def test_endpoints_serve_the_published_round(self, server):
+        result = _result()
+        server.publish(result)
+        port = server.port
+
+        status, headers, body = _req(port, "GET", "/api/v1/summary")
+        summary = json.loads(body)
+        assert status == 200
+        assert summary["round"] == 1
+        assert summary["exit_code"] == 0
+        assert summary["total_nodes"] == result.payload["total_nodes"]
+        assert summary["ready_chips"] == 256
+        assert summary["slices"] == {"total": 1, "complete": 1}
+
+        status, _, body = _req(port, "GET", "/api/v1/nodes")
+        nodes = json.loads(body)
+        assert status == 200 and nodes["count"] == 64
+        # Verbatim payload entries — the API must not re-derive the round.
+        assert nodes["nodes"] == result.payload["nodes"]
+
+        name = result.payload["nodes"][0]["name"]
+        status, _, body = _req(port, "GET", f"/api/v1/nodes/{name}")
+        assert status == 200 and json.loads(body)["node"]["name"] == name
+
+        status, _, body = _req(port, "GET", "/api/v1/slices")
+        slices = json.loads(body)
+        assert status == 200 and slices["slices"] == result.payload["slices"]
+
+    def test_unknown_node_404s_with_round(self, server):
+        server.publish(_result())
+        status, _, body = _req(server.port, "GET", "/api/v1/nodes/nope")
+        assert status == 404 and json.loads(body)["round"] == 1
+
+    def test_unknown_path_404_and_wrong_method_405(self, server):
+        server.publish(_result())
+        assert _req(server.port, "GET", "/api/v2/summary")[0] == 404
+        status, headers, _ = _req(server.port, "POST", "/api/v1/summary")
+        assert status == 405 and "GET" in headers["Allow"]
+
+    def test_head_matches_get_headers_with_no_body(self, server):
+        server.publish(_result())
+        g_status, g_headers, g_body = _req(server.port, "GET", "/api/v1/nodes")
+        h_status, h_headers, h_body = _req(server.port, "HEAD", "/api/v1/nodes")
+        assert (h_status, h_body) == (200, b"")
+        assert h_headers["Content-Length"] == str(len(g_body))
+        assert h_headers["ETag"] == g_headers["ETag"]
+
+    def test_etag_hit_304_and_gzip_roundtrip(self, server):
+        server.publish(_result())
+        _, headers, body = _req(server.port, "GET", "/api/v1/nodes")
+        etag = headers["ETag"]
+        status, headers2, body2 = _req(
+            server.port, "GET", "/api/v1/nodes", {"If-None-Match": etag}
+        )
+        assert (status, body2) == (304, b"") and headers2["ETag"] == etag
+        status, headers3, body3 = _req(
+            server.port, "GET", "/api/v1/nodes", {"Accept-Encoding": "gzip"}
+        )
+        assert headers3.get("Content-Encoding") == "gzip"
+        assert gzip.decompress(body3) == body
+        assert len(body3) < len(body)
+
+    def test_503_before_first_round(self, server):
+        for path in ("/api/v1/summary", "/api/v1/nodes", "/api/v1/slices",
+                     "/api/v1/nodes/x"):
+            status, _, body = _req(server.port, "GET", path)
+            assert status == 503, path
+            assert "no completed" in json.loads(body)["error"]
+
+    def test_trend_404_when_not_configured(self, server):
+        server.publish(_result())
+        assert _req(server.port, "GET", "/api/v1/trend")[0] == 404
+
+    def test_unread_post_body_does_not_desync_keepalive(self, server):
+        # A 404/405 answer must still drain the request body: leftover
+        # bytes in the socket would be parsed as the START of the next
+        # keep-alive request on the connection.
+        server.publish(_result())
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            conn.request(
+                "POST", "/api/v1/unknown", body=b'{"x": "y"}',
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 404
+            # Same connection: the next request must parse cleanly.
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+        finally:
+            conn.close()
+
+    def test_metrics_carries_fleet_and_server_families(self, server):
+        server.publish(_result())
+        _req(server.port, "GET", "/api/v1/summary")
+        _, _, body = _req(server.port, "GET", "/metrics")
+        text = body.decode()
+        assert 'tpu_node_checker_chips{state="ready"} 256' in text
+        assert 'tpu_node_checker_api_server_requests_total{method="GET"' in text
+        assert "tpu_node_checker_api_server_in_flight" in text
+        assert "tpu_node_checker_api_server_auth_failures_total 0" in text
+
+
+class TestReadiness:
+    def test_healthz_always_ok_readyz_needs_a_round(self, server):
+        assert _req(server.port, "GET", "/healthz")[0] == 200
+        status, _, body = _req(server.port, "GET", "/readyz")
+        assert status == 503 and json.loads(body)["ready"] is False
+        server.publish(_result())
+        status, _, body = _req(server.port, "GET", "/readyz")
+        doc = json.loads(body)
+        assert status == 200 and doc["ready"] is True and doc["round"] == 1
+
+    def test_open_breaker_flips_readyz_snapshot_keeps_serving(self, server):
+        server.publish(_result(), breaker={"open": False, "consecutive_failures": 0})
+        assert _req(server.port, "GET", "/readyz")[0] == 200
+        server.mark_error({"open": True, "consecutive_failures": 3})
+        status, _, body = _req(server.port, "GET", "/readyz")
+        assert status == 503
+        assert "breaker open" in json.loads(body)["reason"]
+        # The stale-but-present snapshot still answers reads.
+        assert _req(server.port, "GET", "/api/v1/summary")[0] == 200
+        # Recovery: the next published round restores readiness.
+        server.publish(_result(), breaker={"open": False, "consecutive_failures": 0})
+        assert _req(server.port, "GET", "/readyz")[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# The hammer: concurrent polls across live snapshot swaps
+# ---------------------------------------------------------------------------
+
+
+class TestHammer:
+    ENDPOINTS = ("/api/v1/summary", "/api/v1/nodes", "/api/v1/slices")
+    CLIENTS = 16
+    ROUNDS = 25
+
+    def test_no_torn_reads_no_500s_etag_stable_within_round(self, server):
+        nodes = fx.tpu_v5p_64_slice()[:8]
+        result = _result(nodes)
+        server.publish(result)
+        port = server.port
+        done = threading.Event()
+        start = threading.Barrier(self.CLIENTS + 1)
+        records = [[] for _ in range(self.CLIENTS)]
+        errors = []
+
+        def worker(slot):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            try:
+                start.wait(timeout=10)
+                last_etag = {}
+                while not done.is_set():
+                    for path in self.ENDPOINTS + ("/api/v1/nodes/" + nodes[0]["metadata"]["name"],):
+                        headers = {}
+                        if path in last_etag:
+                            headers["If-None-Match"] = last_etag[path]
+                        conn.request("GET", path, headers=headers)
+                        resp = conn.getresponse()
+                        body = resp.read()
+                        etag = resp.headers.get("ETag")
+                        if resp.status == 200:
+                            last_etag[path] = etag
+                        records[slot].append((path, resp.status, etag, body))
+            except Exception as exc:  # noqa: BLE001 — surfaced as a failure below
+                errors.append(f"client {slot}: {exc!r}")
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(self.CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        start.wait(timeout=10)
+        # Swap ROUNDS snapshots under the pollers — no pacing, the tightest
+        # interleave we can produce.
+        for _ in range(self.ROUNDS):
+            server.publish(result)
+        done.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "hammer client wedged"
+        assert not errors, errors
+
+        flat = [r for rec in records for r in rec]
+        assert len(flat) > self.CLIENTS  # the hammer actually hammered
+        # Zero 500s, zero anything outside the 200/304 contract.
+        assert {status for _, status, _, _ in flat} <= {200, 304}
+        # Every 200 is complete, valid JSON — no torn reads mid-swap.
+        etag_to_round = {}
+        etag_to_body = {}
+        rounds_seen = set()
+        for path, status, etag, body in flat:
+            if status != 200:
+                continue
+            doc = json.loads(body)  # raises on a torn body
+            rnd = doc["round"]
+            rounds_seen.add(rnd)
+            key = (path, etag)
+            # ETag ↔ representation is a bijection: one ETag never names
+            # two bodies (stable within a round) ...
+            assert etag_to_body.setdefault(key, body) == body
+            # ... and one ETag never spans two rounds (changes across rounds).
+            assert etag_to_round.setdefault(key, rnd) == rnd
+        # Distinct rounds were actually observed mid-flight, and each
+        # (path, round) pair carried exactly one ETag.
+        assert len(rounds_seen) > 1
+        per_round_etags = {}
+        for (path, etag), rnd in etag_to_round.items():
+            per_round_etags.setdefault((path, rnd), set()).add(etag)
+        assert all(len(v) == 1 for v in per_round_etags.values())
+
+
+# ---------------------------------------------------------------------------
+# Write path: auth + evidence gating + the live PATCH
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fake_api(tmp_path):
+    """Fake API server recording PATCHes + a kubeconfig pointing at it
+    (same seam as tests/test_cordon.py / test_history_fsm.py)."""
+    from http.server import BaseHTTPRequestHandler
+
+    patches = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_PATCH(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            patches.append({"path": self.path, "body": json.loads(body)})
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *args):
+            pass
+
+    srv = fx.serve_http(Handler)
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: t\n"
+        "contexts: [{name: t, context: {cluster: t, user: t}}]\n"
+        "clusters: [{name: t, cluster: {server: "
+        f'"http://127.0.0.1:{srv.server_address[1]}"}}}}]\n'
+        "users: [{name: t, user: {token: tok}}]\n"
+    )
+    yield {"patches": patches, "kubeconfig": str(kubeconfig)}
+    srv.shutdown()
+
+
+def _tpu_node(name="tpu-0", **kw):
+    return fx.make_node(
+        name,
+        allocatable={"google.com/tpu": "4"},
+        labels={
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-nodepool": "p",
+        },
+        **kw,
+    )
+
+
+def _probe_dir(tmp_path, verdicts, tag):
+    d = tmp_path / f"probes-{tag}"
+    d.mkdir()
+    for host, ok in verdicts.items():
+        (d / f"{host}.json").write_text(
+            json.dumps(
+                {
+                    "ok": ok,
+                    "level": "compute",
+                    "hostname": host,
+                    "written_at": time.time(),
+                    "error": None if ok else "matmul numerics failed",
+                }
+            )
+        )
+    return str(d)
+
+
+class TestWriteDecision:
+    """Unit matrix over checker._api_write_decision — the evidence rules."""
+
+    def _node(self, **kw):
+        base = {
+            "name": "tpu-0", "ready": True, "schedulable": True,
+            "cordoned": False,
+        }
+        base.update(kw)
+        return base
+
+    def test_cordon_needs_failed_probe_evidence(self):
+        ok, why = checker._api_write_decision(
+            self._node(probe={"ok": False, "level": "compute"}), "cordon"
+        )
+        assert ok, why
+        assert not checker._api_write_decision(self._node(), "cordon")[0]
+        assert not checker._api_write_decision(
+            self._node(probe={"ok": True, "level": "compute"}), "cordon"
+        )[0]
+        # Absence is not evidence — same rule as the sweep.
+        assert not checker._api_write_decision(
+            self._node(probe={"ok": False, "level": "missing"}), "cordon"
+        )[0]
+
+    def test_cordon_refuses_notready_cordoned_unschedulable(self):
+        probe = {"ok": False, "level": "compute"}
+        for node in (
+            self._node(ready=False, probe=probe),
+            self._node(cordoned=True, probe=probe),
+            self._node(schedulable=False, probe=probe),
+        ):
+            ok, _ = checker._api_write_decision(node, "cordon")
+            assert not ok
+
+    def test_cordon_fsm_gated_when_history_rides(self):
+        probe = {"ok": False, "level": "compute"}
+        suspect = self._node(probe=probe, health={"state": "SUSPECT", "streak": 1})
+        ok, why = checker._api_write_decision(suspect, "cordon")
+        assert not ok and "SUSPECT" in why
+        failed = self._node(probe=probe, health={"state": "FAILED", "streak": 2})
+        assert checker._api_write_decision(failed, "cordon")[0]
+        chronic = self._node(probe=probe, health={"state": "CHRONIC", "streak": 0})
+        assert checker._api_write_decision(chronic, "cordon")[0]
+
+    def test_uncordon_needs_our_annotation_and_passing_probe(self):
+        good = self._node(
+            cordoned=True, quarantined_by_us=True,
+            probe={"ok": True, "level": "compute"},
+        )
+        assert checker._api_write_decision(good, "uncordon")[0]
+        human = self._node(cordoned=True, probe={"ok": True, "level": "compute"})
+        ok, why = checker._api_write_decision(human, "uncordon")
+        assert not ok and "human" in why
+        no_probe = self._node(cordoned=True, quarantined_by_us=True)
+        assert not checker._api_write_decision(no_probe, "uncordon")[0]
+        assert not checker._api_write_decision(self._node(), "uncordon")[0]
+
+    def test_uncordon_fsm_gated_chronic_never_lifts(self):
+        base = dict(
+            cordoned=True, quarantined_by_us=True,
+            probe={"ok": True, "level": "compute"},
+        )
+        recovering = self._node(**base, health={"state": "RECOVERING", "streak": 1})
+        ok, why = checker._api_write_decision(recovering, "uncordon")
+        assert not ok and "RECOVERING" in why
+        chronic = self._node(**base, health={"state": "CHRONIC", "streak": 5})
+        ok, why = checker._api_write_decision(chronic, "uncordon")
+        assert not ok and "CHRONIC" in why
+        healthy = self._node(**base, health={"state": "HEALTHY", "streak": 3})
+        assert checker._api_write_decision(healthy, "uncordon")[0]
+
+
+class TestWriteAuthEndToEnd:
+    def _server(self, tmp_path, fake_api, token, tag="w", node_ok=False,
+                history=True):
+        extra = [
+            "--kubeconfig", fake_api["kubeconfig"],
+            "--probe-results", _probe_dir(tmp_path, {"tpu-0": node_ok}, tag),
+        ]
+        if history:
+            extra += ["--history", str(tmp_path / f"history-{tag}.jsonl")]
+        args = cli.parse_args(["--json", *extra])
+        result = checker.run_check(args, nodes=[_tpu_node()])
+        srv = FleetStateServer(
+            0, host="127.0.0.1", token=token,
+            control=checker._make_serve_control(args),
+        )
+        srv.publish(result)
+        return srv
+
+    def test_no_token_configured_writes_403(self, tmp_path, fake_api):
+        srv = self._server(tmp_path, fake_api, token=None)
+        try:
+            status, _, body = _req(
+                srv.port, "POST", "/api/v1/nodes/tpu-0/cordon",
+                {"Authorization": "Bearer guessed"},
+            )
+            assert status == 403
+            assert "disabled" in json.loads(body)["error"]
+            assert fake_api["patches"] == []
+            assert srv.stats.auth_failures == 1
+        finally:
+            srv.close()
+
+    def test_bad_token_401_with_challenge(self, tmp_path, fake_api):
+        srv = self._server(tmp_path, fake_api, token="s3cret")
+        try:
+            status, headers, _ = _req(srv.port, "POST", "/api/v1/nodes/tpu-0/cordon")
+            assert status == 401 and headers["WWW-Authenticate"] == "Bearer"
+            status, headers, _ = _req(
+                srv.port, "POST", "/api/v1/nodes/tpu-0/cordon",
+                {"Authorization": "Bearer wrong"},
+            )
+            assert status == 401
+            assert fake_api["patches"] == []
+            assert srv.stats.auth_failures == 2
+        finally:
+            srv.close()
+
+    def test_good_token_fsm_gated_patch_lands_exactly_once(
+        self, tmp_path, fake_api
+    ):
+        # K=1 default: one failed probed round → FAILED → cordon-eligible.
+        srv = self._server(tmp_path, fake_api, token="s3cret")
+        try:
+            status, _, body = _req(
+                srv.port, "POST", "/api/v1/nodes/tpu-0/cordon",
+                {"Authorization": "Bearer s3cret"},
+            )
+            doc = json.loads(body)
+            assert status == 200, doc
+            assert doc["applied"] is True and doc["eligible"] is True
+            # Exactly ONE PATCH observed server-side, with the cordon body.
+            assert [p["path"] for p in fake_api["patches"]] == [
+                "/api/v1/nodes/tpu-0"
+            ]
+            assert fake_api["patches"][0]["body"]["spec"] == {
+                "unschedulable": True
+            }
+        finally:
+            srv.close()
+
+    def test_dry_run_decides_without_patching(self, tmp_path, fake_api):
+        srv = self._server(tmp_path, fake_api, token="s3cret")
+        try:
+            status, _, body = _req(
+                srv.port, "POST", "/api/v1/nodes/tpu-0/cordon?dry_run=1",
+                {"Authorization": "Bearer s3cret"},
+            )
+            doc = json.loads(body)
+            assert status == 200 and doc["would_apply"] is True
+            assert doc["applied"] is False and doc["dry_run"] is True
+            assert fake_api["patches"] == []
+        finally:
+            srv.close()
+
+    def test_healthy_node_409_no_patch(self, tmp_path, fake_api):
+        srv = self._server(tmp_path, fake_api, token="s3cret", node_ok=True)
+        try:
+            status, _, body = _req(
+                srv.port, "POST", "/api/v1/nodes/tpu-0/cordon",
+                {"Authorization": "Bearer s3cret"},
+            )
+            doc = json.loads(body)
+            assert status == 409 and doc["eligible"] is False
+            assert fake_api["patches"] == []
+        finally:
+            srv.close()
+
+    def test_unknown_node_404_store_mode_503(self, tmp_path, fake_api):
+        srv = self._server(tmp_path, fake_api, token="s3cret")
+        try:
+            assert _req(
+                srv.port, "POST", "/api/v1/nodes/ghost/cordon",
+                {"Authorization": "Bearer s3cret"},
+            )[0] == 404
+        finally:
+            srv.close()
+        # A store-backed server (control=None) refuses writes with 503.
+        store_srv = FleetStateServer(0, host="127.0.0.1", token="s3cret")
+        try:
+            store_srv.publish(_result([_tpu_node()]))
+            status, _, body = _req(
+                store_srv.port, "POST", "/api/v1/nodes/tpu-0/cordon",
+                {"Authorization": "Bearer s3cret"},
+            )
+            assert status == 503
+            assert "recorded store" in json.loads(body)["error"]
+        finally:
+            store_srv.close()
+
+    def test_auth_failures_emit_one_rate_limited_event(self, tmp_path, fake_api):
+        srv = self._server(tmp_path, fake_api, token="s3cret")
+        events = []
+        srv.on_event = lambda kind, detail: events.append((kind, detail))
+        try:
+            for _ in range(3):
+                _req(srv.port, "POST", "/api/v1/nodes/tpu-0/cordon")
+            assert srv.stats.auth_failures == 3
+            # Rate-limited AND off-thread (the hook may POST to Slack; the
+            # 401 must not wait on it): three rejects inside the window →
+            # exactly ONE event, delivered asynchronously.
+            deadline = time.monotonic() + 5
+            while not events and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert [k for k, _ in events] == ["auth-failure"]
+        finally:
+            srv.close()
+
+    def test_cordon_max_budget_gates_api_writes(self, tmp_path, fake_api):
+        # Two FAILED nodes, default --cordon-max 1: the first authenticated
+        # cordon lands, the second answers 409 — a token holder cannot
+        # drain the pool one request at a time (the sweep's budget rule).
+        tag = "budget"
+        args = cli.parse_args([
+            "--json",
+            "--kubeconfig", fake_api["kubeconfig"],
+            "--probe-results",
+            _probe_dir(tmp_path, {"tpu-0": False, "tpu-1": False}, tag),
+            "--history", str(tmp_path / f"history-{tag}.jsonl"),
+        ])
+        result = checker.run_check(
+            args, nodes=[_tpu_node("tpu-0"), _tpu_node("tpu-1")]
+        )
+        srv = FleetStateServer(
+            0, host="127.0.0.1", token="s3cret",
+            control=checker._make_serve_control(args),
+        )
+        srv.publish(result)
+        try:
+            auth = {"Authorization": "Bearer s3cret"}
+            status, _, body = _req(
+                srv.port, "POST", "/api/v1/nodes/tpu-0/cordon", auth
+            )
+            assert status == 200 and json.loads(body)["applied"] is True
+            status, _, body = _req(
+                srv.port, "POST", "/api/v1/nodes/tpu-1/cordon", auth
+            )
+            doc = json.loads(body)
+            assert status == 409 and "budget exhausted" in doc["reason"]
+            # Exactly the one budgeted PATCH reached the API server.
+            assert [p["path"] for p in fake_api["patches"]] == [
+                "/api/v1/nodes/tpu-0"
+            ]
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Trend cache
+# ---------------------------------------------------------------------------
+
+
+class TestTrendCache:
+    def _log(self, tmp_path, n=3):
+        p = tmp_path / "trend.jsonl"
+        lines = [
+            json.dumps({"ts": 1_700_000_000.0 + 60 * i, "exit_code": 0,
+                        "total_nodes": 2, "ready_nodes": 2})
+            for i in range(n)
+        ]
+        p.write_text("\n".join(lines) + "\n")
+        return p
+
+    def test_trend_served_and_cached_until_file_changes(self, tmp_path):
+        path = self._log(tmp_path)
+        srv = FleetStateServer(0, host="127.0.0.1", trend_path=str(path))
+        try:
+            srv.publish(_result([_tpu_node()]))
+            status, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            assert status == 200 and json.loads(body)["rounds"] == 3
+            assert srv._trend.rebuilds == 1
+            # Same round, same file → cache hit, no re-read, no re-parse.
+            for _ in range(5):
+                _req(srv.port, "GET", "/api/v1/trend")
+            assert srv._trend.rebuilds == 1
+            # Another process appends a round → mtime/size move → rebuild.
+            with open(path, "a") as f:
+                f.write(json.dumps({"ts": 1_700_000_300.0, "exit_code": 3}) + "\n")
+            status, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            assert json.loads(body)["rounds"] == 4
+            assert srv._trend.rebuilds == 2
+        finally:
+            srv.close()
+
+    def test_new_round_invalidates_even_with_same_file(self, tmp_path):
+        path = self._log(tmp_path)
+        srv = FleetStateServer(0, host="127.0.0.1", trend_path=str(path))
+        try:
+            srv.publish(_result([_tpu_node()]))
+            _req(srv.port, "GET", "/api/v1/trend")
+            srv.publish(_result([_tpu_node()]))  # seq moves, file does not
+            _req(srv.port, "GET", "/api/v1/trend")
+            assert srv._trend.rebuilds == 2
+        finally:
+            srv.close()
+
+    def test_empty_or_missing_log_is_machine_readable(self, tmp_path):
+        srv = FleetStateServer(
+            0, host="127.0.0.1", trend_path=str(tmp_path / "absent.jsonl")
+        )
+        try:
+            srv.publish(_result([_tpu_node()]))
+            status, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            doc = json.loads(body)
+            assert status == 200 and doc["rounds"] == 0 and doc["error"]
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Standalone store serving + watch integration
+# ---------------------------------------------------------------------------
+
+
+def _capture_server(monkeypatch):
+    captured = {}
+    real = server_app.FleetStateServer
+
+    def wrapper(*a, **kw):
+        kw.setdefault("host", "127.0.0.1")
+        srv = real(*a, **kw)
+        captured["srv"] = srv
+        return srv
+
+    monkeypatch.setattr(server_app, "FleetStateServer", wrapper)
+    return captured
+
+
+def _store_line(node, i, ok, state, ts=1_700_000_000.0):
+    return json.dumps({
+        "schema": 1, "node": node, "ts": ts + 60 * i, "ok": ok,
+        "causes": [] if ok else ["probe-failed"], "state": state,
+        "streak": 1, "flaps": 0, "flaps_total": 0,
+    })
+
+
+class TestServeStore:
+    def test_standalone_serves_history_store_and_tracks_rewrites(
+        self, tmp_path, monkeypatch
+    ):
+        store = tmp_path / "history.jsonl"
+        store.write_text(
+            "\n".join(
+                [_store_line("tpu-0", i, True, "HEALTHY") for i in range(3)]
+                + [_store_line("tpu-1", i, i < 2, "HEALTHY" if i < 2 else "FAILED")
+                   for i in range(3)]
+            ) + "\n"
+        )
+        captured = _capture_server(monkeypatch)
+        done = threading.Event()
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round", lambda stop, s: done.wait(15)
+        )
+        args = cli.parse_args(["--serve", "0", "--history", str(store)])
+        rc = []
+        thread = threading.Thread(
+            target=lambda: rc.append(checker.serve_store(args)), daemon=True
+        )
+        thread.start()
+        try:
+            deadline = time.monotonic() + 10
+            while "srv" not in captured and time.monotonic() < deadline:
+                time.sleep(0.01)
+            srv = captured["srv"]
+            assert _req(srv.port, "GET", "/readyz")[0] == 200
+            _, _, body = _req(srv.port, "GET", "/api/v1/nodes")
+            doc = json.loads(body)
+            assert doc["count"] == 2 and doc["source"] == "history-store"
+            _, _, body = _req(srv.port, "GET", "/api/v1/nodes/tpu-1")
+            node = json.loads(body)["node"]
+            assert node["health"]["state"] == "FAILED"
+            assert node["causes"] == ["probe-failed"]
+            _, _, body = _req(srv.port, "GET", "/api/v1/summary")
+            summary = json.loads(body)
+            assert summary["states"] == {"HEALTHY": 1, "FAILED": 1}
+            # Writes: no live round → 503 even with... no token here → 403
+            assert _req(srv.port, "POST", "/api/v1/nodes/tpu-1/uncordon")[0] == 403
+            # The owning process writes another round → served on next poll.
+            with open(store, "a") as f:
+                f.write(_store_line("tpu-1", 3, True, "RECOVERING") + "\n")
+            _, _, body = _req(srv.port, "GET", "/api/v1/nodes/tpu-1")
+            assert json.loads(body)["node"]["health"]["state"] == "RECOVERING"
+        finally:
+            done.set()
+            thread.join(timeout=10)
+        assert rc == [128 + 15]
+
+    def test_trendlog_only_mode_summary_degrades_honestly(
+        self, tmp_path, monkeypatch
+    ):
+        log = tmp_path / "trend.jsonl"
+        log.write_text(
+            json.dumps({"ts": 1_700_000_000.0, "exit_code": 0,
+                        "total_nodes": 4, "ready_nodes": 4}) + "\n"
+            + json.dumps({"ts": 1_700_000_060.0, "exit_code": 3,
+                          "total_nodes": 4, "ready_nodes": 3,
+                          "causes": ["probe-failed: tpu-2"]}) + "\n"
+        )
+        captured = _capture_server(monkeypatch)
+        done = threading.Event()
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round", lambda stop, s: done.wait(15)
+        )
+        args = cli.parse_args(["--serve", "0", "--log-jsonl", str(log)])
+        thread = threading.Thread(
+            target=lambda: checker.serve_store(args), daemon=True
+        )
+        thread.start()
+        try:
+            deadline = time.monotonic() + 10
+            while "srv" not in captured and time.monotonic() < deadline:
+                time.sleep(0.01)
+            srv = captured["srv"]
+            _, _, body = _req(srv.port, "GET", "/api/v1/summary")
+            summary = json.loads(body)
+            assert summary["source"] == "trend-log"
+            assert summary["exit_code"] == 3 and summary["healthy"] is False
+            assert summary["causes"] == ["probe-failed: tpu-2"]
+            _, _, body = _req(srv.port, "GET", "/api/v1/nodes")
+            assert json.loads(body)["count"] == 0
+            # /api/v1/trend serves the full summary over the same log.
+            _, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            assert json.loads(body)["rounds"] == 2
+            assert _req(srv.port, "GET", "/readyz")[0] == 200
+        finally:
+            done.set()
+            thread.join(timeout=10)
+
+    def test_empty_store_stays_not_ready(self, tmp_path, monkeypatch):
+        store = tmp_path / "empty.jsonl"
+        store.write_text("")
+        captured = _capture_server(monkeypatch)
+        done = threading.Event()
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round", lambda stop, s: done.wait(15)
+        )
+        args = cli.parse_args(["--serve", "0", "--history", str(store)])
+        thread = threading.Thread(
+            target=lambda: checker.serve_store(args), daemon=True
+        )
+        thread.start()
+        try:
+            deadline = time.monotonic() + 10
+            while "srv" not in captured and time.monotonic() < deadline:
+                time.sleep(0.01)
+            srv = captured["srv"]
+            assert _req(srv.port, "GET", "/readyz")[0] == 503
+            assert _req(srv.port, "GET", "/api/v1/nodes")[0] == 503
+            assert _req(srv.port, "GET", "/healthz")[0] == 200
+        finally:
+            done.set()
+            thread.join(timeout=10)
+
+
+class TestServeCliValidation:
+    @pytest.mark.parametrize(
+        "extra",
+        [
+            ["--probe"],
+            ["--strict-slices"],
+            ["--nodes-json", "n.json"],
+            ["--slack-only-on-error"],
+            ["--label-selector", "x=y"],
+        ],
+    )
+    def test_standalone_serve_rejects_round_only_flags(self, extra, capsys):
+        # Standalone --serve runs no rounds: a flag that only acts during
+        # a round must be rejected, not silently absorbed (the repo's
+        # silent-no-op rule).
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--serve", "0", "--history", "h.jsonl", *extra])
+        assert "runs no check rounds" in capsys.readouterr().err
+
+    def test_watch_serve_accepts_round_flags(self):
+        args = cli.parse_args(
+            ["--watch", "5", "--serve", "0", "--probe", "--strict-slices"]
+        )
+        assert args.serve == 0 and args.probe
+
+    def test_standalone_serve_with_store_flags_parses(self):
+        args = cli.parse_args(
+            ["--serve", "0", "--history", "h.jsonl", "--log-jsonl", "t.jsonl",
+             "--serve-token", "s"]
+        )
+        assert args.serve == 0
+
+
+class TestWatchIntegration:
+    def test_watch_publishes_every_round_and_closes_on_exit(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        nodes = [_tpu_node()]
+        captured = _capture_server(monkeypatch)
+        observed = []
+
+        def fake_fetch(args, timer):
+            return [json.loads(json.dumps(n)) for n in nodes], None
+
+        def fake_wait(stop, s):
+            srv = captured["srv"]
+            status, _, body = _req(srv.port, "GET", "/api/v1/summary")
+            observed.append((status, json.loads(body)["round"]))
+            assert _req(srv.port, "GET", "/readyz")[0] == 200
+            return len(observed) >= 3
+
+        monkeypatch.setattr(checker, "_fetch_nodes", fake_fetch)
+        monkeypatch.setattr(checker, "_wait_for_next_round", fake_wait)
+        args = cli.parse_args(["--watch", "10", "--serve", "0", "--json"])
+        assert checker.watch(args) == 128 + 15
+        assert observed == [(200, 1), (200, 2), (200, 3)]
+        # The finally closed the server: the port no longer accepts.
+        with pytest.raises(OSError):
+            _req(captured["srv"].port, "GET", "/healthz")
+
+
+# ---------------------------------------------------------------------------
+# No --serve → nothing changes (the PR's regression contract)
+# ---------------------------------------------------------------------------
+
+
+class TestNoServeByteIdentical:
+    def test_payload_and_metrics_identical_without_serve_surface(self, capsys):
+        from tpu_node_checker.metrics import render_metrics
+
+        nodes = fx.tpu_v5e_256_slice()
+
+        def run(args):
+            code = checker.one_shot(
+                args, nodes=[json.loads(json.dumps(n)) for n in nodes]
+            )
+            return code, json.loads(capsys.readouterr().out)
+
+        args_flag = cli.parse_args(["--json"])  # serve=None on the namespace
+        args_bare = cli.parse_args(["--json"])
+        # Simulate the pre-serve flag surface entirely absent: the check
+        # path must consult nothing serve-related.
+        delattr(args_bare, "serve")
+        delattr(args_bare, "serve_token")
+        code_a, a = run(args_flag)
+        code_b, b = run(args_bare)
+        assert code_a == code_b
+        a.pop("timings_ms"), b.pop("timings_ms")
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+        def strip_volatile(text):
+            return "\n".join(
+                line for line in text.splitlines()
+                if not line.startswith(
+                    ("tpu_node_checker_last_run_timestamp_seconds ",
+                     "tpu_node_checker_check_duration_ms ")
+                )
+            )
+
+        result_a = checker.run_check(
+            args_flag, nodes=[json.loads(json.dumps(n)) for n in nodes]
+        )
+        result_b = checker.run_check(
+            args_bare, nodes=[json.loads(json.dumps(n)) for n in nodes]
+        )
+        assert strip_volatile(render_metrics(result_a)) == strip_volatile(
+            render_metrics(result_b)
+        )
+
+
+# ---------------------------------------------------------------------------
+# --metrics-port satellite: routed, HEAD, ETag, gzip
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsServerRouted:
+    def _server(self):
+        from tpu_node_checker.metrics import MetricsServer
+
+        return MetricsServer(0, host="127.0.0.1")
+
+    def test_unknown_path_404_root_alias_kept(self):
+        srv = self._server()
+        try:
+            assert _req(srv.port, "GET", "/nope")[0] == 404
+            assert _req(srv.port, "GET", "/")[0] == 200
+            assert _req(srv.port, "GET", "/metrics")[0] == 200
+        finally:
+            srv.close()
+
+    def test_head_etag_gzip_on_metrics(self):
+        srv = self._server()
+        try:
+            srv.update(_result())
+            g_status, g_headers, g_body = _req(srv.port, "GET", "/metrics")
+            assert g_status == 200
+            assert g_headers["Content-Type"].startswith("text/plain")
+            assert b"tpu_node_checker_chips" in g_body
+            # HEAD: the GET's headers, no body.
+            h_status, h_headers, h_body = _req(srv.port, "HEAD", "/metrics")
+            assert (h_status, h_body) == (200, b"")
+            assert h_headers["Content-Length"] == str(len(g_body))
+            # ETag: stable between scrapes of the same round, 304 on match.
+            etag = g_headers["ETag"]
+            status, _, _ = _req(
+                srv.port, "GET", "/metrics", {"If-None-Match": etag}
+            )
+            assert status == 304
+            # A new round swaps the body → the old ETag misses.
+            srv.update(_result(fx.tpu_v5e_256_slice(not_ready=1)))
+            status, headers, _ = _req(
+                srv.port, "GET", "/metrics", {"If-None-Match": etag}
+            )
+            assert status == 200 and headers["ETag"] != etag
+            # gzip negotiation.
+            status, headers, body = _req(
+                srv.port, "GET", "/metrics", {"Accept-Encoding": "gzip"}
+            )
+            assert headers.get("Content-Encoding") == "gzip"
+            assert b"tpu_node_checker_chips" in gzip.decompress(body)
+        finally:
+            srv.close()
+
+    def test_served_bytes_equal_render_metrics_output(self):
+        # The router layer must not mutate the scrape body by a byte
+        # (modulo the wall-clock staleness stamp, which moves per render).
+        from tpu_node_checker.metrics import render_metrics
+
+        def stable(text: bytes) -> list:
+            return [
+                line
+                for line in text.splitlines()
+                if not line.startswith(b"tpu_node_checker_last_run_timestamp_seconds ")
+            ]
+
+        srv = self._server()
+        try:
+            result = _result()
+            srv.update(result)
+            _, _, body = _req(srv.port, "GET", "/metrics")
+            assert stable(body) == stable(render_metrics(result).encode())
+        finally:
+            srv.close()
+
+
+class TestStoreSnapshotUnit:
+    def test_build_store_snapshot_rolls_up_latest_lines(self, tmp_path):
+        store = tmp_path / "s.jsonl"
+        store.write_text(
+            _store_line("a", 0, True, "HEALTHY") + "\n"
+            + _store_line("a", 1, False, "SUSPECT") + "\n"
+            + "{torn\n"
+            + _store_line("b", 0, False, "CHRONIC") + "\n"
+        )
+        snap = build_store_snapshot(str(store), 7, 1_700_000_999.0)
+        summary = json.loads(snap.entities["summary"].raw)
+        assert summary["total_nodes"] == 2
+        assert summary["states"] == {"SUSPECT": 1, "CHRONIC": 1}
+        assert summary["chronic"] == ["b"]
+        assert summary["skipped_lines"] == 1
+        assert json.loads(snap.node_entities["a"].raw)["node"]["health"][
+            "state"
+        ] == "SUSPECT"
+
+    def test_build_snapshot_etag_differs_across_seq(self):
+        payload = _result([_tpu_node()]).payload
+        one = build_snapshot(payload, 0, 1, 1_700_000_000.0)
+        two = build_snapshot(payload, 0, 2, 1_700_000_060.0)
+        for key in ("summary", "nodes", "slices"):
+            assert one.entities[key].etag != two.entities[key].etag

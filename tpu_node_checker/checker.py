@@ -1755,6 +1755,237 @@ def _wait_for_next_round(stop, seconds: float) -> bool:
     return stop.wait(max(0.0, seconds))
 
 
+def _api_write_decision(node: dict, action: str) -> tuple:
+    """Evidence rules for one fleet-API write → ``(eligible, reason)``.
+
+    Evaluated over the last round's IMMUTABLE snapshot entry — the write
+    path must not race (or lock against) a round in flight.  The rules are
+    the same ones the ``--cordon-failed`` / ``--uncordon-recovered`` sweeps
+    apply: FSM-gated when the round carried hysteresis state, probe-evidence
+    gated otherwise — an authenticated caller can ask, only evidence can
+    approve.  A refusal is a 409, distinct from auth (401/403).
+    """
+    health = node.get("health") if isinstance(node.get("health"), dict) else None
+    state = (health or {}).get("state")
+    probe = node.get("probe") if isinstance(node.get("probe"), dict) else None
+    if action == "cordon":
+        if node.get("cordoned"):
+            return False, "already cordoned"
+        if not node.get("ready"):
+            return False, (
+                "node is NotReady — already the control plane's problem; "
+                "cordon is for kubelet-Ready nodes with dead chips"
+            )
+        if not node.get("schedulable", True):
+            return False, (
+                "no allocatable devices — already unschedulable for "
+                "device-requesting pods"
+            )
+        if probe is None or probe.get("level") == "missing":
+            # Same rule as the sweep: a PATCH needs a REAL probe report
+            # from the last round; absence is not evidence.
+            return False, "no probe evidence in the last round"
+        if health is not None:
+            from tpu_node_checker.history.fsm import CHRONIC, FAILED
+
+            if state not in (FAILED, CHRONIC):
+                return False, (
+                    f"hysteresis state {state} is not cordon-eligible "
+                    "(needs FAILED or CHRONIC)"
+                )
+            return True, f"hysteresis state {state} with probe evidence"
+        if probe.get("ok"):
+            return False, "probe passed in the last round — nothing to quarantine"
+        return True, "probe failed in the last round"
+    # uncordon
+    if not node.get("cordoned"):
+        return False, "not cordoned"
+    if not node.get("quarantined_by_us"):
+        return False, (
+            "cordon is not ours (no quarantine annotation) — human cordons "
+            "are never touched; use kubectl uncordon"
+        )
+    if not node.get("ready"):
+        return False, "kubelet does not report Ready"
+    if probe is None or not probe.get("ok"):
+        return False, "no fresh passing probe verdict vouches for the chips"
+    if health is not None:
+        from tpu_node_checker.history.fsm import CHRONIC, HEALTHY
+
+        if state == CHRONIC:
+            return False, (
+                "CHRONIC flapper: held cordoned — a passing round is the "
+                "setup for its next failure (uncordon out-of-band to override)"
+            )
+        if state != HEALTHY:
+            return False, (
+                f"hysteresis state {state} is not uncordon-eligible "
+                "(needs re-earned HEALTHY)"
+            )
+        return True, "re-earned HEALTHY with passing probe"
+    return True, "Ready with passing probe"
+
+
+def _make_serve_control(args):
+    """The fleet API's write-path seam: decide over the snapshot, PATCH on
+    a PRIVATE client.
+
+    The round's pooled session stays untouched — a write resolves (and
+    closes) its own client, so a control-plane PATCH can never race the
+    check loop's keep-alive pool or ride a round's retry budget.  Writes
+    are rare; one handshake each is the cost of isolation.
+
+    The ``--cordon-max`` budget applies here exactly as in the sweep —
+    total cordoned state, counting the snapshot's already-cordoned nodes
+    PLUS cordons this control path applied since that snapshot (the
+    snapshot is immutable, so an applied PATCH is invisible to it until
+    the next round publishes) — a token holder must not be able to drain
+    the pool one authenticated request at a time.
+    """
+    # Cordons applied via the API since the snapshot they were decided on.
+    round_state = {"seq": None, "applied": 0}
+
+    def control(name: str, action: str, dry_run: bool, node: dict, snap) -> tuple:
+        eligible, reason = _api_write_decision(node, action)
+        if eligible and action == "cordon":
+            if round_state["seq"] != snap.seq:
+                round_state["seq"], round_state["applied"] = snap.seq, 0
+            cap = getattr(args, "cordon_max", 1) or 1
+            already = sum(
+                1 for d in snap.node_docs.values() if d.get("cordoned")
+            ) + round_state["applied"]
+            if already >= cap:
+                eligible = False
+                reason = (
+                    f"--cordon-max budget exhausted ({already} nodes already "
+                    f"cordoned, cap {cap}) — raise --cordon-max deliberately "
+                    "for mass-repair workflows"
+                )
+        body = {"applied": False, "eligible": eligible, "reason": reason,
+                "dry_run": dry_run}
+        if not eligible:
+            return 409, body
+        if dry_run:
+            return 200, {**body, "would_apply": True}
+        from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
+
+        client = KubeClient(
+            resolve_cluster_config(
+                getattr(args, "kubeconfig", None), getattr(args, "context", None)
+            )
+        )
+        try:
+            if action == "cordon":
+                client.cordon_node(name)
+            else:
+                client.uncordon_node(name)
+        finally:
+            client.close()
+        if action == "cordon":
+            round_state["applied"] += 1
+        return 200, {**body, "applied": True}
+
+    return control
+
+
+def serve_store(args) -> int:
+    """``--serve PORT`` without ``--watch``: serve a RECORDED store.
+
+    The standalone half of the fleet API: no check rounds run in this
+    process.  ``/api/v1/nodes*`` and ``/api/v1/summary`` serve the
+    ``--history`` store (each node's latest FSM line + fleet roll-up),
+    ``/api/v1/trend`` the ``--log-jsonl`` trend log — both owned by
+    ANOTHER process (the aggregator Deployment, a cron one-shot) and
+    re-read only when their mtime/size signature moves, never per request.
+    With only ``--log-jsonl``, the summary degrades to the log's last
+    round.  Control-plane writes answer 503: with no live round there is
+    no evidence to gate a PATCH on.  Runs until SIGTERM (exit 143).
+    """
+    import threading
+
+    from tpu_node_checker.server.app import FleetStateServer
+    from tpu_node_checker.server.auth import resolve_serve_token
+    from tpu_node_checker.server.snapshot import (
+        build_store_snapshot,
+        build_trendlog_snapshot,
+    )
+
+    history_path = getattr(args, "history", None)
+    trend_path = getattr(args, "log_jsonl", None)
+    source = history_path or trend_path
+    state = {"sig": object(), "seq": 0}  # sentinel: first stat always differs
+    refresh_lock = threading.Lock()
+    holder: dict = {}
+
+    def refresh() -> None:
+        """Request-time seam: stat the store, rebuild the snapshot only on
+        change.  A stat per request is the whole steady-state cost; the
+        lock serializes concurrent pollers racing one store change, so a
+        rewrite rebuilds (and bumps the served round) exactly once."""
+        from tpu_node_checker.history.store import file_signature
+
+        sig = file_signature(source)
+        if sig == state["sig"]:
+            return
+        with refresh_lock:
+            if sig == state["sig"]:
+                return  # another request rebuilt while we waited
+            if sig is None:
+                state["sig"] = None  # vanished store: keep the last snapshot
+                return
+            # seq commits only AFTER a successful build: a stat-able but
+            # unreadable store (perms flipped mid-incident) must not bump
+            # the served round per poll — that would churn the trend
+            # cache's (seq, signature) key into a re-parse per request.
+            now = round(time.time(), 3)
+            snap = (
+                build_store_snapshot(history_path, state["seq"] + 1, now)
+                if history_path
+                else build_trendlog_snapshot(trend_path, state["seq"] + 1, now)
+            )
+            state["seq"] += 1
+            if snap.node_docs or snap.exit_code is not None:
+                # An empty store is "no completed round yet": /readyz must
+                # stay 503 until a real round has been recorded.
+                holder["server"].publish_snapshot(snap)
+            state["sig"] = sig
+
+    server = FleetStateServer(
+        args.serve,
+        token=resolve_serve_token(getattr(args, "serve_token", None)),
+        control=None,  # no live round → no evidence → writes answer 503
+        trend_path=trend_path,
+        refresh=refresh,
+    )
+    holder["server"] = server
+    try:
+        refresh()
+    except OSError as exc:
+        print(f"Cannot read store {source}: {exc} (serving not-ready)", file=sys.stderr)
+    print(
+        f"Serving fleet state API on port {server.port} over "
+        f"{'history store ' + history_path if history_path else 'trend log ' + trend_path}"
+        " (standalone: no check rounds run here; writes disabled).",
+        file=sys.stderr,
+    )
+    stop = threading.Event()
+    prev_handler = _install_stop_signal(stop)
+    try:
+        # Short wait slices, not one long one: Event.wait's underlying lock
+        # acquire is NOT interruptible by signals in CPython, so a single
+        # 3600 s wait would delay the SIGTERM handler — and the clean exit —
+        # by up to an hour.  An idle wakeup per second costs one timed
+        # acquire; the watch loop never hits this because its waits are
+        # bounded by the (short) check interval.
+        while not _wait_for_next_round(stop, 1.0):
+            pass
+        print("SIGTERM: fleet state API stopped cleanly.", file=sys.stderr)
+        return 128 + 15
+    finally:
+        _restore_stop_signal(prev_handler)
+        server.close()
+
+
 def watch(args) -> int:
     """``--watch SECONDS``: run the check repeatedly (daemon mode).
 
@@ -1798,6 +2029,42 @@ def watch(args) -> int:
     stop = threading.Event()
     prev_handler = _install_stop_signal(stop)
     username = getattr(args, "slack_username", notify.DEFAULT_USERNAME)
+    fleet_server = None
+    if getattr(args, "serve", None) is not None:
+        # The fleet state API rides the watch loop: each completed round
+        # publishes one immutable pre-serialized snapshot, so every poller
+        # GET is a dict lookup + ETag/gzip negotiation — never a re-encode,
+        # never a torn read mid-round (server/snapshot.py).
+        from tpu_node_checker.server.app import FleetStateServer
+        from tpu_node_checker.server.auth import resolve_serve_token
+
+        fleet_server = FleetStateServer(
+            args.serve,
+            token=resolve_serve_token(getattr(args, "serve_token", None)),
+            control=_make_serve_control(args),
+            trend_path=getattr(args, "log_jsonl", None),
+        )
+        print(
+            f"Serving fleet state API on port {fleet_server.port} "
+            "(/api/v1/{summary,nodes,slices,trend}, /healthz, /readyz, "
+            "/metrics).",
+            file=sys.stderr,
+        )
+        if webhook:
+            fleet_server.on_event = lambda kind, detail: notify.server_event(
+                webhook, kind, detail, username=username
+            )
+            notify.server_event(
+                webhook,
+                "server-start",
+                f"fleet state API listening on port {fleet_server.port}"
+                + (
+                    " (write endpoints token-gated)"
+                    if resolve_serve_token(getattr(args, "serve_token", None))
+                    else " (write endpoints disabled: no token)"
+                ),
+                username=username,
+            )
     try:
         while True:
             round_start = time.monotonic()
@@ -1820,6 +2087,10 @@ def watch(args) -> int:
                 if metrics_server is not None:
                     metrics_server.set_breaker(breaker.as_dict())
                     metrics_server.mark_error(EXIT_ERROR)
+                if fleet_server is not None:
+                    # The last snapshot keeps serving (fleet state is
+                    # UNKNOWN, not gone); an OPEN breaker flips /readyz.
+                    fleet_server.mark_error(breaker.as_dict())
                 _append_state_log(args, None, error=str(exc))
                 sick = None  # an error round observed no nodes
                 changed = last_code is None or code != last_code
@@ -1853,6 +2124,11 @@ def watch(args) -> int:
                     metrics_server.set_breaker(breaker.as_dict())
                     metrics_server.update(result)
                 _append_state_log(args, result)
+                if fleet_server is not None:
+                    # AFTER the state log append: /api/v1/trend's cache key
+                    # includes the publication seq, so the new round's line
+                    # must already be on disk when the seq moves.
+                    fleet_server.publish(result, breaker=breaker.as_dict())
                 sick = _round_sick_set(result)
                 # Change fingerprint = exit code + sick-node set: a node
                 # swap inside an unchanged code is still a transition.  The
@@ -1930,6 +2206,8 @@ def watch(args) -> int:
                 return 128 + 15  # conventional SIGTERM exit
     finally:
         _restore_stop_signal(prev_handler)
+        if fleet_server is not None:
+            fleet_server.close()
 
 
 def _round_sick_set(result: CheckResult) -> tuple:
@@ -2016,40 +2294,23 @@ def _cause_class(cause: str) -> str:
     return head if sep else cause[:40]
 
 
-def trend_summary(path: str, json_mode: bool = False) -> int:
-    """``--trend FILE``: summarize a ``--log-jsonl`` trend log.
+def compute_trend_summary(path: str):
+    """The ``--trend`` analysis as data: ``(summary, reason, rounds, skipped)``.
 
-    The post-incident questions the log exists to answer — when did the
-    fleet degrade, for how long, how available was it — computed from the
-    per-round entries: availability (fraction of rounds at exit 0), every
-    state TRANSITION with its timestamp, the longest non-0 stretch, and
-    chip-level availability (mean ready/total chips).  Malformed lines are
-    skipped with a count via the same torn-line-tolerant loader the history
-    store uses (a crash mid-append must not sink the analysis); an
-    unreadable or empty log exits 1 — with a machine-readable summary on
-    stdout in ``--json`` mode, never a traceback.
+    ``summary`` is the machine-readable object ``--trend --json`` prints
+    (``None`` when the log is unreadable or holds no usable rounds, with
+    ``reason`` saying why); ``rounds`` is the sorted ``(ts, code, entry)``
+    list the human renderer formats timestamps from.  Shared by the CLI
+    wrapper (:func:`trend_summary`) and the fleet API's ``/api/v1/trend``
+    snapshot cache, so both surfaces compute one set of numbers.
     """
     from tpu_node_checker.history.store import read_jsonl_tolerant
-
-    def _empty(reason: str) -> int:
-        print(f"trend log {path} {reason}", file=sys.stderr)
-        if json_mode:
-            # Automation reads stdout: an empty / whitespace-only /
-            # unreadable log must still parse (rounds=0 plus the reason),
-            # with exit 1 as the signal — not a bare stderr note.
-            print(
-                json.dumps(
-                    {"rounds": 0, "skipped_lines": skipped, "error": reason},
-                    ensure_ascii=False,
-                )
-            )
-        return 1
 
     skipped = 0
     try:
         entries, skipped = read_jsonl_tolerant(path)
     except OSError as exc:
-        return _empty(f"unreadable: {exc}")
+        return None, f"unreadable: {exc}", [], skipped
     rounds = []
     for e in entries:
         try:
@@ -2064,7 +2325,7 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
             # it — a malformed line must be SKIPPED, never sink the analysis.
             skipped += 1
     if not rounds:
-        return _empty("has no usable rounds")
+        return None, "has no usable rounds", [], skipped
     rounds.sort(key=lambda r: r[0])
     ok_rounds = sum(1 for _, code, _ in rounds if code == EXIT_OK)
     transitions = []
@@ -2198,6 +2459,36 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
         # the current set belongs in the post-incident picture (per-node
         # depth lives in --trend-nodes against the history store).
         summary["chronic_nodes"] = [str(n) for n in last_chronic]
+    return summary, None, rounds, skipped
+
+
+def trend_summary(path: str, json_mode: bool = False) -> int:
+    """``--trend FILE``: summarize a ``--log-jsonl`` trend log.
+
+    The post-incident questions the log exists to answer — when did the
+    fleet degrade, for how long, how available was it — computed from the
+    per-round entries: availability (fraction of rounds at exit 0), every
+    state TRANSITION with its timestamp, the longest non-0 stretch, and
+    chip-level availability (mean ready/total chips).  Malformed lines are
+    skipped with a count via the same torn-line-tolerant loader the history
+    store uses (a crash mid-append must not sink the analysis); an
+    unreadable or empty log exits 1 — with a machine-readable summary on
+    stdout in ``--json`` mode, never a traceback.
+    """
+    summary, reason, rounds, skipped = compute_trend_summary(path)
+    if summary is None:
+        print(f"trend log {path} {reason}", file=sys.stderr)
+        if json_mode:
+            # Automation reads stdout: an empty / whitespace-only /
+            # unreadable log must still parse (rounds=0 plus the reason),
+            # with exit 1 as the signal — not a bare stderr note.
+            print(
+                json.dumps(
+                    {"rounds": 0, "skipped_lines": skipped, "error": reason},
+                    ensure_ascii=False,
+                )
+            )
+        return 1
     if json_mode:
         print(json.dumps(summary, ensure_ascii=False, indent=2))
         return 0
@@ -2241,8 +2532,10 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
             else ""
         )
     )
+    transitions_total = summary["transitions_total"]
+    top_causes = summary["top_causes"]
     print(
-        f"state transitions: {len(transitions)}; "
+        f"state transitions: {transitions_total}; "
         f"longest outage {summary['longest_outage_s']}s; "
         f"current state: exit {summary['last_exit_code']}"
     )
@@ -2252,15 +2545,15 @@ def trend_summary(path: str, json_mode: bool = False) -> int:
             + ", ".join(summary["chronic_nodes"])
         )
     if top_causes:
-        omitted = cause_classes_total - len(top_causes)
+        omitted = summary["cause_classes_total"] - len(top_causes)
         print(
             "top causes: "
             + "; ".join(f"{c['cause']} ×{c['rounds']}" for c in top_causes)
             + (f"; +{omitted} more classes" if omitted else "")
         )
     shown = summary["transitions"]  # one truncation rule for both surfaces
-    if len(transitions) > len(shown):
-        print(f"  … {len(transitions) - len(shown)} earlier transitions omitted")
+    if transitions_total > len(shown):
+        print(f"  … {transitions_total - len(shown)} earlier transitions omitted")
     for t in shown:
         suffix = ""
         if t.get("causes"):

@@ -126,6 +126,24 @@ def build_parser() -> argparse.ArgumentParser:
                    "transitions with their causes, longest outage) and exit "
                    "— post-incident analysis; runs alone")
 
+    serve = p.add_argument_group("Fleet state API (queryable health over HTTP)")
+    serve.add_argument("--serve", type=int, metavar="PORT",
+                       help="serve the fleet state HTTP API on PORT (0 = "
+                       "ephemeral): GET /api/v1/summary, /api/v1/nodes[/NAME], "
+                       "/api/v1/slices, /api/v1/trend, plus /healthz, /readyz "
+                       "and /metrics — every round publishes one immutable "
+                       "pre-serialized snapshot (strong ETag + gzip), so "
+                       "polls never re-encode JSON or race the check loop; "
+                       "with --watch serves live rounds, standalone (with "
+                       "--history and/or --log-jsonl) serves a store another "
+                       "process writes")
+    serve.add_argument("--serve-token", metavar="TOKEN",
+                       help="bearer token (or $TNC_SERVE_TOKEN) gating the "
+                       "API's write endpoints — POST /api/v1/nodes/NAME/"
+                       "cordon|uncordon, evidence/FSM-gated with ?dry_run=1 "
+                       "support, audit-logged; with no token configured every "
+                       "write answers 403 (reads stay open)")
+
     probe = p.add_argument_group("Chip probe (data-plane liveness)")
     probe.add_argument("--probe", action="store_true",
                        help="probe this host's chips via jax.devices() in a sandboxed subprocess")
@@ -311,6 +329,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--retry-budget must be >= 0 (0 disables retries)")
     if args.metrics_port is not None and args.watch is None:
         p.error("--metrics-port requires --watch (one-shot runs serve no scrapes)")
+    if args.serve_token and args.serve is None:
+        p.error("--serve-token requires --serve")
     if args.slack_on_change and args.watch is None:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
@@ -331,6 +351,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.expected_chips
         or args.history
         or args.trend_nodes
+        or args.serve is not None
     ):
         # Same silent-no-op rule as --report-fresh below: a summary-only mode
         # must not absorb check/emit/notify/quarantine flags the operator
@@ -351,6 +372,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.strict_slices
         or args.expected_chips
         or args.history
+        or args.serve is not None
     ):
         # Same rule as --trend: a per-node summary mode must not absorb
         # check/emit/notify/quarantine flags the operator thinks ran.
@@ -421,6 +443,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.probe_topology
         or args.probe_level != "enumerate"
         or args.trace
+        or args.serve is not None
     ):
         # Same silent-no-op rule as --trend/--report-fresh: a drill-only
         # mode must not absorb check/emit/notify flags the operator thinks
@@ -452,6 +475,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             or args.json
             or args.trace
             or args.perf_floor is not None
+            or args.serve is not None
         ):
             # Calibration's stdout IS the TNC_PERF_EXPECT JSON (command
             # substitution is the intended consumer); anything else riding
@@ -484,6 +508,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.uncordon_recovered
         or args.history
         or args.trend_nodes
+        or args.serve is not None
     ):
         # A liveness verdict must stay a liveness verdict: combined check /
         # emit / quarantine flags would silently do nothing (main() returns
@@ -525,8 +550,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             p.error("--node-events cannot be combined with --emit-probe")
     if args.cordon_max is not None and args.cordon_max < 1:
         p.error("--cordon-max must be at least 1")
-    if args.cordon_max is not None and not args.cordon_failed:
-        p.error("--cordon-max requires --cordon-failed")
+    if args.cordon_max is not None and not (args.cordon_failed or args.serve is not None):
+        # --serve counts too: the fleet API's cordon endpoint shares the
+        # same total-cordoned-state budget as the sweep.
+        p.error("--cordon-max requires --cordon-failed or --serve")
     if args.cordon_dry_run and not (args.cordon_failed or args.uncordon_recovered):
         p.error("--cordon-dry-run requires --cordon-failed or --uncordon-recovered")
     if args.cordon_max is None:
@@ -560,6 +587,49 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             p.error("--perf-floor requires --probe or --emit-probe")
         if args.probe_level == "enumerate":
             p.error("--perf-floor requires --probe-level compute (or higher)")
+    if args.serve is not None:
+        if not 0 <= args.serve <= 65535:
+            p.error("--serve PORT must be in 0-65535 (0 = ephemeral)")
+        if args.emit_probe:
+            # The fleet API is the aggregator's surface (fleet snapshots,
+            # cordon control); an emitter pod exposes --metrics-port only.
+            p.error("--serve cannot be combined with --emit-probe")
+        if args.watch is None and not (args.history or args.log_jsonl):
+            # Standalone mode serves a RECORDED store; without one the
+            # server could never answer anything but 503 — the operator
+            # almost certainly wanted --watch.  Checked LAST so the
+            # runs-alone modes above report their own, sharper errors.
+            p.error(
+                "--serve without --watch serves a recorded store: add "
+                "--history FILE and/or --log-jsonl FILE (or run with --watch)"
+            )
+        if args.watch is None:
+            # Standalone serving runs NO check rounds: any flag that only
+            # means something during a round would silently do nothing
+            # while the operator assumes coverage — the same silent-no-op
+            # rule --trend/--report-fresh/--selftest enforce.
+            for flag, on in (
+                ("--probe", args.probe),
+                ("--probe-results", args.probe_results),
+                ("--node-events", args.node_events),
+                ("--cordon-failed", args.cordon_failed),
+                ("--uncordon-recovered", args.uncordon_recovered),
+                ("--strict-slices", args.strict_slices),
+                ("--expected-chips", args.expected_chips),
+                ("--nodes-json", args.nodes_json),
+                ("--label-selector", args.label_selector),
+                ("--resource-key", args.resource_key),
+                ("--multislice-label", args.multislice_label),
+                ("--slack-webhook", args.slack_webhook),
+                ("--slack-only-on-error", args.slack_only_on_error),
+                ("--trace", args.trace),
+            ):
+                if on:
+                    p.error(
+                        f"--serve without --watch runs no check rounds: "
+                        f"{flag} would silently do nothing (add --watch to "
+                        "run rounds alongside the API)"
+                    )
     return args
 
 
@@ -595,6 +665,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         if getattr(args, "watch", None) is not None:
             # Returns only on SIGTERM (143) or via signals/exceptions.
             return checker.watch(args)
+        if getattr(args, "serve", None) is not None:
+            # Standalone fleet API: serve a recorded --history store /
+            # --log-jsonl trend log written by another process; no check
+            # rounds run here.  Returns only on SIGTERM (143).
+            return checker.serve_store(args)
         return checker.one_shot(args)
     except KeyboardInterrupt:
         return 130  # conventional SIGINT exit; watch mode ends this way

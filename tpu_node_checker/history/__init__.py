@@ -1,8 +1,10 @@
 """Per-node health history: durable store + hysteresis state machine.
 
 The layer between probing and remediation (DESIGN.md §9).  Everything in
-this package is reached only through ``--history FILE``; without the flag
-the checker's per-round behavior is untouched.
+this package is reached only through ``--history FILE`` (or the fleet
+API's standalone ``--serve`` mode, which reads a store another process
+writes); without those flags the checker's per-round behavior is
+untouched.
 """
 
 from tpu_node_checker.history.fsm import (  # noqa: F401
@@ -21,5 +23,6 @@ from tpu_node_checker.history.store import (  # noqa: F401
     DEFAULT_MAX_ROUNDS,
     HISTORY_SCHEMA_VERSION,
     HistoryStore,
+    file_signature,
     read_jsonl_tolerant,
 )

@@ -43,6 +43,23 @@ HISTORY_SCHEMA_VERSION = 1
 DEFAULT_MAX_ROUNDS = 64
 
 
+def file_signature(path: str):
+    """``(mtime_ns, size)`` change-detection signature; ``None`` when the
+    file cannot be stat'ed.
+
+    The cache key the fleet API's store/trend snapshots re-read on: a
+    server process that does not own the file (the standalone ``--serve``
+    mode, ``/api/v1/trend`` over a log another process appends) pays one
+    ``stat`` per request and re-parses only when the signature moves —
+    never per poll.
+    """
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
 def read_jsonl_tolerant(path: str) -> Tuple[List[dict], int]:
     """Load a JSONL file, skipping blank and malformed lines.
 

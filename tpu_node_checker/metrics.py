@@ -45,8 +45,14 @@ from __future__ import annotations
 
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import List, Optional, Tuple
+
+from tpu_node_checker.server.router import RoutedHandler, Router, negotiate
+from tpu_node_checker.server.snapshot import Entity
+
+# Prometheus text exposition format, version 0.0.4 — the scrape content type.
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 
 def _escape(value: str) -> str:
@@ -549,38 +555,49 @@ def render_metrics(
 
 
 class MetricsServer:
-    """Background /metrics endpoint fed by ``update(result)``."""
+    """Background /metrics endpoint fed by ``update(result)``.
+
+    Routed through the shared :class:`~tpu_node_checker.server.router.Router`
+    (the same one the ``--serve`` fleet API speaks), so the scrape surface
+    gets the full HTTP contract for free: unknown paths 404, ``HEAD``
+    answers the GET's headers with no body, and the body — static between
+    rounds by construction — carries a strong ETag and a gzip variant, so a
+    scraper sending ``If-None-Match`` pays 304-sized responses for every
+    round it has already seen.
+    """
 
     def __init__(self, port: int, host: str = "0.0.0.0"):
         self._body = b"# tpu-node-checker: no check completed yet\n"
+        self._entity = Entity(self._body, METRICS_CONTENT_TYPE)
         self._lock = threading.Lock()
-        outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            # A stalled client must never block scrapes: threaded server +
-            # per-connection timeout.
-            timeout = 10
+        router = Router()
+        router.add("GET", "/metrics", self._get_metrics)
+        # "/" has served the metrics body since the first MetricsServer;
+        # keep the alias — ad-hoc curl probes depend on it.
+        router.add("GET", "/", self._get_metrics)
 
-            def do_GET(self):
-                if self.path not in ("/metrics", "/"):
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                with outer._lock:
-                    body = outer._body
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+        class Handler(RoutedHandler):
+            pass
 
-            def log_message(self, *args):
-                pass
-
+        Handler.router = router
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
+
+    def _get_metrics(self, req):
+        with self._lock:
+            entity = self._entity
+        return negotiate(entity, req.headers)
+
+    def _set_body(self, body: bytes) -> None:
+        # One pre-serialized entity per round: gzip + ETag computed at
+        # update time, every scrape is a lookup (same contract as the
+        # fleet API's snapshots).
+        with self._lock:
+            self._body = body
+            self._entity = Entity(body, METRICS_CONTENT_TYPE)
 
     @property
     def port(self) -> int:
@@ -593,9 +610,8 @@ class MetricsServer:
 
     def update(self, result) -> None:
         body = render_metrics(result, breaker=getattr(self, "_breaker", None)).encode()
-        with self._lock:
-            self._body = body
-            self._last_result = result
+        self._set_body(body)
+        self._last_result = result
 
     def mark_error(self, exit_code: int = 1) -> None:
         """A check round failed: surface it on the scrape.
@@ -624,8 +640,7 @@ class MetricsServer:
                 for line in text.splitlines()
                 if not line.startswith("tpu_node_checker_last_run_timestamp_seconds ")
             ).encode() + b"\n"
-        with self._lock:
-            self._body = body
+        self._set_body(body)
 
     def close(self) -> None:
         self._server.shutdown()

@@ -133,3 +133,37 @@ def send_slack_message(
             sleep(retry_delay)
     print(f"Slack delivery failed after {attempts} attempts.", file=sys.stderr)
     return False
+
+
+# Fleet-API lifecycle events worth a Slack line.  Anything else passed to
+# server_event still sends (ℹ️) — the map curates icons, not policy.
+_SERVER_EVENT_ICONS = {
+    "server-start": "🛰️",
+    "auth-failure": "🔒",
+}
+
+
+def server_event(
+    webhook_url: Optional[str],
+    event: str,
+    detail: str,
+    username: str = DEFAULT_USERNAME,
+) -> bool:
+    """Best-effort Slack note for fleet state API lifecycle events.
+
+    Two classes today: ``server-start`` (the API came up — operators learn
+    the surface exists and whether writes are token-gated) and
+    ``auth-failure`` (a write was rejected 401/403 — rate-limited by the
+    server so a scanner cannot turn Slack into the amplifier).  Zero
+    retries and never fatal: these fire from (or next to) serving threads,
+    which must not stall on a slow webhook the way a check round may.
+    """
+    if not webhook_url:
+        return False
+    icon = _SERVER_EVENT_ICONS.get(event, "ℹ️")
+    return send_slack_message(
+        webhook_url,
+        f"{icon} *Fleet state API {event}*: {detail}",
+        username=username,
+        max_retries=0,
+    )

@@ -1,0 +1,34 @@
+"""Embedded fleet state API (``--serve``): snapshot-cached HTTP serving.
+
+Layering (no cycles):
+
+* :mod:`~tpu_node_checker.server.router` — routing + ETag/gzip negotiation,
+  shared with the ``--metrics-port`` endpoint;
+* :mod:`~tpu_node_checker.server.snapshot` — immutable pre-serialized
+  round snapshots and the trend cache;
+* :mod:`~tpu_node_checker.server.auth` — deny-by-default bearer gate for
+  the write path;
+* :mod:`~tpu_node_checker.server.app` — the server itself (imported
+  lazily here: it pulls in :mod:`tpu_node_checker.metrics`, which imports
+  this package's router).
+"""
+
+from tpu_node_checker.server.auth import resolve_serve_token  # noqa: F401
+from tpu_node_checker.server.router import Router, negotiate  # noqa: F401
+from tpu_node_checker.server.snapshot import (  # noqa: F401
+    Entity,
+    FleetSnapshot,
+    TrendCache,
+    build_snapshot,
+    build_store_snapshot,
+)
+
+
+def __getattr__(name):
+    # FleetStateServer lazily: app → metrics → server.router must not run
+    # during this package's own import.
+    if name in ("FleetStateServer", "ServerStats"):
+        from tpu_node_checker.server import app
+
+        return getattr(app, name)
+    raise AttributeError(name)

@@ -1,0 +1,472 @@
+"""The fleet state API server: queryable node/slice health over HTTP.
+
+``tpu-node-checker --serve PORT`` — embedded (riding a ``--watch`` loop) or
+standalone (serving a ``--history`` store / ``--log-jsonl`` trend log that
+another process writes).  Read surface:
+
+* ``GET /api/v1/summary``   — fleet roll-up (the CI-gate / dashboard-tile poll);
+* ``GET /api/v1/nodes``     — every node entry, health state included;
+* ``GET /api/v1/nodes/{name}`` — one node: payload + FSM state/streak/flaps;
+* ``GET /api/v1/slices``    — slice (and multislice) readiness;
+* ``GET /api/v1/trend``     — the ``--trend`` summary, cache-invalidated per
+  round (or by file mtime when another process owns the log);
+* ``GET /healthz``          — process liveness (always 200 while serving);
+* ``GET /readyz``           — 200 only once a round has completed AND the
+  watch circuit breaker is not open — "the data is fresh enough to act on";
+* ``GET /metrics``          — the last round's Prometheus families plus this
+  server's own ``tpu_node_checker_api_server_*`` request telemetry.
+
+Write surface (deny-by-default, see :mod:`~tpu_node_checker.server.auth`):
+
+* ``POST /api/v1/nodes/{name}/cordon`` / ``.../uncordon`` — routed through
+  the same evidence rules the ``--cordon-failed`` / ``--uncordon-recovered``
+  sweeps apply (FSM-gated under ``--history``), with ``?dry_run=1`` support;
+  every decision is audit-logged to stderr as one JSON line.
+
+Serving never blocks or races the check loop: every GET reads one
+immutable pre-serialized snapshot reference (see
+:mod:`~tpu_node_checker.server.snapshot`); publication is a single atomic
+attribute swap.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from tpu_node_checker.server.auth import check_write_auth
+from tpu_node_checker.server.router import (
+    Request,
+    Response,
+    RoutedHandler,
+    Router,
+    json_response,
+    negotiate,
+)
+from tpu_node_checker.server.snapshot import (
+    FleetSnapshot,
+    TrendCache,
+    build_snapshot,
+)
+
+# At most one auth-failure notification per this many seconds: a scanner
+# hammering the write path must not turn Slack into the amplifier.
+_AUTH_EVENT_INTERVAL_S = 60.0
+
+
+class ServerStats:
+    """Thread-safe request telemetry → ``tpu_node_checker_api_server_*``.
+
+    Labeled by route PATTERN (``/api/v1/nodes/{name}``), never by raw path,
+    so series cardinality tracks the route table, not the fleet size.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests: Dict[Tuple[str, str, int], int] = {}
+        self.latency: Dict[str, list] = {}  # route -> [sum_ms, count]
+        self.in_flight = 0
+        self.auth_failures = 0
+
+    def track_in_flight(self, delta: int) -> None:
+        with self._lock:
+            self.in_flight += delta
+
+    def observe(self, method: str, route: str, status: int, elapsed_ms: float) -> None:
+        with self._lock:
+            key = (method, route, status)
+            self.requests[key] = self.requests.get(key, 0) + 1
+            bucket = self.latency.setdefault(route, [0.0, 0])
+            bucket[0] += elapsed_ms
+            bucket[1] += 1
+
+    def mark_auth_failure(self) -> None:
+        with self._lock:
+            self.auth_failures += 1
+
+    def prometheus_lines(self) -> list:
+        from tpu_node_checker.metrics import _line  # shared escaping rules
+
+        with self._lock:
+            requests = dict(self.requests)
+            latency = {k: list(v) for k, v in self.latency.items()}
+            in_flight = self.in_flight
+            auth_failures = self.auth_failures
+        lines = [
+            "# HELP tpu_node_checker_api_server_requests_total HTTP requests "
+            "served by the fleet state API, by method/route/status.",
+            "# TYPE tpu_node_checker_api_server_requests_total counter",
+        ]
+        for (method, route, status), n in sorted(requests.items()):
+            lines.append(
+                _line(
+                    "tpu_node_checker_api_server_requests_total",
+                    float(n),
+                    {"method": method, "route": route, "status": str(status)},
+                )
+            )
+        lines += [
+            "# HELP tpu_node_checker_api_server_request_latency_ms Summed "
+            "request latency per route (pair with _count for the mean).",
+            "# TYPE tpu_node_checker_api_server_request_latency_ms summary",
+        ]
+        for route, (total_ms, count) in sorted(latency.items()):
+            lines.append(
+                _line(
+                    "tpu_node_checker_api_server_request_latency_ms_sum",
+                    round(total_ms, 3),
+                    {"route": route},
+                )
+            )
+            lines.append(
+                _line(
+                    "tpu_node_checker_api_server_request_latency_ms_count",
+                    float(count),
+                    {"route": route},
+                )
+            )
+        lines += [
+            "# HELP tpu_node_checker_api_server_in_flight Requests currently "
+            "being served.",
+            "# TYPE tpu_node_checker_api_server_in_flight gauge",
+            _line("tpu_node_checker_api_server_in_flight", float(in_flight)),
+            "# HELP tpu_node_checker_api_server_auth_failures_total Rejected "
+            "write-path requests (missing/invalid bearer token).",
+            "# TYPE tpu_node_checker_api_server_auth_failures_total counter",
+            _line(
+                "tpu_node_checker_api_server_auth_failures_total",
+                float(auth_failures),
+            ),
+        ]
+        return lines
+
+
+class FleetStateServer:
+    """Background fleet API fed by :meth:`publish` once per round.
+
+    ``control(name, action, dry_run, node_doc, snapshot) -> (status,
+    body_dict)`` is the write-path seam the checker wires with its
+    evidence rules (the snapshot rides along for fleet-wide gates like the
+    ``--cordon-max`` budget); when
+    ``None`` (standalone store serving) writes answer 503.  ``refresh()``
+    runs before reads in standalone mode to pick up store files another
+    process rewrites.  ``on_event(kind, detail)`` surfaces lifecycle events
+    (auth failures, rate-limited) to the notify layer.
+    """
+
+    def __init__(
+        self,
+        port: int,
+        host: str = "0.0.0.0",
+        token: Optional[str] = None,
+        control: Optional[Callable] = None,
+        trend_path: Optional[str] = None,
+        refresh: Optional[Callable] = None,
+        on_event: Optional[Callable] = None,
+        pre_serialized: bool = True,
+    ):
+        self._snap: Optional[FleetSnapshot] = None
+        self._seq = 0
+        self._breaker: Optional[dict] = None
+        self._metrics_body = b"# tpu-node-checker: no check completed yet\n"
+        self._token = token
+        self._control = control
+        self._refresh = refresh
+        self.on_event = on_event
+        self._trend = TrendCache(trend_path) if trend_path else None
+        self._stats = ServerStats()
+        self._last_auth_event = 0.0
+        # Bench seam: pre_serialized=False re-encodes the endpoint body on
+        # every request — the pre-snapshot cost model, measured against the
+        # cached path by bench.py's serve case.  Never used in production.
+        self._pre_serialized = pre_serialized
+
+        router = Router()
+        router.add("GET", "/healthz", self._get_healthz)
+        router.add("GET", "/readyz", self._get_readyz)
+        router.add("GET", "/metrics", self._get_metrics)
+        router.add("GET", "/api/v1/summary", self._get_collection("summary"))
+        router.add("GET", "/api/v1/nodes", self._get_collection("nodes"))
+        router.add("GET", "/api/v1/slices", self._get_collection("slices"))
+        router.add("GET", "/api/v1/nodes/{name}", self._get_node)
+        router.add("GET", "/api/v1/trend", self._get_trend)
+        router.add("POST", "/api/v1/nodes/{name}/cordon", self._post_control)
+        router.add("POST", "/api/v1/nodes/{name}/uncordon", self._post_control)
+
+        outer = self
+
+        class Handler(RoutedHandler):
+            pass
+
+        Handler.router = router
+        Handler.observe = lambda self, *a: outer._stats.observe(*a)
+        Handler.track_in_flight = lambda self, d: outer._stats.track_in_flight(d)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def stats(self) -> ServerStats:
+        return self._stats
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- publication (the check loop's side) ---------------------------------
+
+    def publish(self, result, breaker: Optional[dict] = None) -> FleetSnapshot:
+        """One completed round → one immutable snapshot, atomically swapped.
+
+        Called from the watch loop between rounds; request threads keep
+        serving the PREVIOUS snapshot until the assignment lands, so a
+        poller never observes a half-built round.
+        """
+        self._seq += 1
+        snap = build_snapshot(
+            result.payload, result.exit_code, self._seq, round(time.time(), 3)
+        )
+        metrics_body = self._render_fleet_metrics(result, breaker)
+        # Swap order: metrics first, snapshot last — the snapshot's seq is
+        # what readiness and the hammer test key on.
+        self._metrics_body = metrics_body
+        self._breaker = breaker
+        self._snap = snap
+        return snap
+
+    def publish_snapshot(self, snap: FleetSnapshot) -> None:
+        """Standalone mode: install an externally built (store) snapshot."""
+        self._seq = max(self._seq + 1, snap.seq)
+        self._snap = snap
+
+    def mark_error(self, breaker: Optional[dict] = None) -> None:
+        """A check round failed: the last snapshot keeps serving (state is
+        UNKNOWN, not gone), but an OPEN breaker flips ``/readyz`` — stale
+        data must stop gating schedulers once the monitor itself is down."""
+        self._breaker = breaker
+
+    def _render_fleet_metrics(self, result, breaker) -> bytes:
+        from tpu_node_checker.metrics import render_metrics
+
+        return render_metrics(result, breaker=breaker).encode("utf-8")
+
+    # -- readiness -----------------------------------------------------------
+
+    def ready(self) -> Tuple[bool, str]:
+        if self._snap is None:
+            return False, "no completed check round yet"
+        if self._breaker and self._breaker.get("open"):
+            return False, (
+                "watch circuit breaker open "
+                f"({self._breaker.get('consecutive_failures')} consecutive "
+                "failed rounds) — snapshot may be stale"
+            )
+        return True, "ok"
+
+    # -- read handlers --------------------------------------------------------
+
+    def _current(self) -> Optional[FleetSnapshot]:
+        if self._refresh is not None:
+            try:
+                self._refresh()
+            except Exception as exc:  # noqa: BLE001 — refresh is best-effort
+                print(f"fleet API store refresh failed: {exc}", file=sys.stderr)
+        return self._snap
+
+    @staticmethod
+    def _no_round() -> Response:
+        return json_response(
+            503, {"error": "no completed check round yet", "ready": False}
+        )
+
+    def _get_collection(self, key: str):
+        def handler(req: Request) -> Response:
+            snap = self._current()
+            if snap is None:
+                return self._no_round()
+            if not self._pre_serialized and key in snap.docs:
+                # Bench-only cold-encode path: what every GET would cost
+                # WITHOUT the snapshot cache — one full JSON encode per
+                # request, no ETag, no pre-compressed variant.
+                raw = (
+                    json.dumps(snap.docs[key], ensure_ascii=False) + "\n"
+                ).encode("utf-8")
+                return Response(
+                    200, raw,
+                    {"Content-Type": "application/json; charset=utf-8"},
+                )
+            return negotiate(snap.entities[key], req.headers)
+
+        return handler
+
+    def _get_node(self, req: Request) -> Response:
+        snap = self._current()
+        if snap is None:
+            return self._no_round()
+        entity = snap.node_entities.get(req.params["name"])
+        if entity is None:
+            return json_response(
+                404,
+                {
+                    "error": f"node {req.params['name']!r} not in round {snap.seq}",
+                    "round": snap.seq,
+                },
+            )
+        return negotiate(entity, req.headers)
+
+    def _get_trend(self, req: Request) -> Response:
+        if self._trend is None:
+            return json_response(
+                404, {"error": "no trend log configured (--log-jsonl)"}
+            )
+        snap = self._current()
+        return negotiate(
+            self._trend.entity(snap.seq if snap else 0), req.headers
+        )
+
+    def _get_healthz(self, req: Request) -> Response:
+        return json_response(200, {"ok": True})
+
+    def _get_readyz(self, req: Request) -> Response:
+        self._current()  # standalone: readiness reflects the refreshed store
+        ok, reason = self.ready()
+        body = {"ready": ok, "reason": reason}
+        if self._snap is not None:
+            body["round"] = self._snap.seq
+        return json_response(200 if ok else 503, body)
+
+    def _get_metrics(self, req: Request) -> Response:
+        """The round's fleet families + this server's live request stats.
+
+        The stats block moves on every scrape (it counts the scrape
+        itself), so a conditional ETag could never hit — served directly,
+        gzip only when asked, no per-request hashing or compression paid
+        by scrapers that didn't opt in.  The ``--metrics-port`` surface,
+        whose body IS round-static, keeps the full ETag treatment.
+        """
+        import gzip as _gzip
+
+        from tpu_node_checker.metrics import METRICS_CONTENT_TYPE
+
+        body = self._metrics_body + (
+            "\n".join(self._stats.prometheus_lines()) + "\n"
+        ).encode("utf-8")
+        headers = {"Content-Type": METRICS_CONTENT_TYPE, "Vary": "Accept-Encoding"}
+        if "gzip" in (req.headers.get("Accept-Encoding") or "").lower():
+            body = _gzip.compress(body, 6)
+            headers["Content-Encoding"] = "gzip"
+        return Response(200, body, headers)
+
+    # -- write handlers -------------------------------------------------------
+
+    def _post_control(self, req: Request) -> Response:
+        action = "cordon" if req.path.endswith("/cordon") else "uncordon"
+        name = req.params["name"]
+        status, reason = check_write_auth(
+            self._token, req.headers.get("Authorization")
+        )
+        if status is not None:
+            self._stats.mark_auth_failure()
+            self._audit(name, action, status, applied=False, reason=reason,
+                        remote=req.remote)
+            self._auth_event(
+                f"{action} {name!r} from {req.remote} rejected ({status}): {reason}"
+            )
+            resp = json_response(status, {"error": reason})
+            if status == 401:
+                resp.headers["WWW-Authenticate"] = "Bearer"
+            return resp
+        if self._control is None:
+            return json_response(
+                503,
+                {
+                    "error": "control plane unavailable: this server runs over "
+                    "a recorded store, not a live check loop"
+                },
+            )
+        snap = self._current()
+        if snap is None:
+            return self._no_round()
+        node = snap.node_docs.get(name)
+        if node is None:
+            return json_response(
+                404, {"error": f"node {name!r} not in round {snap.seq}"}
+            )
+        dry_run = self._dry_run(req)
+        try:
+            status, body = self._control(name, action, dry_run, node, snap)
+        except Exception as exc:  # noqa: BLE001 — a PATCH failure is a response, not a crash
+            status, body = 502, {"error": f"{action} failed: {exc}"}
+        body.setdefault("node", name)
+        body.setdefault("action", action)
+        body.setdefault("round", snap.seq)
+        self._audit(
+            name, action, status,
+            applied=bool(body.get("applied")), reason=body.get("reason"),
+            remote=req.remote, dry_run=dry_run,
+        )
+        return json_response(status, body)
+
+    @staticmethod
+    def _dry_run(req: Request) -> bool:
+        flag = (req.query.get("dry_run") or "").lower()
+        if flag in ("1", "true", "yes"):
+            return True
+        if req.body:
+            try:
+                return bool(json.loads(req.body).get("dry_run"))
+            except (ValueError, AttributeError):
+                return False
+        return False
+
+    # -- audit + events -------------------------------------------------------
+
+    @staticmethod
+    def _audit(name, action, status, applied, reason, remote, dry_run=False):
+        """One JSON line per write-path decision — grantable or refused —
+        so "who cordoned what, when, and why" is grep-able from pod logs."""
+        entry = {
+            "audit": "fleet-api-write",
+            "ts": round(time.time(), 3),
+            "action": action,
+            "node": name,
+            "status": status,
+            "applied": applied,
+            "dry_run": dry_run,
+            "remote": remote,
+        }
+        if reason:
+            entry["reason"] = reason
+        print(json.dumps(entry, ensure_ascii=False), file=sys.stderr)
+
+    def _auth_event(self, detail: str) -> None:
+        if self.on_event is None:
+            return
+        now = time.monotonic()
+        if now - self._last_auth_event < _AUTH_EVENT_INTERVAL_S:
+            return
+        self._last_auth_event = now
+
+        def _fire():
+            try:
+                self.on_event("auth-failure", detail)
+            except Exception as exc:  # noqa: BLE001 — notification must not break serving
+                print(f"fleet API event hook failed: {exc}", file=sys.stderr)
+
+        # Off the request thread: the hook may POST to Slack (10 s timeout),
+        # and the 401/403 response must not wait on a slow webhook.
+        threading.Thread(target=_fire, daemon=True).start()

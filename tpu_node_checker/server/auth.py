@@ -1,0 +1,50 @@
+"""Bearer-token gate for the fleet API's write endpoints.
+
+Reads are open (the API serves the same facts ``/metrics`` already
+publishes); **writes are deny-by-default**:
+
+* no token configured (neither ``--serve-token`` nor ``$TNC_SERVE_TOKEN``)
+  → every write answers **403**: the control plane is *disabled*, and no
+  header can enable it — a server deployed without a secret must not be
+  one guessed header away from cordoning nodes;
+* token configured but the request's bearer token is missing or wrong →
+  **401** with ``WWW-Authenticate: Bearer`` (the caller may retry with
+  credentials; 403 above is final);
+* match → the request proceeds to the FSM-gated evidence rules, which can
+  still refuse it (409) — auth is *who may ask*, eligibility is *what the
+  evidence supports*.
+
+Comparison is constant-time (``hmac.compare_digest``): the token crosses
+the wire on every write, so the server must not leak its prefix through
+response timing.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+from typing import Optional, Tuple
+
+TOKEN_ENV = "TNC_SERVE_TOKEN"
+
+
+def resolve_serve_token(flag_value: Optional[str]) -> Optional[str]:
+    """Flag beats environment (same precedence as the Slack webhook)."""
+    return flag_value or os.environ.get(TOKEN_ENV) or None
+
+
+def check_write_auth(
+    token: Optional[str], authorization: Optional[str]
+) -> Tuple[Optional[int], str]:
+    """→ ``(None, "")`` when authorized, else ``(http_status, reason)``."""
+    if not token:
+        return 403, (
+            "write endpoints disabled: no --serve-token (or $TNC_SERVE_TOKEN) "
+            "configured on the server"
+        )
+    if not authorization or not authorization.startswith("Bearer "):
+        return 401, "missing bearer token (Authorization: Bearer <token>)"
+    presented = authorization[len("Bearer "):].strip()
+    if not hmac.compare_digest(presented.encode("utf-8"), token.encode("utf-8")):
+        return 401, "invalid bearer token"
+    return None, ""

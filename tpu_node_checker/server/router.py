@@ -1,0 +1,282 @@
+"""Minimal HTTP routing + conditional/encoding negotiation, stdlib-only.
+
+Both embedded HTTP surfaces — the ``--metrics-port`` scrape endpoint and the
+``--serve`` fleet state API — speak through this one router so path and
+method handling cannot drift between them:
+
+* unknown paths answer **404** (the pre-router metrics handler had exactly
+  one route and an ad-hoc path check; a second server would have grown a
+  second ad-hoc check);
+* a known path with the wrong method answers **405** with an ``Allow``
+  header naming what would have worked;
+* **HEAD** is served from the GET handler with the body suppressed — same
+  status, same headers (``Content-Length``/``ETag`` included), zero body
+  bytes — instead of the stdlib default 501;
+* conditional requests (**strong ETag** vs ``If-None-Match`` → 304) and
+  content encoding (``Accept-Encoding: gzip`` → the pre-compressed variant)
+  are one shared code path, :func:`negotiate`, applied to every
+  pre-serialized :class:`~tpu_node_checker.server.snapshot.Entity`.
+
+The router matches on exact segments plus ``{name}``-style captures.
+Captured values are percent-decoded path segments; handlers receive them in
+``Request.params``.  Route PATTERNS (not raw paths) are what request
+metrics label by, so a 5k-node fleet cannot mint 5k label values.
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class Request:
+    """What a handler sees: method, path, captures, query, headers, body."""
+
+    __slots__ = ("method", "path", "params", "query", "headers", "body", "remote")
+
+    def __init__(self, method, path, params, query, headers, body, remote):
+        self.method = method
+        self.path = path
+        self.params = params
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.remote = remote
+
+
+class Response:
+    """status + raw body bytes + extra headers (Content-Length is implied)."""
+
+    __slots__ = ("status", "body", "headers")
+
+    def __init__(self, status: int, body: bytes = b"", headers: Optional[dict] = None):
+        self.status = status
+        self.body = body
+        self.headers = headers or {}
+
+
+def json_response(status: int, obj) -> Response:
+    import json
+
+    return Response(
+        status,
+        (json.dumps(obj, ensure_ascii=False) + "\n").encode("utf-8"),
+        {"Content-Type": "application/json; charset=utf-8"},
+    )
+
+
+def _etag_matches(if_none_match: str, etag: str) -> bool:
+    """RFC 7232 ``If-None-Match`` evaluation against one strong ETag.
+
+    ``*`` matches any current representation; otherwise the header is a
+    comma-separated list of (possibly ``W/``-prefixed) entity tags, compared
+    WEAKLY — the weak comparison is what the RFC specifies for
+    ``If-None-Match``, and our tags are strong, so stripping ``W/`` is safe.
+    """
+    if if_none_match.strip() == "*":
+        return True
+    for candidate in if_none_match.split(","):
+        candidate = candidate.strip()
+        if candidate.startswith("W/"):
+            candidate = candidate[2:]
+        if candidate == etag:
+            return True
+    return False
+
+
+def negotiate(entity, headers, status: int = 200) -> Response:
+    """One pre-serialized entity → the right wire response for this request.
+
+    * ``If-None-Match`` hit → **304** with the ETag and zero body bytes —
+      the cached path every poller after the first round rides;
+    * ``Accept-Encoding: gzip`` → the entity's pre-compressed variant (when
+      one exists and actually saved bytes) with ``Content-Encoding: gzip``;
+    * always: strong ``ETag`` + ``Vary: Accept-Encoding`` + ``Cache-Control:
+      no-cache`` (clients MUST revalidate — the 304 is the cheap path, a
+      stale-for-60s snapshot is not acceptable for scheduler gates).
+    """
+    base = {
+        "ETag": entity.etag,
+        "Vary": "Accept-Encoding",
+        "Cache-Control": "no-cache",
+    }
+    inm = headers.get("If-None-Match")
+    if status == 200 and inm and _etag_matches(inm, entity.etag):
+        return Response(304, b"", base)
+    body = entity.raw
+    out = dict(base)
+    out["Content-Type"] = entity.content_type
+    accept = (headers.get("Accept-Encoding") or "").lower()
+    if entity.gz is not None and "gzip" in accept:
+        body = entity.gz
+        out["Content-Encoding"] = "gzip"
+    return Response(status, body, out)
+
+
+def gunzip(data: bytes) -> bytes:
+    """Test/debug helper: undo :func:`negotiate`'s gzip variant."""
+    return _gzip.decompress(data)
+
+
+class Router:
+    """Ordered route table: ``(method, pattern)`` → handler.
+
+    ``resolve`` returns ``(handler, params, pattern)`` or a ready-made
+    404/405 :class:`Response`.  HEAD resolves through GET routes — the
+    HTTP layer suppresses the body.
+    """
+
+    def __init__(self):
+        # [(method, segments, pattern, handler)]
+        self._routes: List[Tuple[str, Tuple[str, ...], str, Callable]] = []
+
+    def add(self, method: str, pattern: str, handler: Callable) -> None:
+        segments = tuple(s for s in pattern.split("/") if s)
+        self._routes.append((method.upper(), segments, pattern, handler))
+
+    @staticmethod
+    def _match(segments: Tuple[str, ...], path_segs: List[str]) -> Optional[Dict[str, str]]:
+        if len(segments) != len(path_segs):
+            return None
+        params: Dict[str, str] = {}
+        for pat, seg in zip(segments, path_segs):
+            if pat.startswith("{") and pat.endswith("}"):
+                params[pat[1:-1]] = urllib.parse.unquote(seg)
+            elif pat != seg:
+                return None
+        return params
+
+    def resolve(self, method: str, path: str):
+        """→ ``(handler, params, pattern)`` | :class:`Response` (404/405)."""
+        method = method.upper()
+        lookup = "GET" if method == "HEAD" else method
+        path_segs = [s for s in path.split("/") if s]
+        allowed: set = set()
+        for m, segments, pattern, handler in self._routes:
+            params = self._match(segments, path_segs)
+            if params is None:
+                continue
+            if m == lookup:
+                return handler, params, pattern
+            allowed.add(m)
+        if allowed:
+            # The path exists; the verb is wrong.  Name what would work —
+            # GET routes also answer HEAD.
+            if "GET" in allowed:
+                allowed.add("HEAD")
+            resp = json_response(405, {"error": f"method {method} not allowed"})
+            resp.headers["Allow"] = ", ".join(sorted(allowed))
+            return resp
+        return json_response(404, {"error": f"no route for {path}"})
+
+
+class RoutedHandler(BaseHTTPRequestHandler):
+    """``BaseHTTPRequestHandler`` driven by a :class:`Router`.
+
+    Subclasses (closures in practice) set ``router`` and optionally
+    ``observe(method, route_pattern, status, elapsed_ms)`` /
+    ``track_in_flight(delta)`` hooks for request metrics.  HTTP/1.1 with an
+    explicit ``Content-Length`` on every response, so pollers keep their
+    connections alive across rounds instead of re-dialing per poll.
+    """
+
+    router: Router = None  # set by subclass
+    protocol_version = "HTTP/1.1"
+    # A stalled client must never wedge a handler thread forever.
+    timeout = 10
+
+    # -- hooks (no-ops by default) -------------------------------------------
+    def observe(self, method: str, route: str, status: int, elapsed_ms: float) -> None:
+        pass
+
+    def track_in_flight(self, delta: int) -> None:
+        pass
+
+    # -- verb plumbing -------------------------------------------------------
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_HEAD(self):
+        self._dispatch("HEAD")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_PUT(self):
+        self._dispatch("PUT")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    def _read_body(self) -> bytes:
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return b""
+        if length <= 0:
+            return b""
+        # Bound write bodies: control-plane requests are tiny JSON; a
+        # multi-MB body is abuse, not a request.  Truncating leaves the
+        # rest of the body in the socket, which would desync keep-alive
+        # framing — drop the connection after answering instead.
+        cap = 1 << 20
+        if length > cap:
+            self.close_connection = True
+        return self.rfile.read(min(length, cap))
+
+    def _dispatch(self, method: str) -> None:
+        import time as _time
+
+        t0 = _time.monotonic()
+        self.track_in_flight(+1)
+        route_label = "(unmatched)"
+        status = 500
+        try:
+            parsed = urllib.parse.urlsplit(self.path)
+            query = dict(urllib.parse.parse_qsl(parsed.query))
+            resolved = self.router.resolve(method, parsed.path)
+            # Drain the body BEFORE answering, resolved or not: a 404/405
+            # that skips an unread POST body leaves its bytes in the
+            # socket, and the next keep-alive request on the connection
+            # would be parsed starting at the leftovers.
+            body = self._read_body() if method in ("POST", "PUT") else b""
+            if isinstance(resolved, Response):
+                response = resolved
+            else:
+                handler, params, route_label = resolved
+                request = Request(
+                    method, parsed.path, params, query,
+                    self.headers, body, self.client_address[0],
+                )
+                try:
+                    response = handler(request)
+                except Exception as exc:  # noqa: BLE001 — a handler bug must not kill the thread
+                    response = json_response(500, {"error": f"internal error: {exc}"})
+            status = response.status
+            self._send(response, head_only=(method == "HEAD"))
+        except (BrokenPipeError, ConnectionResetError):
+            # The poller hung up mid-response; its problem, not a log line.
+            self.close_connection = True
+        finally:
+            self.track_in_flight(-1)
+            self.observe(
+                method, route_label, status, (_time.monotonic() - t0) * 1e3
+            )
+
+    def _send(self, response: Response, head_only: bool = False) -> None:
+        self.send_response(response.status)
+        headers = dict(response.headers)
+        headers.setdefault("Content-Type", "application/json; charset=utf-8")
+        for key, value in headers.items():
+            self.send_header(key, value)
+        # HEAD carries the GET's Content-Length with no body (RFC 7231
+        # §4.3.2); 304 always has zero body bytes.
+        self.send_header("Content-Length", str(len(response.body)))
+        self.end_headers()
+        if not head_only and response.status != 304 and response.body:
+            self.wfile.write(response.body)
+
+    def log_message(self, *args):  # scrapes and polls must not spam stderr
+        pass

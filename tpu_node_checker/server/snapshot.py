@@ -1,0 +1,317 @@
+"""Immutable, pre-serialized fleet snapshots — the serving side's heart.
+
+The contract the fleet API lives by: **serving never blocks or races the
+check loop**.  Each round builds one :class:`FleetSnapshot` — every endpoint
+body JSON-encoded ONCE, gzip variant and strong ETag computed ONCE — and
+swaps it into the server with a single attribute assignment (atomic under
+the GIL).  A GET then costs a dict lookup plus ``If-None-Match`` /
+``Accept-Encoding`` negotiation: no per-request JSON encoding, and no torn
+reads mid-round, because a request holds a reference to whichever snapshot
+was current when it arrived and that object never mutates.
+
+The ETag is a strong validator over the exact representation bytes
+(sha256-derived), so it is *stable within a round* and *changes across
+rounds* — the property the poller-facing 304 path and the hammer test pin.
+
+:class:`TrendCache` extends the same idea to ``/api/v1/trend``: the
+``--log-jsonl`` summary is recomputed only when a new round lands (the
+publication seq moves) or the file changes under us (mtime/size — a store
+written by another process), never per request.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import json
+import threading
+from typing import Dict, Optional
+
+JSON_CONTENT_TYPE = "application/json; charset=utf-8"
+
+# Level 6 is zlib's sweet spot; below this size the gzip header overhead
+# beats the savings and the raw bytes are served instead.
+_GZIP_LEVEL = 6
+_GZIP_MIN_BYTES = 256
+
+
+class Entity:
+    """One immutable HTTP representation: raw bytes + gzip variant + ETag."""
+
+    __slots__ = ("raw", "gz", "etag", "content_type")
+
+    def __init__(self, raw: bytes, content_type: str = JSON_CONTENT_TYPE):
+        self.raw = raw
+        self.content_type = content_type
+        # mtime=0 pins the gzip header, so identical bodies compress to
+        # identical bytes — representation equality mirrors ETag equality.
+        gz = (
+            gzip.compress(raw, _GZIP_LEVEL, mtime=0)
+            if len(raw) >= _GZIP_MIN_BYTES
+            else None
+        )
+        self.gz = gz if gz is not None and len(gz) < len(raw) else None
+        self.etag = '"' + hashlib.sha256(raw).hexdigest()[:32] + '"'
+
+
+def json_entity(obj) -> Entity:
+    return Entity((json.dumps(obj, ensure_ascii=False) + "\n").encode("utf-8"))
+
+
+class FleetSnapshot:
+    """One round's queryable state, fully serialized at build time.
+
+    ``entities`` holds the collection endpoints (summary / nodes / slices),
+    ``node_entities`` one pre-encoded body per node, and ``node_docs`` the
+    raw per-node dicts the control plane's evidence rules read — all
+    build-once, mutate-never.
+    """
+
+    __slots__ = ("seq", "ts", "exit_code", "source", "entities",
+                 "node_entities", "node_docs", "docs")
+
+    def __init__(self, seq: int, ts: float, exit_code: Optional[int], source: str):
+        self.seq = seq
+        self.ts = ts
+        self.exit_code = exit_code
+        self.source = source
+        self.entities: Dict[str, Entity] = {}
+        self.node_entities: Dict[str, Entity] = {}
+        self.node_docs: Dict[str, dict] = {}
+        # The un-serialized collection docs (references, not copies): what
+        # the bench's cold-encode cost model re-encodes per request.
+        self.docs: Dict[str, dict] = {}
+
+
+def build_snapshot(
+    payload: dict, exit_code: int, seq: int, ts: float
+) -> FleetSnapshot:
+    """A check round's payload → the round's immutable snapshot.
+
+    The summary is a roll-up (what a dashboard tile or CI gate polls); the
+    nodes/slices endpoints carry the payload's own entries verbatim — the
+    API must never re-derive (and drift from) what the round computed.
+    """
+    snap = FleetSnapshot(seq, ts, exit_code, "round")
+    nodes = payload.get("nodes") or []
+    slices = payload.get("slices") or []
+    summary = {
+        "round": seq,
+        "ts": ts,
+        "exit_code": exit_code,
+        "healthy": exit_code == 0,
+        "total_nodes": payload.get("total_nodes"),
+        "ready_nodes": payload.get("ready_nodes"),
+        "total_chips": payload.get("total_chips"),
+        "ready_chips": payload.get("ready_chips"),
+        "slices": {
+            "total": len(slices),
+            "complete": sum(1 for s in slices if s.get("complete")),
+        },
+        "degraded": bool(payload.get("degraded")),
+    }
+    for key in ("probe_summary", "history", "expected_chips",
+                "expected_chips_met", "api_transport"):
+        if payload.get(key) is not None:
+            summary[key] = payload[key]
+    nodes_doc = {"round": seq, "ts": ts, "count": len(nodes), "nodes": nodes}
+    slices_doc = {"round": seq, "ts": ts, "slices": slices}
+    if payload.get("multislices") is not None:
+        slices_doc["multislices"] = payload["multislices"]
+    snap.docs = {"summary": summary, "nodes": nodes_doc, "slices": slices_doc}
+    for key, doc in snap.docs.items():
+        snap.entities[key] = json_entity(doc)
+    for n in nodes:
+        name = n.get("name")
+        if not isinstance(name, str) or not name:
+            continue
+        snap.node_docs[name] = n
+        snap.node_entities[name] = json_entity(
+            {"round": seq, "ts": ts, "node": n}
+        )
+    return snap
+
+
+def build_store_snapshot(path: str, seq: int, ts: float) -> FleetSnapshot:
+    """A ``--history`` store file → a snapshot (standalone serving mode).
+
+    The store is the durable twin of the live round: one line per node per
+    round, each carrying the FSM verdict.  The snapshot serves each node's
+    LATEST line (state/streak/flaps + causes) and a fleet roll-up; slices
+    are not recorded in the store, so ``/api/v1/slices`` answers an empty
+    list with the source named rather than pretending to know.
+
+    Raises ``OSError`` when the file is unreadable; torn/foreign lines are
+    skipped by the shared tolerant loader, exactly like ``--trend-nodes``.
+    """
+    from tpu_node_checker.history.store import (
+        HISTORY_SCHEMA_VERSION,
+        read_jsonl_tolerant,
+    )
+
+    entries, skipped = read_jsonl_tolerant(path)
+    by_node: Dict[str, list] = {}
+    for e in entries:
+        schema = e.get("schema")
+        node = e.get("node")
+        if (schema is not None and schema != HISTORY_SCHEMA_VERSION) or not isinstance(
+            node, str
+        ) or not node:
+            skipped += 1
+            continue
+        by_node.setdefault(node, []).append(e)
+
+    snap = FleetSnapshot(seq, ts, None, "history-store")
+    node_docs = []
+    states: Dict[str, int] = {}
+    last_ts = None
+    for name in sorted(by_node):
+        seq_entries = sorted(
+            by_node[name],
+            key=lambda e: e.get("ts") if isinstance(e.get("ts"), (int, float)) else 0.0,
+        )
+        last = seq_entries[-1]
+        state = last.get("state") if isinstance(last.get("state"), str) else None
+        doc = {
+            "name": name,
+            "ok": last.get("ok") if isinstance(last.get("ok"), bool) else None,
+            "causes": [str(c) for c in (last.get("causes") or [])],
+            "rounds": len(seq_entries),
+            "last_ts": last.get("ts"),
+            "health": {
+                "state": state,
+                "streak": last.get("streak"),
+                "flaps": last.get("flaps"),
+                "flaps_total": last.get("flaps_total"),
+            },
+        }
+        node_docs.append(doc)
+        if state:
+            states[state] = states.get(state, 0) + 1
+        if isinstance(last.get("ts"), (int, float)):
+            last_ts = max(last_ts or 0.0, last["ts"])
+    summary = {
+        "round": seq,
+        "ts": ts,
+        "source": "history-store",
+        "total_nodes": len(node_docs),
+        "states": states,
+        "chronic": [
+            d["name"] for d in node_docs if d["health"]["state"] == "CHRONIC"
+        ],
+        "last_round_ts": last_ts,
+        "skipped_lines": skipped,
+    }
+    snap.entities["summary"] = json_entity(summary)
+    snap.entities["nodes"] = json_entity(
+        {"round": seq, "ts": ts, "count": len(node_docs), "nodes": node_docs,
+         "source": "history-store"}
+    )
+    snap.entities["slices"] = json_entity(
+        {"round": seq, "ts": ts, "slices": [], "source": "history-store",
+         "note": "slice grouping is not recorded in the history store; "
+                 "run the server alongside --watch for live slices"}
+    )
+    for doc in node_docs:
+        snap.node_docs[doc["name"]] = doc
+        snap.node_entities[doc["name"]] = json_entity(
+            {"round": seq, "ts": ts, "node": doc, "source": "history-store"}
+        )
+    return snap
+
+
+def build_trendlog_snapshot(path: str, seq: int, ts: float) -> FleetSnapshot:
+    """A ``--log-jsonl`` trend log → a summary-only snapshot.
+
+    The degraded standalone mode (no ``--history`` store): per-node state
+    was never recorded, so ``/api/v1/nodes`` answers an empty list with the
+    source named, and the summary carries the log's LAST usable round —
+    enough for a CI gate polling ``healthy`` or a dashboard tile, honest
+    about what it cannot know.  Raises ``OSError`` when unreadable.
+    """
+    from tpu_node_checker.history.store import read_jsonl_tolerant
+
+    entries, skipped = read_jsonl_tolerant(path)
+    usable = [
+        e
+        for e in entries
+        if isinstance(e.get("ts"), (int, float))
+        and isinstance(e.get("exit_code"), int)
+        and not isinstance(e.get("exit_code"), bool)
+    ]
+    usable.sort(key=lambda e: e["ts"])
+    snap = FleetSnapshot(
+        seq, ts, usable[-1]["exit_code"] if usable else None, "trend-log"
+    )
+    summary = {
+        "round": seq,
+        "ts": ts,
+        "source": "trend-log",
+        "rounds_recorded": len(usable),
+        "skipped_lines": skipped,
+    }
+    if usable:
+        last = usable[-1]
+        summary["exit_code"] = last["exit_code"]
+        summary["healthy"] = last["exit_code"] == 0
+        summary["last_round_ts"] = last["ts"]
+        for key in ("total_nodes", "ready_nodes", "total_chips", "ready_chips",
+                    "slices", "slices_complete", "degraded", "causes", "chronic"):
+            if last.get(key) is not None:
+                summary[key] = last[key]
+    snap.entities["summary"] = json_entity(summary)
+    note = (
+        "per-node entries are not recorded in the trend log; serve a "
+        "--history store (or run alongside --watch) for node detail"
+    )
+    snap.entities["nodes"] = json_entity(
+        {"round": seq, "ts": ts, "count": 0, "nodes": [],
+         "source": "trend-log", "note": note}
+    )
+    snap.entities["slices"] = json_entity(
+        {"round": seq, "ts": ts, "slices": [], "source": "trend-log",
+         "note": note}
+    )
+    return snap
+
+
+class TrendCache:
+    """``/api/v1/trend`` body cache over a ``--log-jsonl`` trend log.
+
+    Rebuilds only when the cache key moves: the publication seq (a new
+    round landed in THIS process) or the file's mtime/size signature (a
+    store written by another process).  A stat per request is the entire
+    steady-state cost; the JSONL re-read + summary math runs once per
+    change, not once per poll.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._key = None
+        self._entity: Optional[Entity] = None
+        self.rebuilds = 0  # observability + test seam
+
+    def _signature(self, seq: int):
+        from tpu_node_checker.history.store import file_signature
+
+        return (seq, file_signature(self.path))
+
+    def entity(self, seq: int) -> Entity:
+        key = self._signature(seq)
+        with self._lock:
+            if key == self._key and self._entity is not None:
+                return self._entity
+            # Lazy import: checker imports the server package, so the
+            # reverse edge must resolve at call time, not import time.
+            from tpu_node_checker.checker import compute_trend_summary
+
+            summary, reason, _rounds, skipped = compute_trend_summary(self.path)
+            if summary is None:
+                body = {"rounds": 0, "skipped_lines": skipped, "error": reason}
+            else:
+                body = summary
+            self._entity = json_entity(body)
+            self._key = key
+            self.rebuilds += 1
+            return self._entity
