@@ -1,0 +1,29 @@
+"""Seeds for TNC016 (test-wall-clock)."""
+
+import datetime
+import time
+
+
+def pacing_sleep():
+    time.sleep(0.1)  # EXPECT[TNC016]
+
+
+def wall_clock_read():
+    return datetime.datetime.now()  # EXPECT[TNC016]
+
+
+def bounded_poll():
+    time.sleep(0.05)  # tnc: allow-test-wall-clock(seed: bounded poll on a real kernel socket)
+
+
+class FakeClock:  # near-miss: a fake clock DEFINES sleep without sleeping
+    def __init__(self):
+        self.now = 0.0
+
+    def sleep(self, seconds):
+        self.now += seconds
+
+
+def uses_fake(clock):  # near-miss: calling the fake is the approved idiom
+    clock.sleep(30.0)
+    return clock.now

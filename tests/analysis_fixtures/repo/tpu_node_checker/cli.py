@@ -1,0 +1,15 @@
+"""Seeds for TNC203 (drift-readme-flags) and the TNC015 cli.py carve-out."""
+
+import argparse
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--good-flag", action="store_true", help="documented in README")
+    p.add_argument("--undocumented-flag", action="store_true", help="nowhere in README")  # EXPECT[TNC203]
+    return p.parse_args(argv)
+
+
+def usage_error():
+    sys.exit(2)  # near-miss: bare codes are cli.py's privilege

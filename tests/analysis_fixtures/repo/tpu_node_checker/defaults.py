@@ -1,0 +1,21 @@
+"""Seeds for TNC013 (mutable-default)."""
+
+
+def literal_list(items=[]):  # EXPECT[TNC013]
+    return items
+
+
+def constructor_dict(cache=dict()):  # EXPECT[TNC013]
+    return cache
+
+
+def keyword_only_set(*, seen={1}):  # EXPECT[TNC013]
+    return seen
+
+
+def none_sentinel(items=None):  # near-miss: the correct idiom
+    return items or []
+
+
+def immutable_tuple(dims=(2, 2)):  # near-miss: immutable defaults are fine
+    return dims

@@ -1,0 +1,22 @@
+"""Seed for the engine's virtual-file extraction: a ``*_SCRIPT`` string
+constant is production code and gets linted like any module, with findings
+and suppressions landing on THIS file's line numbers."""
+
+CHILD_SCRIPT = r"""
+import json
+
+out = {}
+try:
+    out["ok"] = True
+except Exception:  # EXPECT[TNC010]
+    out["ok"] = False
+try:
+    out["graded"] = 1
+except Exception:  # tnc: allow-broad-except(seed: child reports, never raises)
+    out["graded"] = 0
+print(json.dumps(out))
+"""
+
+NOT_PYTHON_SCRIPT = """
+this is a shell-ish template, $NOT python — the walker must skip it
+"""

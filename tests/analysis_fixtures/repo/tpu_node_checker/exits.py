@@ -1,0 +1,21 @@
+"""Seeds for TNC015 (exit-code): symbolic constants only, outside cli.py."""
+
+import sys
+
+EXIT_ERROR = 1
+
+
+def hard_exit():
+    sys.exit(3)  # EXPECT[TNC015]
+
+
+def raise_exit():
+    raise SystemExit(2)  # EXPECT[TNC015]
+
+
+def symbolic_exit():  # near-miss: the documented contract, by name
+    sys.exit(EXIT_ERROR)
+
+
+def message_exit():  # near-miss: exiting with a message is not a code
+    sys.exit("refusing: bad arguments")

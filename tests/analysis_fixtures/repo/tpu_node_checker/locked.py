@@ -1,0 +1,36 @@
+"""Seeds for TNC101 (unlocked-write)."""
+
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # near-miss: __init__ constructs, no peer threads yet
+        self.label = ""
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def rename(self, label):
+        with self._lock:
+            self.label = label
+
+    def reset_racy(self):
+        self.count = 0  # EXPECT[TNC101]
+
+    def clear_label_racy(self):
+        self.label = ""  # EXPECT[TNC101]
+
+    def sanctioned_reset(self):
+        # tnc: allow-unlocked-write(seed: single-threaded teardown path, peers already joined)
+        self.count = 0
+
+
+class Unguarded:  # near-miss: no lock anywhere → rule stays silent
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1

@@ -1,0 +1,30 @@
+"""Seeds for TNC014 (metric-name) and the TNC202 reverse direction.
+
+Documented families (this docstring is the fixture's metric index):
+
+* ``tpu_node_checker_doc_gauge`` — documented here, emitted below: clean;
+* ``tpu_node_checker_stats_requests_total`` — the hand-built TYPE-line form.
+"""
+
+
+def _line(name, value, labels=None):
+    return f"{name} {value}"
+
+
+def family(name, mtype, help_text, samples):
+    return [name, mtype, help_text, samples]
+
+
+def render():
+    out = []
+    out += family("tpu_node_checker_doc_gauge", "gauge", "documented", [({}, 1.0)])
+    out += family("tpu_node_checker_readme_gauge", "gauge", "in README", [({}, 1.0)])
+    out += family("bad_metric_name", "gauge", "wrong namespace", [({}, 1.0)])  # EXPECT[TNC014]
+    out += family("tpu_node_checker_bad_counter", "counter", "no _total", [({}, 1.0)])  # EXPECT[TNC014]
+    out += family("tpu_node_checker_ghosted_gauge", "gauge", "undocumented", [({}, 1.0)])  # EXPECT[TNC202]
+    out.append(_line("tpu_node_checker_doc_gauge", 1.0))
+    out.append(
+        "# TYPE tpu_node_checker_stats_requests_total counter"  # near-miss: well-formed TYPE line
+    )
+    out.append("# TYPE tpu_node_checker_stats_inflight counter")  # EXPECT[TNC014] EXPECT[TNC202]
+    return out

@@ -1,0 +1,52 @@
+"""Seeds for TNC010 (broad-except) and the suppression meta rules."""
+
+
+def swallow_everything():
+    try:
+        return 1
+    except Exception:  # EXPECT[TNC010]
+        return None
+
+
+def swallow_bare():
+    try:
+        return 1
+    except:  # noqa: E722  # EXPECT[TNC010]
+        return None
+
+
+def rethrows():  # near-miss: broad, but the error still surfaces
+    try:
+        return 1
+    except Exception as exc:
+        raise RuntimeError("wrapped") from exc
+
+
+def narrow():  # near-miss: a specific type is the whole point of the rule
+    try:
+        return 1
+    except ValueError:
+        return None
+
+
+def sanctioned():  # suppressed with a reason: counted, not a finding
+    try:
+        return 1
+    except Exception:  # tnc: allow-broad-except(seed: a probe-style report-never-raise site)
+        return None
+
+
+def no_reason_given():
+    try:
+        return 1
+    # A reasonless waiver is itself a finding AND does not suppress:
+    # both TNC002 (the empty parens) and TNC010 (still unsuppressed) fire.
+    except Exception:  # tnc: allow-broad-except()  # EXPECT[TNC002] EXPECT[TNC010]
+        return None
+
+
+def unknown_rule_named():
+    try:
+        return 1
+    except Exception:  # tnc: allow-everything-forever(why not)  # EXPECT[TNC003] EXPECT[TNC010]
+        return None

@@ -1,0 +1,20 @@
+"""Seeds for TNC102 (snapshot-mutation): build fully, then swap."""
+
+
+class Publisher:
+    def __init__(self):
+        self._snap = None
+
+    def publish_then_mutate(self, payload):
+        snap = {"entities": {}}
+        snap["entities"]["summary"] = payload  # near-miss: still private
+        self._snap = snap
+        snap["entities"]["late"] = payload  # EXPECT[TNC102]
+        snap["entities"].update(extra=1)  # EXPECT[TNC102]
+        return snap
+
+    def publish_clean(self, payload):  # near-miss: all mutation pre-swap
+        snap = {"entities": {"summary": payload}}
+        snap["seq"] = 1
+        self._snap = snap
+        return snap

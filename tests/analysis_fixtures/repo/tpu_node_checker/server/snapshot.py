@@ -1,0 +1,27 @@
+"""Seeds for TNC011 (blocking-read-path): snapshot.py read vs build side."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def current_entity(key):
+    time.sleep(0.01)  # EXPECT[TNC011]
+    with _lock:  # EXPECT[TNC011]
+        return key
+
+
+def lookup(snapshots, key):  # near-miss: a dict lookup is the whole contract
+    return snapshots.get(key)
+
+
+def build_snapshot(path):  # near-miss: builders run off the request path
+    with open(path) as fh:
+        return fh.read()
+
+
+def json_entity(obj):  # near-miss: named builder helper
+    with open("/dev/null", "w") as fh:
+        fh.write(str(obj))
+    return obj

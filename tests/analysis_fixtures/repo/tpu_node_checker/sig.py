@@ -1,0 +1,26 @@
+"""Seeds for TNC012 (signal-handler-blocking)."""
+
+import signal
+import threading
+import time
+
+_STOP = threading.Event()
+
+
+def _blocking_handler(signum, frame):
+    time.sleep(1.0)  # EXPECT[TNC012]
+    with open("/tmp/x", "w") as fh:  # EXPECT[TNC012]
+        fh.write("bye")
+
+
+def _clean_handler(signum, frame):  # near-miss: flag-flip only
+    _STOP.set()
+
+
+def _unregistered_helper():  # near-miss: sleeps, but never a signal handler
+    time.sleep(0.5)
+
+
+def install():
+    signal.signal(signal.SIGTERM, _blocking_handler)
+    signal.signal(signal.SIGINT, _clean_handler)

@@ -1,0 +1,26 @@
+"""Seeds for TNC103 (thread-hygiene)."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def nameless():
+    threading.Thread(target=print, daemon=True).start()  # EXPECT[TNC103]
+
+
+def daemonless():
+    threading.Thread(target=print, name="tnc-seed").start()  # EXPECT[TNC103]
+
+
+def anonymous_pool():
+    with ThreadPoolExecutor(max_workers=2) as pool:  # EXPECT[TNC103]
+        pool.submit(print)
+
+
+def hygienic():  # near-miss: both kwargs present
+    threading.Thread(target=print, name="tnc-seed-clean", daemon=True).start()
+
+
+def hygienic_pool():  # near-miss
+    with ThreadPoolExecutor(max_workers=2, thread_name_prefix="tnc-seed") as pool:
+        pool.submit(print)

@@ -249,7 +249,9 @@ def serve_http(handler_cls, tls_cert=None):
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ctx.load_cert_chain(tls_cert[0], tls_cert[1])
         server.socket = ctx.wrap_socket(server.socket, server_side=True)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
+    threading.Thread(
+        target=server.serve_forever, name="tnc-test-http-fixture", daemon=True
+    ).start()
     return server
 
 

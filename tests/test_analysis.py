@@ -1,0 +1,305 @@
+"""tnc-lint: engine unit tests, the seeded violation corpus, and the
+repo-wide zero-findings gate.
+
+Three layers:
+
+* **engine units** — suppression parsing (same-line and standalone-above),
+  the mandatory-reason and known-rule checks, JSON schema, exit codes;
+* **seeded corpus** — ``tests/analysis_fixtures/repo`` is a miniature
+  checkout where every rule has ``EXPECT[TNCxxx]`` markers on the exact
+  lines it must fire and near-miss true negatives beside them; the test
+  diffs the engine's findings against the markers in both directions;
+* **the repo itself** — the tier-1 gate: zero unsuppressed findings over
+  this checkout, every suppression carrying a reason.  This is the
+  regression test for every invariant the rule table encodes AND for the
+  drift fixed when the engine first ran (README flag-table rows, metric
+  families missing from the metrics.py docstring index).
+"""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from tpu_node_checker.analysis.engine import (
+    JSON_SCHEMA_VERSION,
+    extract_suppressions,
+    run_project,
+)
+from tpu_node_checker.analysis.rules import ALL_RULES, RULE_SLUGS
+from tpu_node_checker.analysis.rules.contracts import normalize_token
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+CORPUS_ROOT = Path(__file__).resolve().parent / "analysis_fixtures" / "repo"
+_MARKER = re.compile(r"EXPECT\[(TNC\d+)\]")
+
+
+class TestSuppressionParsing:
+    def test_same_line_comment_parsed_with_reason(self):
+        sups, meta = extract_suppressions(
+            "x = 1  # tnc: allow-broad-except(probes report, never raise)\n"
+        )
+        assert meta == []
+        (sup,) = sups
+        assert sup.rule == "broad-except"
+        assert sup.reason == "probes report, never raise"
+        assert sup.line == 1
+        assert sup.standalone is False
+
+    def test_standalone_comment_marked_for_next_line(self):
+        sups, _ = extract_suppressions(
+            "# tnc: allow-unlocked-write(teardown path)\nx = 1\n"
+        )
+        (sup,) = sups
+        assert sup.standalone is True and sup.line == 1
+
+    def test_reason_is_mandatory(self):
+        sups, meta = extract_suppressions("x = 1  # tnc: allow-broad-except()\n")
+        assert sups == []  # an unexplained waiver never suppresses
+        (m,) = meta
+        assert m.code == "TNC002"
+        assert "no reason" in m.message
+
+    def test_unknown_rule_is_a_finding(self):
+        sups, meta = extract_suppressions(
+            "x = 1  # tnc: allow-everything(because)\n"
+        )
+        assert sups == []
+        (m,) = meta
+        assert m.code == "TNC003"
+
+    def test_marker_inside_string_literal_is_not_a_suppression(self):
+        # tokenize-based extraction: only real COMMENT tokens count.
+        src = 's = "# tnc: allow-broad-except(not a comment)"\n'
+        sups, meta = extract_suppressions(src)
+        assert sups == [] and meta == []
+
+    def test_every_registered_slug_is_stable_and_unique(self):
+        slugs = [r.slug for r in ALL_RULES]
+        codes = [r.code for r in ALL_RULES]
+        assert len(set(slugs)) == len(slugs)
+        assert len(set(codes)) == len(codes)
+        assert all(re.fullmatch(r"TNC\d{3}", c) for c in codes)
+        assert all(re.fullmatch(r"[a-z0-9-]+", s) for s in slugs)
+
+
+class TestTokenNormalization:
+    def test_label_selector_stripped(self):
+        assert normalize_token('tpu_node_checker_nodes{state="total"}') == [
+            "tpu_node_checker_nodes"
+        ]
+
+    def test_unmatched_brace_truncates(self):
+        assert normalize_token("tpu_node_checker_nodes{state") == [
+            "tpu_node_checker_nodes"
+        ]
+
+    def test_infix_alternation_expands(self):
+        assert normalize_token(
+            "tpu_node_checker_api_{connections_opened,requests}_total"
+        ) == [
+            "tpu_node_checker_api_connections_opened_total",
+            "tpu_node_checker_api_requests_total",
+        ]
+
+    def test_bare_prefix_fragment_dropped(self):
+        assert normalize_token("tpu_node_checker_") == []
+
+    def test_wildcard_survives(self):
+        assert normalize_token("tpu_node_checker_probe_*") == [
+            "tpu_node_checker_probe_*"
+        ]
+
+
+class TestCliContract:
+    def test_json_output_schema_and_exit_codes(self, capsys):
+        from tpu_node_checker.analysis.__main__ import (
+            EXIT_CLEAN,
+            EXIT_FINDINGS,
+            EXIT_USAGE,
+            main,
+        )
+
+        rc = main(["--root", str(CORPUS_ROOT), "--format", "json"])
+        assert rc == EXIT_FINDINGS  # the corpus exists to contain findings
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["schema"] == JSON_SCHEMA_VERSION
+        assert doc["files_scanned"] > 0
+        for entry in doc["findings"] + doc["suppressed"]:
+            assert set(entry) == {"rule", "code", "path", "line", "col",
+                                  "message"}
+            assert entry["rule"] in RULE_SLUGS or entry["code"] in (
+                "TNC001", "TNC002", "TNC003"
+            )
+        # Ordering is stable: sorted by (path, line, col, code).
+        keys = [(f["path"], f["line"], f["col"], f["code"])
+                for f in doc["findings"]]
+        assert keys == sorted(keys)
+
+        assert main(["--rule", "no-such-rule"]) == EXIT_USAGE
+        assert main(["--root", "/nonexistent-dir"]) == EXIT_USAGE
+        assert main(["--list-rules"]) == EXIT_CLEAN
+        listing = capsys.readouterr().out
+        for rule in ALL_RULES:
+            assert rule.code in listing and rule.slug in listing
+
+    def test_single_rule_filter(self):
+        report = run_project(str(CORPUS_ROOT), only_rules=["mutable-default"])
+        codes = {f.code for f in report.findings}
+        # Engine meta rules still run (they are part of the engine, not the
+        # filter), so expect mutable-default plus at most TNC001-003.
+        assert "TNC013" in codes
+        assert codes <= {"TNC013", "TNC001", "TNC002", "TNC003"}
+
+    def test_syntax_error_file_is_a_finding_not_a_crash(self, tmp_path):
+        pkg = tmp_path / "tpu_node_checker"
+        pkg.mkdir()
+        (pkg / "broken.py").write_text("def f(:\n")
+        report = run_project(str(tmp_path))
+        (finding,) = [f for f in report.findings if f.code == "TNC001"]
+        assert finding.path == "tpu_node_checker/broken.py"
+
+    def test_rule_crash_exits_internal_not_findings(self, monkeypatch, capsys):
+        # The CI corpus gate requires EXACTLY exit 1, so a crashed rule must
+        # use a distinct code — a traceback impersonating "findings present"
+        # would let every rule go blind while CI stays green.
+        import tpu_node_checker.analysis.__main__ as main_mod
+
+        def boom(root, only_rules=None):
+            raise AttributeError("rule crashed mid-walk")
+
+        monkeypatch.setattr(main_mod, "run_project", boom)
+        rc = main_mod.main(["--root", str(CORPUS_ROOT)])
+        assert rc == main_mod.EXIT_INTERNAL == 3
+        assert "internal error" in capsys.readouterr().err
+
+    def test_unused_suppression_reported_as_note_not_failure(self, tmp_path):
+        pkg = tmp_path / "tpu_node_checker"
+        pkg.mkdir()
+        (pkg / "stale.py").write_text(
+            "def f():\n"
+            "    return 1  # tnc: allow-broad-except(the except was removed)\n"
+        )
+        report = run_project(str(tmp_path))
+        assert report.findings == []  # informational, never a failure
+        (unused,) = report.unused_suppressions
+        assert unused["path"] == "tpu_node_checker/stale.py"
+        assert unused["rule"] == "broad-except"
+        assert unused["line"] == 2
+        assert "unused_suppressions" in report.to_dict()
+
+    def test_raise_systemexit_reports_exactly_once(self):
+        report = run_project(str(CORPUS_ROOT), only_rules=["exit-code"])
+        # Two seeded sites (sys.exit(3), raise SystemExit(2)) — one finding
+        # each, never a duplicate for the Raise+Call pair.
+        per_line = {}
+        for f in report.findings:
+            if f.code == "TNC015":
+                per_line[f.line] = per_line.get(f.line, 0) + 1
+        assert per_line and all(n == 1 for n in per_line.values()), per_line
+
+
+class TestSeededCorpus:
+    """Every rule fires exactly where the corpus says — and nowhere else."""
+
+    def _expected(self):
+        exp = set()
+        for path in sorted(CORPUS_ROOT.rglob("*")):
+            if not path.is_file() or "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(CORPUS_ROOT).as_posix()
+            for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1
+            ):
+                for m in _MARKER.finditer(line):
+                    exp.add((rel, lineno, m.group(1)))
+        return exp
+
+    def test_findings_match_markers_exactly(self):
+        report = run_project(str(CORPUS_ROOT))
+        # Virtual files (embedded *_SCRIPT constants) report as
+        # "host.py#NAME" at host line numbers: fold back onto the host.
+        got = {(f.path.split("#")[0], f.line, f.code)
+               for f in report.findings}
+        expected = self._expected()
+        assert got - expected == set(), (
+            f"false positives — findings on unmarked lines: "
+            f"{sorted(got - expected)}"
+        )
+        assert expected - got == set(), (
+            f"false negatives — marked lines without their finding: "
+            f"{sorted(expected - got)}"
+        )
+
+    def test_every_rule_id_fires_in_the_corpus(self):
+        report = run_project(str(CORPUS_ROOT))
+        fired = {f.code for f in report.findings}
+        fired |= {f.code for f in report.suppressed}
+        registered = {r.code for r in ALL_RULES}
+        assert registered <= fired, (
+            f"rules with no seeded true positive: {sorted(registered - fired)}"
+        )
+
+    def test_corpus_suppressions_are_counted_not_reported(self):
+        report = run_project(str(CORPUS_ROOT))
+        suppressed = {(f.path.split("#")[0], f.code)
+                      for f in report.suppressed}
+        # One sanctioned seed per suppression-bearing rule family.
+        assert ("tpu_node_checker/sample_broad.py", "TNC010") in suppressed
+        assert ("tpu_node_checker/locked.py", "TNC101") in suppressed
+        assert ("tests/sleepy.py", "TNC016") in suppressed
+        assert ("tpu_node_checker/embedded.py", "TNC010") in suppressed
+
+    def test_embedded_script_findings_land_on_host_lines(self):
+        report = run_project(str(CORPUS_ROOT))
+        virt = [f for f in report.findings if "#" in f.path]
+        (finding,) = virt
+        assert finding.path == "tpu_node_checker/embedded.py#CHILD_SCRIPT"
+        host = (CORPUS_ROOT / "tpu_node_checker" / "embedded.py").read_text()
+        line = host.splitlines()[finding.line - 1]
+        assert "except Exception" in line  # offset maps into the host file
+
+
+class TestRepoIsClean:
+    """The tier-1 gate: this checkout has zero unsuppressed findings."""
+
+    @pytest.fixture()
+    def repo_report(self):
+        if not (REPO_ROOT / "tpu_node_checker" / "analysis").is_dir():
+            pytest.skip("source tree not present (installed-wheel test run)")
+        return run_project(str(REPO_ROOT))
+
+    def test_zero_unsuppressed_findings(self, repo_report):
+        assert repo_report.findings == [], (
+            "tnc-lint found unsuppressed violations:\n"
+            + "\n".join(
+                f"{f.path}:{f.line}: {f.code}[{f.rule}] {f.message}"
+                for f in repo_report.findings
+            )
+        )
+
+    def test_every_suppression_carries_a_reason(self, repo_report):
+        # Structural double-check: reasonless suppressions are TNC002
+        # findings (covered above), so here assert the accepted ones all
+        # carry non-trivial reasons — no "(x)" rubber stamps.
+        from tpu_node_checker.analysis.engine import (
+            extract_suppressions as extract,
+        )
+
+        for path in sorted((REPO_ROOT / "tpu_node_checker").rglob("*.py")):
+            sups, _ = extract(path.read_text())
+            for sup in sups:
+                assert len(sup.reason) >= 10, (
+                    f"{path}:{sup.line}: suppression reason too thin: "
+                    f"{sup.reason!r}"
+                )
+
+    def test_repo_scan_covers_the_package_and_tests(self, repo_report):
+        assert repo_report.files_scanned > 80
+        # The probe child script rides along as a virtual file.
+        # (run_project doesn't expose paths, so re-derive via the loader.)
+        from tpu_node_checker.analysis.engine import load_project
+
+        project = load_project(str(REPO_ROOT))
+        assert "tpu_node_checker/probe/liveness.py#_CHILD_SCRIPT" in project.files

@@ -348,7 +348,10 @@ class TestHammer:
                 conn.close()
 
         threads = [
-            threading.Thread(target=worker, args=(i,), daemon=True)
+            threading.Thread(
+                target=worker, args=(i,), name=f"tnc-test-hammer-{i}",
+                daemon=True,
+            )
             for i in range(self.CLIENTS)
         ]
         for t in threads:
@@ -666,7 +669,7 @@ class TestWriteAuthEndToEnd:
             # exactly ONE event, delivered asynchronously.
             deadline = time.monotonic() + 5
             while not events and time.monotonic() < deadline:
-                time.sleep(0.01)
+                time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 5s poll for a REAL daemon thread to deliver the event; no clock to fake across threads)
             assert [k for k, _ in events] == ["auth-failure"]
         finally:
             srv.close()
@@ -819,13 +822,14 @@ class TestServeStore:
         args = cli.parse_args(["--serve", "0", "--history", str(store)])
         rc = []
         thread = threading.Thread(
-            target=lambda: rc.append(checker.serve_store(args)), daemon=True
+            target=lambda: rc.append(checker.serve_store(args)),
+            name="tnc-test-serve-store", daemon=True,
         )
         thread.start()
         try:
             deadline = time.monotonic() + 10
             while "srv" not in captured and time.monotonic() < deadline:
-                time.sleep(0.01)
+                time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL serve_store thread to publish its server; no injectable clock across threads)
             srv = captured["srv"]
             assert _req(srv.port, "GET", "/readyz")[0] == 200
             _, _, body = _req(srv.port, "GET", "/api/v1/nodes")
@@ -868,13 +872,14 @@ class TestServeStore:
         )
         args = cli.parse_args(["--serve", "0", "--log-jsonl", str(log)])
         thread = threading.Thread(
-            target=lambda: checker.serve_store(args), daemon=True
+            target=lambda: checker.serve_store(args),
+            name="tnc-test-serve-store", daemon=True,
         )
         thread.start()
         try:
             deadline = time.monotonic() + 10
             while "srv" not in captured and time.monotonic() < deadline:
-                time.sleep(0.01)
+                time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL serve_store thread to publish its server; no injectable clock across threads)
             srv = captured["srv"]
             _, _, body = _req(srv.port, "GET", "/api/v1/summary")
             summary = json.loads(body)
@@ -901,13 +906,14 @@ class TestServeStore:
         )
         args = cli.parse_args(["--serve", "0", "--history", str(store)])
         thread = threading.Thread(
-            target=lambda: checker.serve_store(args), daemon=True
+            target=lambda: checker.serve_store(args),
+            name="tnc-test-serve-store", daemon=True,
         )
         thread.start()
         try:
             deadline = time.monotonic() + 10
             while "srv" not in captured and time.monotonic() < deadline:
-                time.sleep(0.01)
+                time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL serve_store thread to publish its server; no injectable clock across threads)
             srv = captured["srv"]
             assert _req(srv.port, "GET", "/readyz")[0] == 503
             assert _req(srv.port, "GET", "/api/v1/nodes")[0] == 503

@@ -155,7 +155,7 @@ def _silent_close_handler(state):
             if state.respond_max is not None and state.responses >= state.respond_max:
                 self.close_connection = True  # slam shut, no response
                 return
-            time.sleep(state.delay_s)
+            time.sleep(state.delay_s)  # tnc: allow-test-wall-clock(a REAL http.server fixture: the delay forces request overlap on real sockets, which no fake clock can schedule)
             state.responses += 1
             body = b'{"items": []}'
             self.send_response(200)
@@ -188,7 +188,7 @@ def _wait_pool_dead(s, retries=50):
             conns = [c for idle in s._pool.values() for c in idle]
         if conns and all(cluster._StdlibSession._sock_is_dead(c) for c in conns):
             return
-        time.sleep(0.01)
+        time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded poll for the kernel to deliver FIN on a real closed socket; no clock to fake in the TCP stack)
 
 
 class TestStaleSocketRecovery:
@@ -382,7 +382,7 @@ class TestBoundedMap:
         def work(i):
             if i == 2:
                 raise ValueError("boom-2")
-            time.sleep(0.01 * (5 - i))  # later items finish FIRST
+            time.sleep(0.01 * (5 - i))  # later items finish FIRST  # tnc: allow-test-wall-clock(real ThreadPoolExecutor scheduling under test: staggered completion order needs real elapsed time)
             return i * 10
 
         out = bounded_map(work, range(5), max_workers=5)
@@ -406,7 +406,7 @@ class _SlowEventsClient:
         self._lock = threading.Lock()
 
     def list_node_events(self, name, timeout=None, limit=100):
-        time.sleep(self.delay_s)
+        time.sleep(self.delay_s)  # tnc: allow-test-wall-clock(forces overlap across real fan-out worker threads — the parallelism speedup assertion needs real elapsed time)
         with self._lock:
             self.calls.append(name)
         return [{"type": "Warning", "reason": f"R-{name}", "message": "m",
@@ -594,7 +594,7 @@ class TestCordonFanOut:
 
         class FakeClient:
             def cordon_node(self, name, timeout=None):
-                time.sleep(delay)
+                time.sleep(delay)  # tnc: allow-test-wall-clock(forces overlap across real fan-out worker threads — the parallelism speedup assertion needs real elapsed time)
                 with lock:
                     patched.append(name)
 

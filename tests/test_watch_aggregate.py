@@ -1017,7 +1017,7 @@ class TestDaemonSetLoopEndToEnd:
         while time.monotonic() < deadline:
             if report.exists() and report.stat().st_size > 0:
                 return
-            time.sleep(0.1)
+            time.sleep(0.1)  # tnc: allow-test-wall-clock(bounded poll for a REAL emitter subprocess to write its report file; its clock is not injectable from here)
         raise AssertionError(f"emitter never wrote {report}")
 
     def _aggregate(self, tmp_path, shared, kubeconfig, capsys, max_age):
@@ -1071,6 +1071,7 @@ class TestDaemonSetLoopEndToEnd:
         # Phase 2 — emitter dead: the report stops refreshing, written_at
         # ages past max-age, and required coverage flips the host to
         # MISSING.  Exit 3, but no cordon: absence is not evidence.
+        # tnc: allow-test-wall-clock(written_at staleness is graded against the REAL wall clock in a separate aggregator process — the report must genuinely age past max-age)
         time.sleep(1.2)
         code, payload = self._aggregate(
             tmp_path, shared, fake_api["kubeconfig"], capsys, max_age="1.0"
@@ -1094,7 +1095,7 @@ class TestDaemonSetLoopEndToEnd:
                 self._wait_for_report(report)
                 if json.loads(report.read_text()).get("ok") is False:
                     break
-                time.sleep(0.1)
+                time.sleep(0.1)  # tnc: allow-test-wall-clock(bounded poll for a REAL emitter subprocess to observe its dead jax platform; its clock is not injectable from here)
             assert json.loads(report.read_text())["ok"] is False
             code, payload = self._aggregate(
                 tmp_path, shared, fake_api["kubeconfig"], capsys, max_age="300"

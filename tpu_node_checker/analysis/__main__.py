@@ -1,0 +1,94 @@
+"""``python -m tpu_node_checker.analysis`` — the tnc-lint CLI.
+
+Exit codes: 0 clean (suppressed findings don't count), 1 unsuppressed
+findings, 2 usage error (bad flag, root is not a checkout), 3 internal
+error (a rule crashed — traceback on stderr).  The codes are symbolic
+below for the same reason the checker's are: CI and scripts branch on
+them; in particular the CI corpus gate requires *exactly* 1, so a rule
+crashing mid-walk can never impersonate "findings present".
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+from typing import List, Optional
+
+from tpu_node_checker.analysis.engine import (
+    NotAProjectRoot,
+    render_human,
+    render_json,
+    run_project,
+)
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_USAGE = 2
+EXIT_INTERNAL = 3
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_node_checker.analysis",
+        description="Project-native static analysis: invariant lints, a "
+        "lock-discipline race checker, and contract-drift detection.",
+    )
+    parser.add_argument(
+        "--root", default=".",
+        help="repository checkout to analyze (default: current directory)",
+    )
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human",
+        help="output format (json: stable schema for CI artifacts)",
+    )
+    parser.add_argument(
+        "--rule", action="append", metavar="SLUG",
+        help="run only this rule (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table (code, slug, invariant) and exit 0",
+    )
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as exc:
+        # argparse exits 2 on usage errors and 0 on --help: preserve both,
+        # but through OUR symbolic contract.
+        return EXIT_USAGE if exc.code else EXIT_CLEAN
+
+    if args.list_rules:
+        from tpu_node_checker.analysis.rules import ALL_RULES
+
+        for rule in ALL_RULES:
+            print(f"{rule.code}  {rule.slug:24s} {rule.doc}")
+        return EXIT_CLEAN
+
+    if args.rule:
+        from tpu_node_checker.analysis.rules import RULE_SLUGS
+
+        unknown = sorted(set(args.rule) - RULE_SLUGS)
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(see --list-rules)", file=sys.stderr,
+            )
+            return EXIT_USAGE
+
+    try:
+        report = run_project(os.path.abspath(args.root), only_rules=args.rule)
+    except NotAProjectRoot as exc:
+        print(f"tnc-lint: {exc}", file=sys.stderr)
+        return EXIT_USAGE
+    except Exception:  # tnc: allow-broad-except(a crashed rule must exit 3, distinct from exit 1, or CI's corpus gate would read the traceback's exit as findings-present)
+        traceback.print_exc()
+        print("tnc-lint: internal error — a rule crashed; this is a linter "
+              "bug, not a finding", file=sys.stderr)
+        return EXIT_INTERNAL
+    print(render_json(report) if args.format == "json" else render_human(report))
+    return EXIT_FINDINGS if report.findings else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())

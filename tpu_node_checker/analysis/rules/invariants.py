@@ -1,0 +1,336 @@
+"""Invariant lints: rules that pin prose invariants from DESIGN.md/CHANGES.md.
+
+Each rule's ``doc`` states the invariant; the rationale back-pointers live in
+the DESIGN.md §11 table.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tpu_node_checker.analysis.engine import FileContext, Finding
+from tpu_node_checker.analysis.rules.base import (
+    Rule,
+    call_name,
+    const_str,
+    dotted_name,
+    fstring_head,
+    fstring_tail,
+    iter_type_lines,
+    walk_skipping_nested_functions,
+)
+
+# Call names that block: sleeps, file/socket I/O, subprocesses.  A heuristic
+# allowlist by design — the point is to catch the obvious regressions a
+# refactor introduces, not to prove non-blocking-ness.
+BLOCKING_CALLS = {
+    "time.sleep",
+    "open",
+    "io.open",
+    "os.open", "os.read", "os.write", "os.fsync",
+    "socket.socket", "socket.create_connection",
+    "subprocess.run", "subprocess.Popen", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.call",
+    "urllib.request.urlopen", "urlopen",
+}
+
+METRIC_PREFIX = "tpu_node_checker_"
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    def broad_name(node) -> bool:
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        return name in ("Exception", "BaseException")
+
+    if handler.type is None:
+        return True
+    if broad_name(handler.type):
+        return True
+    if isinstance(handler.type, ast.Tuple):
+        return any(broad_name(elt) for elt in handler.type.elts)
+    return False
+
+
+class BroadExcept(Rule):
+    slug = "broad-except"
+    code = "TNC010"
+    doc = ("``except Exception``/bare ``except`` must re-raise or carry an "
+           "allow-comment naming why swallowing everything is the contract "
+           "at that site")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package():
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
+                continue
+            if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+                continue  # re-raises (even conditionally) — error still surfaces
+            yield self.finding(
+                ctx.path, node,
+                "broad except without re-raise: narrow the exception type, "
+                "or state the contract with "
+                "'# tnc: allow-broad-except(reason)'",
+            )
+
+
+class BlockingReadPath(Rule):
+    slug = "blocking-read-path"
+    code = "TNC011"
+    doc = ("the fleet API snapshot read path (server GET handlers, "
+           "``negotiate``, everything in snapshot.py that is not a builder) "
+           "takes no locks and does no blocking I/O")
+
+    # Builder-side functions in snapshot.py: run once per round, off the
+    # request path, so blocking work is their job.
+    _SNAPSHOT_BUILDERS = ("build_", "json_entity", "__init__")
+
+    def _read_path_functions(self, ctx: FileContext):
+        if ctx.path == "tpu_node_checker/server/snapshot.py":
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.FunctionDef) and not any(
+                    node.name.startswith(p) or node.name == p
+                    for p in self._SNAPSHOT_BUILDERS
+                ):
+                    yield node
+        elif ctx.path == "tpu_node_checker/server/app.py":
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.FunctionDef) and (
+                    node.name.startswith("_get")
+                    or node.name in ("_current", "handler", "ready", "_no_round")
+                ):
+                    yield node
+        elif ctx.path == "tpu_node_checker/server/router.py":
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.FunctionDef) and node.name == "negotiate":
+                    yield node
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for func in self._read_path_functions(ctx):
+            for node in walk_skipping_nested_functions(func):
+                finding = _blocking_in(self, ctx, node, f"read path {func.name!r}")
+                if finding is not None:
+                    yield finding
+
+
+def _blocking_in(rule: Rule, ctx: FileContext, node: ast.AST,
+                 where: str) -> Optional[Finding]:
+    """One node's verdict under the shared blocking/locking ban."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in BLOCKING_CALLS:
+            return rule.finding(
+                ctx.path, node,
+                f"blocking call {name}() on {where}",
+            )
+        if name is not None and name.endswith(".acquire"):
+            return rule.finding(
+                ctx.path, node, f"lock acquire on {where}"
+            )
+    if isinstance(node, ast.withitem):
+        if isinstance(node.context_expr, ast.Call):
+            target = call_name(node.context_expr)
+        else:
+            target = dotted_name(node.context_expr)
+        if target is not None and "lock" in target.lower():
+            return rule.finding(
+                ctx.path, node.context_expr,
+                f"'with {target}' takes a lock on {where}",
+            )
+    return None
+
+
+class SignalHandlerBlocking(Rule):
+    slug = "signal-handler-blocking"
+    code = "TNC012"
+    doc = ("functions registered via ``signal.signal`` only flip flags/events "
+           "— no sleeps, no I/O, no locks (they preempt arbitrary frames)")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package():
+            return
+        handler_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and call_name(node) == "signal.signal"
+                    and len(node.args) == 2
+                    and isinstance(node.args[1], ast.Name)):
+                handler_names.add(node.args[1].id)
+        if not handler_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) and node.name in handler_names:
+                for inner in walk_skipping_nested_functions(node):
+                    finding = _blocking_in(
+                        self, ctx, inner, f"signal handler {node.name!r}"
+                    )
+                    if finding is not None:
+                        yield finding
+
+
+class MutableDefault(Rule):
+    slug = "mutable-default"
+    code = "TNC013"
+    doc = "no mutable default arguments (list/dict/set literals or constructors)"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package():
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]
+            for default in defaults:
+                bad = isinstance(default, (ast.List, ast.Dict, ast.Set))
+                if isinstance(default, ast.Call):
+                    bad = call_name(default) in ("list", "dict", "set")
+                if bad:
+                    yield self.finding(
+                        ctx.path, default,
+                        f"mutable default argument in {node.name}() — "
+                        "shared across calls; use None and create inside",
+                    )
+
+
+class MetricName(Rule):
+    slug = "metric-name"
+    code = "TNC014"
+    doc = (f"every emitted metric family starts ``{METRIC_PREFIX}`` and "
+           "counter families end ``_total``")
+
+    def _family_name(self, arg: ast.AST):
+        """(display_name, startswith_ok, tail) for a literal or f-string."""
+        lit = const_str(arg)
+        if lit is not None:
+            return lit, lit.startswith(METRIC_PREFIX), lit
+        head = fstring_head(arg)
+        if head is not None:
+            tail = fstring_tail(arg) or ""
+            return head + "{…}", head.startswith(METRIC_PREFIX), tail
+        return None, True, None
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package():
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in ("family", "_line") and node.args:
+                    display, ok, tail = self._family_name(node.args[0])
+                    if display is None:
+                        continue
+                    if not ok:
+                        yield self.finding(
+                            ctx.path, node.args[0],
+                            f"metric {display!r} does not start with "
+                            f"'{METRIC_PREFIX}' — one namespace, grep-able "
+                            "fleet-wide",
+                        )
+                    if (name == "family" and len(node.args) >= 2
+                            and const_str(node.args[1]) == "counter"
+                            and tail is not None
+                            and not tail.endswith("_total")):
+                        yield self.finding(
+                            ctx.path, node.args[0],
+                            f"counter family {display!r} does not end "
+                            "'_total' (Prometheus naming contract)",
+                        )
+            # Hand-built exposition blocks ("# TYPE name counter" literals,
+            # e.g. the server stats block) follow the same contract.
+            lit = const_str(node) if isinstance(node, ast.Constant) else None
+            if lit:
+                for mname, mtype in iter_type_lines(lit):
+                    if not mname.startswith(METRIC_PREFIX):
+                        yield self.finding(
+                            ctx.path, node,
+                            f"metric {mname!r} in TYPE line does not "
+                            f"start with '{METRIC_PREFIX}'",
+                        )
+                    if mtype == "counter" and not mname.endswith("_total"):
+                        yield self.finding(
+                            ctx.path, node,
+                            f"counter {mname!r} in TYPE line does not "
+                            "end '_total'",
+                        )
+
+
+class ExitCode(Rule):
+    slug = "exit-code"
+    code = "TNC015"
+    doc = ("``sys.exit``/``SystemExit`` with a bare integer is cli.py's "
+           "privilege — everywhere else uses the symbolic EXIT_* constants "
+           "(the exit-code contract is documented API)")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package() or ctx.path == "tpu_node_checker/cli.py":
+            return
+        for node in ast.walk(ctx.tree):
+            arg = None
+            # SystemExit is matched only on the Raise node, never the bare
+            # Call — otherwise `raise SystemExit(n)` reports twice (the walk
+            # visits both the Raise and the Call inside it).
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in ("sys.exit", "exit", "os._exit") and node.args:
+                    arg = node.args[0]
+            elif isinstance(node, ast.Raise) and isinstance(
+                    node.exc, ast.Call) and call_name(node.exc) == "SystemExit":
+                arg = node.exc.args[0] if node.exc.args else None
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, int)
+                    and not isinstance(arg.value, bool)):
+                yield self.finding(
+                    ctx.path, node,
+                    f"non-symbolic exit code {arg.value} outside cli.py — "
+                    "use the EXIT_* constants so the documented contract "
+                    "has one source of truth",
+                )
+
+
+class TestWallClock(Rule):
+    slug = "test-wall-clock"
+    code = "TNC016"
+    doc = ("tests never really sleep or read the wall clock for pacing — "
+           "inject a fake clock (see tests/test_retry.py); a bounded "
+           "thread-join poll needs an allow-comment")
+
+    _BANNED = {
+        "time.sleep": "real sleep",
+        "datetime.now": "wall-clock read",
+        "datetime.utcnow": "wall-clock read",
+        "datetime.datetime.now": "wall-clock read",
+        "datetime.datetime.utcnow": "wall-clock read",
+    }
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_tests():
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                kind = self._BANNED.get(name or "")
+                if kind:
+                    yield self.finding(
+                        ctx.path, node,
+                        f"{kind} {name}() in tests — fake the clock, or "
+                        "justify a bounded wait with "
+                        "'# tnc: allow-test-wall-clock(reason)'",
+                    )
+
+
+RULES: List[Rule] = [
+    BroadExcept(),
+    BlockingReadPath(),
+    SignalHandlerBlocking(),
+    MutableDefault(),
+    MetricName(),
+    ExitCode(),
+    TestWallClock(),
+]

@@ -485,7 +485,7 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> List[str]:
     sick.sort(key=lambda n: n.sickness_planned)
     try:
         client = _resolve_client(args, client)
-    except Exception as exc:  # noqa: BLE001 — triage extra, never fatal
+    except Exception as exc:  # tnc: allow-broad-except(triage extra, never fatal)
         print(f"Cannot fetch node events: {exc}", file=sys.stderr)
         errors.append(f"no cluster client: {exc}")
         return errors
@@ -756,7 +756,7 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None, fsm=None
         return report_entry
     try:
         client = _resolve_client(args, client)
-    except Exception as exc:  # noqa: BLE001 — best-effort, like cordoning
+    except Exception as exc:  # tnc: allow-broad-except(best-effort, like cordoning)
         report_entry["failed"] = [
             {"node": n.name, "error": f"no cluster client: {exc}"} for n in candidates
         ]
@@ -881,7 +881,7 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> 
         return report_entry
     try:
         client = _resolve_client(args, client)
-    except Exception as exc:  # noqa: BLE001 — quarantine is best-effort
+    except Exception as exc:  # tnc: allow-broad-except(quarantine is best-effort)
         report_entry["failed"] = [
             {"node": n.name, "error": f"no cluster client: {exc}"} for n in to_cordon
         ]
@@ -1232,7 +1232,7 @@ def selftest(args) -> int:
         d = r.to_dict()
         try:
             behaved, detail = check(r, d)
-        except Exception as exc:  # noqa: BLE001 — a broken check is a failure
+        except Exception as exc:  # tnc: allow-broad-except(a broken check is a failure)
             behaved, detail = False, f"verification crashed: {exc}"
         results.append(
             {
@@ -1627,7 +1627,7 @@ def _emit_probe_rounds(args, interval, server, stop) -> int:
         round_start = time.monotonic()
         try:
             rc, doc = _emit_probe_once(args)
-        except Exception as exc:  # noqa: BLE001 — emitter must survive a round
+        except Exception as exc:  # tnc: allow-broad-except(emitter must survive a round)
             print(f"Probe emission failed: {exc}", file=sys.stderr)
             entry = {
                 "ts": round(time.time(), 3),
@@ -2076,7 +2076,7 @@ def watch(args) -> int:
                 result = run_check(args)
             except KeyboardInterrupt:
                 raise
-            except Exception as exc:  # noqa: BLE001 — a bad round must not kill the daemon
+            except Exception as exc:  # tnc: allow-broad-except(a bad round must not kill the daemon)
                 code = EXIT_ERROR
                 print(f"Check round failed: {exc}", file=sys.stderr)
                 # The cached keep-alive client just failed a round: drop it so
@@ -2168,7 +2168,7 @@ def watch(args) -> int:
                         )
                 try:
                     render_and_notify(args, result, notify_enabled=(not on_change) or changed)
-                except Exception as exc:  # noqa: BLE001 — e.g. stdout pipe gone
+                except Exception as exc:  # tnc: allow-broad-except(e.g. stdout pipe gone)
                     print(f"Render/notify failed (check itself OK): {exc}", file=sys.stderr)
             if last_code is not None and code != last_code:
                 print(f"State change: exit {last_code} → {code}", file=sys.stderr)

@@ -673,7 +673,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return checker.one_shot(args)
     except KeyboardInterrupt:
         return 130  # conventional SIGINT exit; watch mode ends this way
-    except Exception as exc:  # noqa: BLE001 — the reference's catch-all (:319-327)
+    except Exception as exc:  # tnc: allow-broad-except(the reference's catch-all :319-327)
         if args.json:
             from tpu_node_checker.report import error_payload
 

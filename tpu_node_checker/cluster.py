@@ -292,7 +292,7 @@ class _StdlibSession:
             t0 = policy.monotonic()
             try:
                 resp = self._attempt(method, key, path, body, hdrs, timeout, url)
-            except Exception as exc:  # noqa: BLE001 — classifier decides
+            except Exception as exc:  # tnc: allow-broad-except(classifier decides)
                 reason = retry_mod.classify_retriable(exc)
                 if reason is not None and method != "GET" and not getattr(
                     exc, "request_never_sent", False
@@ -365,7 +365,7 @@ class _StdlibSession:
             if conn.sock is None:
                 try:
                     conn.connect()
-                except Exception as exc:  # noqa: BLE001 — tag, then surface
+                except Exception as exc:  # tnc: allow-broad-except(tag, then surface)
                     conn.close()
                     # Bytes provably never left this socket: safe to retry
                     # even for non-idempotent methods.
@@ -692,7 +692,7 @@ class KubeClient:
                         return items, None
                     page_params = dict(page_params, **{"continue": cont})
                 return items, page_params.get("continue")
-            except Exception as exc:  # noqa: BLE001 — re-raised unless 410
+            except Exception as exc:  # tnc: allow-broad-except(re-raised unless 410)
                 status = getattr(exc, "status_code", None)
                 if status is None:
                     status = getattr(

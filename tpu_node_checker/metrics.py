@@ -38,7 +38,27 @@ Exported metric families:
   generation contradicts their GKE accelerator label;
 * ``tpu_node_checker_node_notready{reason}`` — NotReady node counts keyed by
   the kubelet Ready-condition reason (KubeletNotReady vs NetworkUnavailable
-  vs NodeStatusUnknown route to different responders).
+  vs NodeStatusUnknown route to different responders);
+* ``tpu_node_checker_slice_expected_chips{nodepool,topology}`` — the per-slice
+  denominator ``slice_ready_chips`` is graded against;
+* ``tpu_node_checker_planned_disruption_nodes`` — sick nodes attributed to a
+  planned GKE disruption (maintenance/upgrade), split out of availability;
+* ``tpu_node_checker_node_state{state}`` /
+  ``tpu_node_checker_node_flaps_total`` — hysteresis FSM occupancy (all five
+  states always emitted) and the monotonic flap counter, under ``--history``;
+* ``tpu_node_checker_round_degraded`` — 1 when the round completed but a
+  non-fatal phase (events/cordon/uncordon) degraded;
+* ``tpu_node_checker_api_{connections_opened,requests,requests_reused}_total``
+  and ``tpu_node_checker_api_retries_total{reason}`` — k8s API transport
+  lifecycle: sockets dialed, requests sent, keep-alive reuse, retry ladder;
+* ``tpu_node_checker_watch_breaker_open`` /
+  ``tpu_node_checker_watch_breaker_consecutive_failures`` — watch-mode
+  circuit-breaker state ("the monitor itself is degraded" is alertable
+  separately from "the fleet is degraded").
+
+This docstring is the package's metric index: tnc-lint's
+``drift-readme-metrics`` rule (TNC202) fails CI when a family is emitted
+below but listed neither here nor in the README — keep it current.
 """
 
 from __future__ import annotations
@@ -583,7 +603,11 @@ class MetricsServer:
         Handler.router = router
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tnc-metrics-server",
+            daemon=True,
+        )
         self._thread.start()
 
     def _get_metrics(self, req):

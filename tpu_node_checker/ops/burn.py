@@ -103,7 +103,7 @@ def matmul_burn(
             iters=iters,
             error=None if ok else f"MXU/VPU invariant mismatch: rel_err={rel_err:.3e}",
         )
-    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
         return BurnResult(
             ok=False, tflops=0.0, elapsed_ms=0.0, rel_err=float("inf"), n=n, iters=iters,
             error=f"{type(exc).__name__}: {exc}",
@@ -215,7 +215,7 @@ def soak_burn(
                 f"{min(tflops):.2f} TFLOP/s is {ratio:.0%} of median {median:.2f}"
             ),
         )
-    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
         return SoakResult(
             ok=False, rounds=0, seconds=0.0, tflops_min=0.0, tflops_median=0.0,
             tflops_max=0.0, sustained_ratio=0.0,

@@ -154,7 +154,7 @@ def dma_stream_probe(
             interpreted=bool(interpret),
             error=None if ok else "DMA-streamed result differs from XLA's 2x+1",
         )
-    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
         return DmaProbeResult(
             ok=False, gbps=0.0, elapsed_ms=0.0, interpreted=bool(interpret),
             error=f"{type(exc).__name__}: {exc}",

@@ -107,7 +107,7 @@ def pallas_matmul_probe(
             interpreted=bool(interpret),
             error=None if ok else f"pallas/XLA mismatch: max_rel_err={max_rel_err:.3e}",
         )
-    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
         return PallasProbeResult(
             ok=False, max_rel_err=float("inf"), elapsed_ms=0.0,
             interpreted=bool(interpret), error=f"{type(exc).__name__}: {exc}",

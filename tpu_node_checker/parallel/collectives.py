@@ -229,7 +229,7 @@ def collective_probe(
                 "busbw_gbps": busbw_gbps,
             },
         )
-    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
         return CollectiveResult(
             ok=False, n_devices=0, latency_us=0.0, error=f"{type(exc).__name__}: {exc}"
         )
@@ -339,7 +339,7 @@ def per_axis_probe(
             ),
             details={"topology": "x".join(str(s) for s in shape), "axis_ok": axis_ok},
         )
-    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
         return CollectiveResult(
             ok=False, n_devices=0, latency_us=0.0, error=f"{type(exc).__name__}: {exc}"
         )
@@ -434,7 +434,7 @@ def axis_bandwidth_probe(
             error=None if ok else f"psum along axis {axis!r} returned wrong sums",
             details={"axis": axis, "axis_size": s_a, "busbw_gbps": busbw_gbps},
         )
-    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
         return CollectiveResult(
             ok=False, n_devices=0, latency_us=0.0, error=f"{type(exc).__name__}: {exc}"
         )
@@ -603,7 +603,7 @@ def ring_probe(
             error=error,
             details=details,
         )
-    except Exception as exc:  # noqa: BLE001 — probes report, never raise
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
         return CollectiveResult(
             ok=False, n_devices=0, latency_us=0.0, error=f"{type(exc).__name__}: {exc}"
         )

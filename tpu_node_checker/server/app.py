@@ -209,7 +209,9 @@ class FleetStateServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
+            target=self._server.serve_forever,
+            name="tnc-fleet-api",
+            daemon=True,
         )
         self._thread.start()
 
@@ -283,7 +285,7 @@ class FleetStateServer:
         if self._refresh is not None:
             try:
                 self._refresh()
-            except Exception as exc:  # noqa: BLE001 — refresh is best-effort
+            except Exception as exc:  # tnc: allow-broad-except(refresh is best-effort)
                 print(f"fleet API store refresh failed: {exc}", file=sys.stderr)
         return self._snap
 
@@ -409,7 +411,7 @@ class FleetStateServer:
         dry_run = self._dry_run(req)
         try:
             status, body = self._control(name, action, dry_run, node, snap)
-        except Exception as exc:  # noqa: BLE001 — a PATCH failure is a response, not a crash
+        except Exception as exc:  # tnc: allow-broad-except(a PATCH failure is a response, not a crash)
             status, body = 502, {"error": f"{action} failed: {exc}"}
         body.setdefault("node", name)
         body.setdefault("action", action)
@@ -464,9 +466,11 @@ class FleetStateServer:
         def _fire():
             try:
                 self.on_event("auth-failure", detail)
-            except Exception as exc:  # noqa: BLE001 — notification must not break serving
+            except Exception as exc:  # tnc: allow-broad-except(notification must not break serving)
                 print(f"fleet API event hook failed: {exc}", file=sys.stderr)
 
         # Off the request thread: the hook may POST to Slack (10 s timeout),
         # and the 401/403 response must not wait on a slow webhook.
-        threading.Thread(target=_fire, daemon=True).start()
+        threading.Thread(
+            target=_fire, name="tnc-auth-event-notify", daemon=True
+        ).start()

@@ -252,7 +252,7 @@ class RoutedHandler(BaseHTTPRequestHandler):
                 )
                 try:
                     response = handler(request)
-                except Exception as exc:  # noqa: BLE001 — a handler bug must not kill the thread
+                except Exception as exc:  # tnc: allow-broad-except(a handler bug must not kill the thread)
                     response = json_response(500, {"error": f"internal error: {exc}"})
             status = response.status
             self._send(response, head_only=(method == "HEAD"))

@@ -299,6 +299,7 @@ class TrendCache:
 
     def entity(self, seq: int) -> Entity:
         key = self._signature(seq)
+        # tnc: allow-blocking-read-path(the trend cache is the sanctioned exception — DESIGN §10: one stat per request, the lock guards a rebuild that runs once per round/file change, never per poll)
         with self._lock:
             if key == self._key and self._entity is not None:
                 return self._entity

@@ -48,17 +48,20 @@ def bounded_map(
         for item in items:
             try:
                 out.append((True, fn(item)))
-            except Exception as exc:  # noqa: BLE001 — per-item, never fatal
+            except Exception as exc:  # tnc: allow-broad-except(per-item, never fatal)
                 out.append((False, exc))
         return out
     from concurrent.futures import ThreadPoolExecutor
 
-    with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
+    with ThreadPoolExecutor(
+        max_workers=min(max_workers, len(items)),
+        thread_name_prefix="tnc-fanout",
+    ) as pool:
         futures = [pool.submit(fn, item) for item in items]
         out = []
         for future in futures:
             try:
                 out.append((True, future.result()))
-            except Exception as exc:  # noqa: BLE001 — per-item, never fatal
+            except Exception as exc:  # tnc: allow-broad-except(per-item, never fatal)
                 out.append((False, exc))
         return out
