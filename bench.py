@@ -46,6 +46,16 @@ Retry-layer evidence (the graded-retry tentpole):
   must recover within its retry budget with the healthy walk's exact
   verdict, retries > 0 in the transport telemetry.
 
+Watch-stream evidence (the incremental-rounds tentpole):
+
+* ``nodes5k_watch_steady_p50_ms`` — a zero-change tick over the event-fed
+  node cache on the 5k-node fleet (the round every quiet interval pays
+  under ``--watch-stream``), ASSERTED < 10 ms and < the full paged LIST
+  (``nodes5k_paged_internal_p50_ms``); ``nodes5k_watch_churn1pct_p50_ms``
+  re-grades 20 stream-flipped nodes per tick.  The run also ASSERTS that
+  relists happen exactly on seed + injected stream loss + injected 410 —
+  never on a steady or churn round.
+
 Fleet-API serving evidence (the snapshot-cache tentpole):
 
 * ``serve_etag_hit_p50_ms`` — GET /api/v1/nodes on the 2k-node round with
@@ -415,6 +425,99 @@ def main() -> int:
         f"p50 {serve_cold_p50:.2f}ms"
     )
 
+    # Watch-stream incremental rounds (this PR's tentpole): the same 5k-node
+    # fleet behind a scripted watch endpoint.  The seed tick pays one full
+    # paged LIST + grade-all; after that a STEADY round (no events) is a
+    # cache drain — asserted < 10 ms AND < the full-relist internal p50 —
+    # and a 1%-churn round (20 flipped TPU nodes per tick, deterministic)
+    # re-grades only the changed nodes.  Full relists are counted by reason:
+    # exactly one (the seed) across the steady/churn phases, and only
+    # injected stream loss / 410 Gone add more.
+    checker.reset_client_cache()
+    from tpu_node_checker.watchstream import StreamRoundEngine
+
+    watch_script = fx.WatchScript([{"live": True}])
+    watch_server = fx.serve_http(
+        fx.watch_nodelist_handler(big, watch_script, resource_version="9000")
+    )
+    watch_kubeconfig = _write_kubeconfig(
+        f"http://127.0.0.1:{watch_server.server_address[1]}"
+    )
+    watch_args = cli.parse_args(
+        ["--kubeconfig", watch_kubeconfig, "--watch", "60", "--watch-stream",
+         "--json"]
+    )
+    engine = StreamRoundEngine(watch_args)
+    result, seeded = engine.tick()  # the one allowed relist: the seed
+    assert result.exit_code == 0, result.exit_code
+    assert result.payload["total_nodes"] == 2024, result.payload["total_nodes"]
+    assert result.payload["ready_chips"] == 16 * 256 + 1000 * 8
+    assert len(seeded) == 2024, len(seeded)
+    steady_latencies = []
+    for _ in range(41):
+        t0 = time.perf_counter()
+        result, delta = engine.tick()
+        steady_latencies.append((time.perf_counter() - t0) * 1e3)
+        assert delta == frozenset(), "steady tick saw phantom changes"
+        assert result.exit_code == 0
+    watch_steady_p50 = statistics.median(steady_latencies)
+    # The acceptance gates: steady-state is O(changes)=O(0), far below the
+    # full paged LIST every poll round pays.
+    assert watch_steady_p50 < 10.0, (
+        f"steady watch tick p50 {watch_steady_p50:.2f}ms breaches the "
+        "10ms budget"
+    )
+    assert watch_steady_p50 < nodes5k_p50, (watch_steady_p50, nodes5k_p50)
+
+    # 1% churn: flip ~20 TPU nodes per round via real stream frames (the
+    # spin-wait for delivery sits OUTSIDE the timed region).
+    churn_nodes = [
+        n for n in big
+        if "google.com/tpu" in (n["status"]["allocatable"] or {})
+    ][:20]
+    churn_latencies = []
+    flip = False
+    for rnd in range(9):
+        flip = not flip
+        for n in churn_nodes:
+            m = json.loads(json.dumps(n))
+            m["status"]["conditions"][1]["status"] = "False" if flip else "True"
+            watch_script.push(
+                fx.watch_event("MODIFIED", m, resource_version=str(9001 + rnd))
+            )
+        deadline = time.perf_counter() + 10.0
+        while engine.cache.pending() < len(churn_nodes):
+            assert time.perf_counter() < deadline, "stream delivery stalled"
+            time.sleep(0.002)
+        t0 = time.perf_counter()
+        result, delta = engine.tick()
+        churn_latencies.append((time.perf_counter() - t0) * 1e3)
+        assert len(delta) == len(churn_nodes), (len(delta), len(churn_nodes))
+    watch_churn_p50 = statistics.median(churn_latencies)
+    assert watch_churn_p50 < nodes5k_p50, (watch_churn_p50, nodes5k_p50)
+    ws = result.payload["watch_stream"]
+    assert ws["relists_total"] == {"seed": 1}, ws["relists_total"]
+
+    # Injected stream loss, then a 410 at reconnect: each forces exactly
+    # one clean relist — the ONLY events that do.
+    watch_script.push(None)  # server ends the stream
+    deadline = time.perf_counter() + 10.0
+    while engine.stream_alive():
+        assert time.perf_counter() < deadline, "stream worker never exited"
+        time.sleep(0.002)
+    watch_script._stanzas.append({"status": 410})
+    watch_script._stanzas.append({"live": True})
+    result, _ = engine.tick()
+    relists = result.payload["watch_stream"]["relists_total"]
+    assert relists.get("stream_end") == 1, relists
+    assert relists.get("gone") == 1, relists
+    assert sum(relists.values()) == 3, relists  # seed + loss + 410, no more
+    engine.close()
+    watch_script.close()
+    watch_server.shutdown()
+    os.unlink(watch_kubeconfig)
+    checker.reset_client_cache()
+
     # The 5k-node paged walk over HTTPS — where per-page handshakes hurt
     # most (~11 pages/round).  Pooled transport vs the pre-pool equivalent
     # (keep_alive=False: a fresh connection, and a fresh TLS handshake, per
@@ -509,6 +612,8 @@ def main() -> int:
                     round(warm_tls_p50, 2) if warm_tls_p50 is not None else None
                 ),
                 "nodes5k_paged_internal_p50_ms": round(nodes5k_p50, 2),
+                "nodes5k_watch_steady_p50_ms": round(watch_steady_p50, 3),
+                "nodes5k_watch_churn1pct_p50_ms": round(watch_churn_p50, 2),
                 "nodes5k_fault30_p50_ms": round(nodes5k_fault30_p50, 2),
                 "serve_etag_hit_p50_ms": round(serve_etag_p50, 3),
                 "serve_cold_encode_p50_ms": round(serve_cold_p50, 3),

@@ -1,0 +1,25 @@
+"""Seeds for TNC102 on the delta-publish shape: a delta build may READ the
+live snapshot freely, but once the new one is swapped in it never mutates —
+request threads hold references to it."""
+
+
+class DeltaPublisher:
+    def __init__(self):
+        self._snap = None
+
+    def publish_delta_then_patch(self, payload, changed):
+        prev = self._snap
+        snap = {"entities": {}, "fragments": {}}
+        for name in changed:
+            snap["fragments"][name] = payload[name]  # near-miss: pre-swap
+        if prev is not None:
+            snap["entities"].update(prev["entities"])  # near-miss: reads prev, mutates the private build
+        self._snap = snap
+        snap["fragments"]["late-node"] = payload  # EXPECT[TNC102]
+        return snap
+
+    def publish_delta_clean(self, payload, changed):
+        snap = {"entities": {}, "fragments": {k: payload[k] for k in changed}}
+        snap["seq"] = 1
+        self._snap = snap
+        return snap

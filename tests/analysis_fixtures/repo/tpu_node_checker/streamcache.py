@@ -1,0 +1,37 @@
+"""Seeds for TNC101 on the watch-stream cache shape: a reader thread and
+the tick share per-node state, so every post-construction mutation of the
+lock-guarded maps must hold the lock."""
+
+import threading
+
+
+class EventCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes = {}  # near-miss: __init__ constructs, no reader yet
+        self._changed = set()
+        self.resource_version = None
+
+    def apply(self, name, obj, rv):
+        with self._lock:
+            self._nodes[name] = obj
+            self._changed.add(name)
+            self.resource_version = rv
+
+    def drain(self):
+        with self._lock:
+            changed = self._changed
+            self._changed = set()
+            return changed
+
+    def fast_bookmark(self, rv):
+        self.resource_version = rv  # EXPECT[TNC101]
+
+    def reseed_racy(self, nodes):
+        self._nodes = dict(nodes)  # EXPECT[TNC101]
+        self._changed = set(nodes)  # EXPECT[TNC101]
+
+    def local_view(self):  # near-miss: a local name, not shared state
+        nodes = {}
+        nodes["a"] = 1
+        return nodes

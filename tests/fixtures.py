@@ -352,13 +352,18 @@ class FaultSchedule:
 
 
 def _paged_nodelist_body(
-    nodes: List[dict], path: str, requests_seen: Optional[list]
+    nodes: List[dict],
+    path: str,
+    requests_seen: Optional[list],
+    resource_version: Optional[str] = None,
 ) -> bytes:
     """The fake apiserver's ``limit``/``continue`` paging protocol — ONE
-    definition shared by :func:`paged_nodelist_handler` and
-    :func:`fault_scheduled_handler`, so the fault-injection/bench path can
-    never drift onto a different protocol than the pagination tests pin.
-    ``requests_seen`` (optional list) records each request's start offset."""
+    definition shared by :func:`paged_nodelist_handler`,
+    :func:`fault_scheduled_handler` and :func:`watch_nodelist_handler`, so
+    the fault-injection/bench/watch paths can never drift onto a different
+    protocol than the pagination tests pin.  ``requests_seen`` (optional
+    list) records each request's start offset; ``resource_version`` rides
+    the list metadata (what a subsequent watch resumes from)."""
     import json as _json
     from urllib.parse import parse_qs, urlparse
 
@@ -368,8 +373,13 @@ def _paged_nodelist_body(
     if requests_seen is not None:
         requests_seen.append(start)
     doc = {"kind": "NodeList", "items": nodes[start:start + limit]}
+    meta = {}
     if start + limit < len(nodes):
-        doc["metadata"] = {"continue": str(start + limit)}
+        meta["continue"] = str(start + limit)
+    if resource_version is not None:
+        meta["resourceVersion"] = str(resource_version)
+    if meta:
+        doc["metadata"] = meta
     return _json.dumps(doc).encode()
 
 
@@ -460,6 +470,201 @@ def fault_scheduled_handler(
             if patches_seen is not None:
                 patches_seen.append((self.path, body))
             self._serve(b"{}")
+
+        def log_message(self, *args):
+            pass
+
+    return Handler
+
+
+def watch_event(etype: str, obj: dict, resource_version: Optional[str] = None) -> dict:
+    """One watch frame: ``{"type": ..., "object": ...}``, optionally
+    stamping a ``resourceVersion`` onto the object's metadata (copied — the
+    caller's node dict is not mutated)."""
+    import copy
+
+    obj = copy.deepcopy(obj)
+    if resource_version is not None:
+        obj.setdefault("metadata", {})["resourceVersion"] = str(resource_version)
+    return {"type": etype, "object": obj}
+
+
+def watch_bookmark(resource_version: str) -> dict:
+    return {
+        "type": "BOOKMARK",
+        "object": {"metadata": {"resourceVersion": str(resource_version)}},
+    }
+
+
+def watch_error_gone() -> dict:
+    """The in-band 410 replay: the ERROR Status frame an apiserver streams
+    when the requested resourceVersion expired under an open watch."""
+    return {
+        "type": "ERROR",
+        "object": {
+            "kind": "Status",
+            "code": 410,
+            "reason": "Expired",
+            "message": "too old resource version",
+        },
+    }
+
+
+class WatchScript:
+    """Scripted fake watch endpoint: one stanza per watch CONNECTION.
+
+    Each arriving ``?watch=1`` request consumes the next stanza; when the
+    list is exhausted, further connections get ``{"live": True}`` (an
+    open stream fed by :meth:`push`).  Stanza keys:
+
+    * ``"status"``: int — answer that HTTP status (410 for Gone) with a
+      small Status body instead of streaming;
+    * ``"events"``: list of event dicts — streamed as one chunked JSON
+      frame each (use :func:`watch_event` / :func:`watch_bookmark` /
+      :func:`watch_error_gone` to build them);
+    * ``"frame_delay"``: seconds between frames (slow-drip stream; paced
+      with an interruptible Event wait, not a bare sleep);
+    * ``"live"``: True — after any scripted ``events``, keep the stream
+      open and relay whatever :meth:`push` feeds, until ``push(None)``;
+    * ``"end"``: ``"close"`` (default — finish the chunked body cleanly:
+      the client sees a server-side stream end) or ``"reset"`` (RST the
+      socket mid-stream: an abrupt disconnect).
+
+    ``connections`` counts watch connects (the relist/reconnect ground
+    truth beside ``list_requests``); ``close()`` releases any live stream
+    so fixture servers shut down promptly.
+    """
+
+    def __init__(self, stanzas: Optional[List[dict]] = None):
+        import queue
+        import threading
+
+        self._stanzas = list(stanzas or [])
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._shutdown = threading.Event()
+        self.connections = 0
+
+    def next_stanza(self) -> dict:
+        with self._lock:
+            self.connections += 1
+            return self._stanzas.pop(0) if self._stanzas else {"live": True}
+
+    def push(self, event: Optional[dict]) -> None:
+        """Feed one event to the current live stream; ``None`` ends it."""
+        self._queue.put(event)
+
+    def close(self) -> None:
+        self._shutdown.set()
+        self._queue.put(None)
+
+    # -- handler side --------------------------------------------------------
+
+    def pace(self, seconds: float) -> None:
+        """Inter-frame delay that shutdown can interrupt."""
+        if seconds:
+            self._shutdown.wait(seconds)
+
+    def next_live_event(self, timeout: float = 30.0) -> Optional[dict]:
+        import queue as _queue
+
+        if self._shutdown.is_set():
+            return None
+        try:
+            return self._queue.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+
+def watch_nodelist_handler(
+    nodes: List[dict],
+    script: WatchScript,
+    resource_version: str = "1000",
+    list_requests: Optional[list] = None,
+):
+    """Fake apiserver speaking BOTH halves of the watch-stream protocol.
+
+    ``GET /api/v1/nodes`` without ``watch`` serves the paged LIST (shared
+    ``limit``/``continue`` protocol, ``resourceVersion`` in the metadata);
+    with ``watch=1`` the :class:`WatchScript`'s next stanza decides what the
+    stream does — chunked JSON event frames, a 410, a mid-stream reset, a
+    slow drip, or a live push-fed stream.  ``list_requests`` records each
+    LIST page's start offset: its growth is the fixture-side proof of when
+    full relists actually happened.
+    """
+    import json as _json
+    import socket as _socket
+    from http.server import BaseHTTPRequestHandler
+    from urllib.parse import parse_qs, urlparse
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _chunk(self, data: bytes) -> None:
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+            self.wfile.flush()
+
+        def _end_chunks(self) -> None:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+        def _rst(self) -> None:
+            self.connection.setsockopt(
+                _socket.SOL_SOCKET, _socket.SO_LINGER,
+                b"\x01\x00\x00\x00\x00\x00\x00\x00",
+            )
+            self.connection.close()
+            self.close_connection = True
+
+        def _serve_watch(self) -> None:
+            stanza = script.next_stanza()
+            status = stanza.get("status")
+            if status:
+                body = _json.dumps(
+                    {"kind": "Status", "code": status, "reason": "Expired"}
+                ).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            delay = stanza.get("frame_delay") or 0.0
+            try:
+                for event in stanza.get("events") or []:
+                    script.pace(delay)
+                    self._chunk(_json.dumps(event).encode() + b"\n")
+                if stanza.get("live"):
+                    while True:
+                        event = script.next_live_event()
+                        if event is None:
+                            break
+                        script.pace(delay)
+                        self._chunk(_json.dumps(event).encode() + b"\n")
+                if stanza.get("end") == "reset":
+                    self._rst()
+                    return
+                self._end_chunks()
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True  # client hung up mid-stream
+
+        def do_GET(self):
+            q = parse_qs(urlparse(self.path).query)
+            if q.get("watch"):
+                self._serve_watch()
+                return
+            body = _paged_nodelist_body(
+                nodes, self.path, list_requests, resource_version=resource_version
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def log_message(self, *args):
             pass

@@ -451,7 +451,12 @@ class TestReviewRegressions:
         empty = tmp_path / "probes-none"
         empty.mkdir()
         p1 = run(0, str(empty))  # missing: no evidence
-        assert p1["nodes"][0]["health"]["state"] == "HEALTHY"
+        # No evidence about a NEVER-observed node mints no machine at all:
+        # no health key, no store line — a recorded default-HEALTHY would
+        # seed uncordon-eligible state from pure absence after a restart
+        # (and under --watch-stream, from mere stream silence).
+        assert "health" not in p1["nodes"][0]
+        assert p1["history"]["states"]["HEALTHY"] == 0
         p2 = run(1, _probe_dir(tmp_path, {"tpu-0": False}, "real"))
         # One real bad round: SUSPECT (streak 1 of 2), NOT FAILED/cordoned.
         assert p2["nodes"][0]["health"]["state"] == "SUSPECT"

@@ -36,6 +36,7 @@ from tpu_node_checker.server.router import Response, Router, negotiate
 from tpu_node_checker.server.snapshot import (
     Entity,
     build_snapshot,
+    build_snapshot_delta,
     build_store_snapshot,
 )
 
@@ -303,6 +304,163 @@ class TestReadiness:
         # Recovery: the next published round restores readiness.
         server.publish(_result(), breaker={"open": False, "consecutive_failures": 0})
         assert _req(server.port, "GET", "/readyz")[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# Delta-patched snapshots (watch-stream incremental publishes)
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaSnapshots:
+    def _two_rounds(self):
+        nodes = fx.tpu_v5p_64_slice()[:8]
+        r1 = _result(nodes)
+        sick = [json.loads(json.dumps(n)) for n in nodes]
+        sick[3]["status"]["conditions"][1]["status"] = "False"
+        r2 = _result(sick)
+        return nodes, r1, r2
+
+    def test_delta_body_is_byte_identical_to_full_rebuild(self):
+        nodes, r1, r2 = self._two_rounds()
+        changed_name = nodes[3]["metadata"]["name"]
+        prev = build_snapshot(r1.payload, r1.exit_code, 1, 100.0)
+        full = build_snapshot(r2.payload, r2.exit_code, 2, 200.0)
+        delta = build_snapshot_delta(
+            prev, r2.payload, r2.exit_code, 2, 200.0, {changed_name}
+        )
+        for key in ("summary", "nodes", "slices"):
+            assert delta.entities[key].raw == full.entities[key].raw
+            assert delta.entities[key].etag == full.entities[key].etag
+
+    def test_unchanged_entries_are_reference_reused(self):
+        nodes, r1, r2 = self._two_rounds()
+        changed_name = nodes[3]["metadata"]["name"]
+        prev = build_snapshot(r1.payload, r1.exit_code, 1, 100.0)
+        delta = build_snapshot_delta(
+            prev, r2.payload, r2.exit_code, 2, 200.0, {changed_name}
+        )
+        for n in nodes:
+            name = n["metadata"]["name"]
+            if name == changed_name:
+                assert delta.node_entities[name] is not prev.node_entities[name]
+                assert delta.node_entities[name].etag != prev.node_entities[name].etag
+            else:
+                # Object identity, not mere equality: zero re-encode work,
+                # and the poller's cached per-node ETag keeps 304-ing.
+                assert delta.node_entities[name] is prev.node_entities[name]
+                assert delta.node_fragments[name] is prev.node_fragments[name]
+                assert delta.node_docs[name] is prev.node_docs[name]
+
+    def test_empty_delta_preserves_node_bytes(self):
+        nodes, r1, _ = self._two_rounds()
+        prev = build_snapshot(r1.payload, r1.exit_code, 1, 100.0)
+        delta = build_snapshot_delta(prev, r1.payload, r1.exit_code, 2, 200.0, set())
+        # Per-node representations are bit-for-bit the previous round's;
+        # only the round-stamped collection heads move.
+        assert delta.node_entities == prev.node_entities
+        assert delta.node_fragments == prev.node_fragments
+
+    def test_node_absent_from_prev_is_encoded_fresh(self):
+        nodes, r1, r2 = self._two_rounds()
+        prev = build_snapshot(r1.payload, r1.exit_code, 1, 100.0)
+        # Simulate a node that flickered out of the previous snapshot: the
+        # delta builder must fall back to a fresh encode, never KeyError or
+        # serve a stale entry.
+        victim = nodes[5]["metadata"]["name"]
+        del prev.node_fragments[victim]
+        del prev.node_entities[victim]
+        del prev.node_docs[victim]
+        full = build_snapshot(r1.payload, r1.exit_code, 2, 200.0)
+        delta = build_snapshot_delta(
+            prev, r1.payload, r1.exit_code, 2, 200.0, set()
+        )
+        assert delta.entities["nodes"].raw == full.entities["nodes"].raw
+        assert delta.node_entities[victim].raw == full.node_entities[victim].raw
+
+    def test_publish_with_changed_set_serves_the_delta(self, server):
+        nodes, r1, r2 = self._two_rounds()
+        changed_name = nodes[3]["metadata"]["name"]
+        unchanged_name = nodes[0]["metadata"]["name"]
+        server.publish(r1)
+        status, headers, _ = _req(server.port, "GET", f"/api/v1/nodes/{unchanged_name}")
+        assert status == 200
+        etag_before = headers["ETag"]
+        collection_etag = _req(server.port, "GET", "/api/v1/nodes")[1]["ETag"]
+        server.publish(r2, changed=frozenset({changed_name}))
+        # The unchanged node's representation (and ETag) is carried over:
+        # a poller re-sending it stays on the 304 diet.
+        status, headers, _ = _req(
+            server.port, "GET", f"/api/v1/nodes/{unchanged_name}",
+            headers={"If-None-Match": etag_before},
+        )
+        assert status == 304
+        # The changed node and the collection moved.
+        status, _, body = _req(server.port, "GET", f"/api/v1/nodes/{changed_name}")
+        assert status == 200
+        assert json.loads(body)["node"]["ready"] is False
+        assert _req(server.port, "GET", "/api/v1/nodes")[1]["ETag"] != collection_etag
+
+    def test_hammer_across_incremental_swaps(self, server):
+        nodes, r1, r2 = self._two_rounds()
+        changed = frozenset({nodes[3]["metadata"]["name"]})
+        server.publish(r1)
+        port = server.port
+        done = threading.Event()
+        start = threading.Barrier(17)
+        records = [[] for _ in range(16)]
+        errors = []
+
+        def worker(slot):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            try:
+                start.wait(timeout=10)
+                last_etag = {}
+                paths = (
+                    "/api/v1/summary", "/api/v1/nodes",
+                    "/api/v1/nodes/" + nodes[0]["metadata"]["name"],
+                    "/api/v1/nodes/" + nodes[3]["metadata"]["name"],
+                )
+                while not done.is_set():
+                    for path in paths:
+                        headers = {}
+                        if path in last_etag:
+                            headers["If-None-Match"] = last_etag[path]
+                        conn.request("GET", path, headers=headers)
+                        resp = conn.getresponse()
+                        body = resp.read()
+                        if resp.status == 200:
+                            last_etag[path] = resp.headers.get("ETag")
+                        records[slot].append((path, resp.status, body))
+            except Exception as exc:  # noqa: BLE001 — surfaced as a failure below
+                errors.append(f"client {slot}: {exc!r}")
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(
+                target=worker, args=(i,), name=f"tnc-test-delta-hammer-{i}",
+                daemon=True,
+            )
+            for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        start.wait(timeout=10)
+        # 25 live incremental swaps, alternating the sick/healthy rounds,
+        # every one a delta publish against the snapshot in service.
+        for i in range(25):
+            server.publish(r2 if i % 2 == 0 else r1, changed=changed)
+        done.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "delta-hammer client wedged"
+        assert not errors, errors
+        flat = [r for rec in records for r in rec]
+        assert len(flat) > 16
+        assert {status for _, status, _ in flat} <= {200, 304}
+        for _, status, body in flat:
+            if status == 200:
+                json.loads(body)  # raises on a torn body
 
 
 # ---------------------------------------------------------------------------

@@ -640,10 +640,17 @@ def _update_history(history: dict, accel: List[NodeInfo]) -> None:
         ):
             # Bad SOLELY because no report arrived: no evidence either way.
             verdict = None
+        out_of_band = n.quarantined_by_us and not n.cordoned
+        if verdict is None and n.name not in fsm.nodes and not out_of_band:
+            # No evidence about a node this machine has NEVER observed:
+            # record nothing and attach nothing.  Minting (and persisting)
+            # a default-HEALTHY machine here would seed uncordon-eligible
+            # state from pure absence — a restart would then trust it.
+            continue
         fsm.observe(
             n.name,
             verdict,
-            uncordoned_out_of_band=n.quarantined_by_us and not n.cordoned,
+            uncordoned_out_of_band=out_of_band,
         )
         h = fsm.health(n.name)
         n.health = {"state": h.state, "streak": h.streak, "flaps": h.flaps}
@@ -677,7 +684,13 @@ def _history_payload(history: dict, accel: List[NodeInfo]) -> dict:
     states = {s: 0 for s in STATES}
     chronic = []
     for n in accel:
-        h = fsm.health(n.name)
+        # .get, never .health(): the roll-up must not MINT a machine for a
+        # node the FSM has never observed (an evidence-free first sight) —
+        # a minted default-HEALTHY entry would both miscount the gauge and
+        # make the node look "known" to the next round's no-evidence guard.
+        h = fsm.nodes.get(n.name)
+        if h is None:
+            continue
         states[h.state] += 1
         if h.state == CHRONIC:
             chronic.append(n.name)
@@ -905,6 +918,54 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> 
     return report_entry
 
 
+def grade_fleet(args, accel, effective_ready, slices):
+    """The exit-code ladder plus the ``--expected-chips`` capacity math —
+    ONE definition shared by ``run_check`` (one-shot / poll rounds) and the
+    watch-stream engine's incremental tick, so a future grading rule can
+    never apply in one mode and silently not in the other.
+
+    Returns ``(exit_code, expected_key, expected_n, have_chips)``.
+    """
+    expectation = getattr(args, "expected_chips", None)
+    expected_key, expected_n, have_chips = None, None, None
+    if expectation is not None:
+        expected_key, expected_n = expectation
+        if expected_key is None:
+            have_chips = sum(n.accelerators for n in effective_ready)
+        else:
+            have_chips = sum(
+                v
+                for n in effective_ready
+                for k, v in n.breakdown.items()
+                if fnmatch.fnmatchcase(k, expected_key)
+            )
+    if not accel:
+        code = EXIT_NO_ACCEL_NODES
+    elif not effective_ready:
+        code = EXIT_NONE_READY
+    elif getattr(args, "strict_slices", False) and any(not s.complete for s in slices):
+        code = EXIT_NONE_READY
+    elif expected_n is not None and have_chips < expected_n:
+        # Cluster-level capacity assertion (SURVEY §5.6): some nodes may be
+        # Ready, but the fleet is short of the chips the caller requires.
+        code = EXIT_NONE_READY
+    else:
+        code = EXIT_OK
+    return code, expected_key, expected_n, have_chips
+
+
+def stamp_expected_chips(payload: dict, expected_key, expected_n, have_chips) -> None:
+    """The payload's ``expected_chips*`` keys — shared with the stream
+    engine for the same no-drift reason as :func:`grade_fleet`."""
+    if expected_n is None:
+        return
+    payload["expected_chips"] = expected_n
+    if expected_key is not None:
+        payload["expected_chips_key"] = expected_key
+    payload["expected_chips_have"] = have_chips
+    payload["expected_chips_met"] = have_chips >= expected_n
+
+
 def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     """Pure-ish core of the run: everything except printing and Slack I/O
     gating decisions is computed here so tests can drive it directly."""
@@ -949,31 +1010,9 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
     effective_ready = [n for n in ready if n.effectively_ready]
     result.ready = effective_ready
 
-    expectation = getattr(args, "expected_chips", None)
-    expected_key, expected_n, have_chips = None, None, None
-    if expectation is not None:
-        expected_key, expected_n = expectation
-        if expected_key is None:
-            have_chips = sum(n.accelerators for n in effective_ready)
-        else:
-            have_chips = sum(
-                v
-                for n in effective_ready
-                for k, v in n.breakdown.items()
-                if fnmatch.fnmatchcase(k, expected_key)
-            )
-    if not accel:
-        result.exit_code = EXIT_NO_ACCEL_NODES
-    elif not effective_ready:
-        result.exit_code = EXIT_NONE_READY
-    elif getattr(args, "strict_slices", False) and any(not s.complete for s in slices):
-        result.exit_code = EXIT_NONE_READY
-    elif expected_n is not None and have_chips < expected_n:
-        # Cluster-level capacity assertion (SURVEY §5.6): some nodes may be
-        # Ready, but the fleet is short of the chips the caller requires.
-        result.exit_code = EXIT_NONE_READY
-    else:
-        result.exit_code = EXIT_OK
+    result.exit_code, expected_key, expected_n, have_chips = grade_fleet(
+        args, accel, effective_ready, slices
+    )
 
     cordon_report = uncordon_report = None
     if getattr(args, "cordon_failed", False) or getattr(args, "uncordon_recovered", False):
@@ -1055,12 +1094,7 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
                 payload["probe_summary"]["reports_skipped"] = {
                     k: v for k, v in reports_skipped.items() if v
                 }
-        if expected_n is not None:
-            payload["expected_chips"] = expected_n
-            if expected_key is not None:
-                payload["expected_chips_key"] = expected_key
-            payload["expected_chips_have"] = have_chips
-            payload["expected_chips_met"] = have_chips >= expected_n
+        stamp_expected_chips(payload, expected_key, expected_n, have_chips)
         if cordon_report is not None:
             payload["cordon"] = cordon_report
         if uncordon_report is not None:
@@ -2029,6 +2063,21 @@ def watch(args) -> int:
     stop = threading.Event()
     prev_handler = _install_stop_signal(stop)
     username = getattr(args, "slack_username", notify.DEFAULT_USERNAME)
+    engine = None
+    if getattr(args, "watch_stream", False):
+        # Watch-stream mode (--watch-stream): the round becomes a tick over
+        # an event-fed node cache — one LIST seeds it, a watch stream keeps
+        # it current, and only changed nodes are re-graded/re-encoded.  A
+        # tick raises exactly like run_check when the stream is down and
+        # the relist fails, so the breaker/backoff path below is shared.
+        from tpu_node_checker.watchstream import StreamRoundEngine
+
+        engine = StreamRoundEngine(args)
+        print(
+            "Watch-stream mode: LIST once, then incremental rounds over "
+            "the node watch (full relist only on stream loss/410).",
+            file=sys.stderr,
+        )
     fleet_server = None
     if getattr(args, "serve", None) is not None:
         # The fleet state API rides the watch loop: each completed round
@@ -2073,7 +2122,10 @@ def watch(args) -> int:
             # recovery also registers as a transition.  Render/notify problems
             # afterwards are reported but do not reclassify a successful round.
             try:
-                result = run_check(args)
+                if engine is not None:
+                    result, delta = engine.tick()
+                else:
+                    result, delta = run_check(args), None
             except KeyboardInterrupt:
                 raise
             except Exception as exc:  # tnc: allow-broad-except(a bad round must not kill the daemon)
@@ -2083,6 +2135,10 @@ def watch(args) -> int:
                 # the next round redials (and re-resolves credentials) instead
                 # of re-trusting a pool that may hold only dead sockets.
                 reset_client_cache()
+                if engine is not None:
+                    # The stream rode that client (or died with it): tear it
+                    # down so the next tick reconnects from a clean dial.
+                    engine.abort_stream()
                 transition = breaker.record_failure()
                 if metrics_server is not None:
                     metrics_server.set_breaker(breaker.as_dict())
@@ -2127,8 +2183,21 @@ def watch(args) -> int:
                 if fleet_server is not None:
                     # AFTER the state log append: /api/v1/trend's cache key
                     # includes the publication seq, so the new round's line
-                    # must already be on disk when the seq moves.
-                    fleet_server.publish(result, breaker=breaker.as_dict())
+                    # must already be on disk when the seq moves.  A
+                    # watch-stream tick with an EMPTY delta publishes
+                    # nothing: served content would be byte-identical, and
+                    # skipping the swap keeps every poller's cached ETag a
+                    # 304 hit — the served round advances when the fleet
+                    # changes, while the scrape surface (timestamp and
+                    # stream-age gauges) keeps moving every tick.
+                    if delta is None or delta:
+                        fleet_server.publish(
+                            result, breaker=breaker.as_dict(), changed=delta
+                        )
+                    else:
+                        fleet_server.refresh_metrics(
+                            result, breaker=breaker.as_dict()
+                        )
                 sick = _round_sick_set(result)
                 # Change fingerprint = exit code + sick-node set: a node
                 # swap inside an unchanged code is still a transition.  The
@@ -2206,6 +2275,8 @@ def watch(args) -> int:
                 return 128 + 15  # conventional SIGTERM exit
     finally:
         _restore_stop_signal(prev_handler)
+        if engine is not None:
+            engine.close()
         if fleet_server is not None:
             fleet_server.close()
 

@@ -114,6 +114,17 @@ def build_parser() -> argparse.ArgumentParser:
                    "phases to FILE (open in Perfetto / chrome://tracing)")
     p.add_argument("--watch", type=float, metavar="SECONDS",
                    help="daemon mode: repeat the check every SECONDS until interrupted")
+    p.add_argument("--watch-stream", dest="watch_stream", action="store_true",
+                   default=False,
+                   help="with --watch: replace per-round LISTs with a "
+                   "Kubernetes watch stream — one LIST seeds a node cache, "
+                   "ADDED/MODIFIED/DELETED events keep it current, each "
+                   "round re-grades only changed nodes and delta-patches "
+                   "the --serve snapshot; a 410/stream loss triggers one "
+                   "clean relist through the normal retry ladder")
+    p.add_argument("--no-watch-stream", dest="watch_stream", action="store_false",
+                   help="force classic poll-and-relist rounds (the default; "
+                   "overrides an earlier --watch-stream on the command line)")
     p.add_argument("--slack-on-change", action="store_true",
                    help="with --watch: notify only when the check outcome changes")
     p.add_argument("--metrics-port", type=int, metavar="PORT",
@@ -329,6 +340,31 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--retry-budget must be >= 0 (0 disables retries)")
     if args.metrics_port is not None and args.watch is None:
         p.error("--metrics-port requires --watch (one-shot runs serve no scrapes)")
+    if args.watch_stream:
+        if args.watch is None:
+            p.error("--watch-stream requires --watch (one-shot runs have no "
+                    "stream to hold open)")
+        if args.nodes_json:
+            p.error("--watch-stream requires a live API server "
+                    "(--nodes-json is an offline node source)")
+        if args.emit_probe:
+            # emit-probe's loop re-probes this host on a cadence — there is
+            # no node LIST to stream; accepting the flag would be the same
+            # silent no-op the probe sources below are rejected for.
+            p.error("--watch-stream cannot be combined with --emit-probe "
+                    "(the emitter loop watches a chip, not the node list)")
+        for flag, val in (
+            ("--probe", args.probe),
+            ("--probe-results", args.probe_results),
+            ("--node-events", args.node_events),
+        ):
+            if val:
+                # Silent-no-op rule: these surfaces gather evidence OUTSIDE
+                # the node-object stream, which the incremental tick does
+                # not re-poll — accepting them would quietly grade on stale
+                # probe/event data the operator thinks is fresh.
+                p.error(f"{flag} is not supported with --watch-stream yet "
+                        "(use poll-mode --watch)")
     if args.serve_token and args.serve is None:
         p.error("--serve-token requires --serve")
     if args.slack_on_change and args.watch is None:

@@ -59,6 +59,16 @@ class ClusterAPIError(RuntimeError):
         self.status_code = status_code
 
 
+class WatchGone(ClusterAPIError):
+    """410 Gone on a watch connect: the requested ``resourceVersion`` has
+    been compacted out of etcd.  The one recovery is a fresh LIST — the
+    caller (the watch-stream engine) relists and reseeds its cache rather
+    than retrying the dead resourceVersion forever."""
+
+    def __init__(self, message: str):
+        super().__init__(message, status_code=410)
+
+
 class _Response:
     """Minimal requests-Response-shaped result for :class:`_StdlibSession`.
 
@@ -85,6 +95,76 @@ class _Response:
 
     def json(self):
         return json.loads(self._body)
+
+
+class _StreamingResponse:
+    """One live streaming HTTP response (a k8s ``watch``): line-iterated,
+    owning a DEDICATED connection that is never pooled.
+
+    A watch monopolizes its socket for minutes — returning it to the
+    free-list would hand a half-consumed chunked stream to the next LIST.
+    ``close()`` tears the connection down; it is also how a reader blocked
+    in ``readline`` gets unblocked at shutdown (the socket close surfaces
+    as EOF/OSError in the reading thread).
+    """
+
+    def __init__(self, conn, raw, url: str):
+        self.status_code = raw.status
+        self.headers = {k.lower(): v for k, v in raw.getheaders()}
+        self._conn = conn
+        self._raw = raw
+        self._url = url
+
+    def raise_for_status(self) -> None:
+        if not 200 <= self.status_code < 300:
+            # Error bodies are small Status objects; bound the read anyway —
+            # a misbehaving server must not stall connect-time error
+            # handling behind an unbounded body.
+            snippet = self._raw.read(300).decode("utf-8", errors="replace")
+            self.close()
+            if self.status_code == 410:
+                raise WatchGone(f"HTTP 410 from {self._url}: {snippet}")
+            raise ClusterAPIError(
+                f"HTTP {self.status_code} from {self._url}: {snippet}",
+                status_code=self.status_code,
+            )
+
+    def iter_lines(self):
+        """Yield one non-empty line (stripped bytes) per watch frame.
+
+        ``http.client`` dechunks transparently, so ``readline`` returns one
+        newline-delimited JSON event per call.  A clean stream end (server
+        closed, 0-chunk) yields nothing further; socket timeouts and
+        resets propagate to the caller, whose reconnect policy this layer
+        deliberately does not own.
+        """
+        while True:
+            line = self._raw.readline()
+            if not line:
+                return
+            line = line.strip()
+            if line:
+                yield line
+
+    def close(self) -> None:
+        # Shut the socket down BEFORE closing the connection object:
+        # ``conn.close()`` ends up waiting on the buffered response's
+        # internal lock, which a reader thread parked in ``readline`` holds
+        # until its recv returns — shutdown() forces that recv to return
+        # NOW (EOF) instead of whenever the peer next says something, so a
+        # stream teardown takes milliseconds, not a read-timeout.
+        import socket as _socket
+
+        sock = getattr(self._conn, "sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
 
 
 class _StdlibSession:
@@ -415,6 +495,50 @@ class _StdlibSession:
                 headers={k.lower(): v for k, v in raw.getheaders()},
             )
 
+    def stream(self, url, *, params=None, headers=None, timeout=None,
+               read_timeout=None):
+        """Open a streaming GET on a DEDICATED (never pooled) connection.
+
+        The watch-stream transport: the response is handed back live for
+        incremental ``readline`` decode instead of being drained into one
+        body.  ``timeout`` bounds the dial and the response HEAD (a wedged
+        server must fail the connect in seconds, like any API call);
+        ``read_timeout`` then replaces it on the established socket — a
+        silent stream past it raises in the reader, which the watch engine
+        treats as stream loss.  No retry policy applies: reconnect policy
+        belongs to the stream's owner, which knows whether a
+        resourceVersion is still worth resuming from.
+        """
+        import urllib.parse
+
+        if params:
+            url = f"{url}?{urllib.parse.urlencode(params)}"
+        parts = urllib.parse.urlsplit(url)
+        scheme = parts.scheme.lower()
+        if scheme not in ("http", "https"):
+            raise ClusterAPIError(f"unsupported URL scheme in {url}")
+        host = parts.hostname or ""
+        port = parts.port or (443 if scheme == "https" else 80)
+        path = parts.path or "/"
+        if parts.query:
+            path = f"{path}?{parts.query}"
+        hdrs = {**self.headers, **(headers or {})}
+        if self.auth and "Authorization" not in hdrs:
+            cred = base64.b64encode(f"{self.auth[0]}:{self.auth[1]}".encode()).decode()
+            hdrs["Authorization"] = f"Basic {cred}"
+        conn = self._new_connection(scheme, host, port, timeout)
+        try:
+            conn.request("GET", path, headers=hdrs)
+            raw = conn.getresponse()
+            if read_timeout is not None and conn.sock is not None:
+                conn.sock.settimeout(read_timeout)
+        except Exception:
+            conn.close()
+            raise
+        with self._lock:
+            self.requests_sent += 1
+        return _StreamingResponse(conn, raw, url)
+
     def get(self, url, params=None, timeout=None):
         return self._request("GET", url, params=params, timeout=timeout)
 
@@ -663,20 +787,23 @@ class KubeClient:
 
     def _paged_list(
         self, path: str, params: dict, timeout: float, max_pages: int
-    ) -> Tuple[List[dict], Optional[str]]:
+    ) -> Tuple[List[dict], Optional[str], Optional[str]]:
         """Follow ``limit``/``continue`` for one GET list — the single
         pagination walk both node and event LISTs share.
 
-        Returns ``(items, leftover_continue)``: ``leftover_continue`` is
-        non-None iff ``max_pages`` was exhausted with the token still set
-        (the caller decides whether that is fatal or a stderr note).  A 410
-        Gone mid-walk (expired snapshot; status read from either the stdlib
-        ClusterAPIError or a drop-in requests.HTTPError) restarts the walk
-        from scratch once.
+        Returns ``(items, leftover_continue, resource_version)``:
+        ``leftover_continue`` is non-None iff ``max_pages`` was exhausted
+        with the token still set (the caller decides whether that is fatal
+        or a stderr note); ``resource_version`` is the list's
+        ``metadata.resourceVersion`` — the point-in-time a subsequent
+        ``watch`` resumes from.  A 410 Gone mid-walk (expired snapshot;
+        status read from either the stdlib ClusterAPIError or a drop-in
+        requests.HTTPError) restarts the walk from scratch once.
         """
         for attempt in (0, 1):
             page_params = dict(params)
             items: List[dict] = []
+            rv: Optional[str] = None
             try:
                 for _ in range(max_pages):
                     resp = self._session.get(
@@ -687,11 +814,14 @@ class KubeClient:
                     resp.raise_for_status()
                     doc = resp.json()
                     items.extend(doc.get("items") or [])
-                    cont = (doc.get("metadata") or {}).get("continue")
+                    meta = doc.get("metadata") or {}
+                    if meta.get("resourceVersion"):
+                        rv = str(meta["resourceVersion"])
+                    cont = meta.get("continue")
                     if not cont:
-                        return items, None
+                        return items, None, rv
                     page_params = dict(page_params, **{"continue": cont})
-                return items, page_params.get("continue")
+                return items, page_params.get("continue"), rv
             except Exception as exc:  # tnc: allow-broad-except(re-raised unless 410)
                 status = getattr(exc, "status_code", None)
                 if status is None:
@@ -719,6 +849,21 @@ class KubeClient:
         the API server compacted the snapshot under a slow walk) restarts the
         LIST from scratch once rather than failing the round.
         """
+        items, _rv = self.list_nodes_with_rv(
+            label_selector=label_selector, timeout=timeout, page_limit=page_limit
+        )
+        return items
+
+    def list_nodes_with_rv(
+        self,
+        label_selector: Optional[str] = None,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        page_limit: Optional[int] = LIST_PAGE_LIMIT,
+    ) -> Tuple[List[dict], Optional[str]]:
+        """:meth:`list_nodes` plus the list's ``metadata.resourceVersion`` —
+        the seed a :meth:`watch_nodes` stream resumes from.  One walk, same
+        pagination/410 semantics; ``resource_version`` is ``None`` when the
+        server reports none (offline fixtures)."""
         params = {}
         if label_selector:
             params["labelSelector"] = label_selector
@@ -728,7 +873,7 @@ class KubeClient:
         # keeps 200-ing with a non-advancing continue token.  1000 pages =
         # half a million nodes at the default page size — far past any real
         # cluster, so hitting the cap is a broken server, graded exit 1.
-        items, leftover = self._paged_list(
+        items, leftover, rv = self._paged_list(
             "/api/v1/nodes", params, timeout, max_pages=1000
         )
         if leftover:
@@ -736,7 +881,46 @@ class KubeClient:
                 "LIST /api/v1/nodes did not terminate within 1000 pages "
                 "(non-advancing continue token?)"
             )
-        return items
+        return items, rv
+
+    # A healthy-but-quiet watch stream with bookmarks enabled still ticks
+    # about once a minute; silence past this long means the connection is
+    # dead in a way no FIN ever announced (NAT timeout, yanked cable) and
+    # the reader should surface stream loss instead of waiting forever.
+    WATCH_READ_TIMEOUT_S = 300.0
+
+    def watch_nodes(
+        self,
+        resource_version: Optional[str],
+        label_selector: Optional[str] = None,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        read_timeout: float = WATCH_READ_TIMEOUT_S,
+        allow_bookmarks: bool = True,
+    ):
+        """Open ``GET /api/v1/nodes?watch=1`` as a live line stream.
+
+        Returns a :class:`_StreamingResponse` whose ``iter_lines`` yields
+        one JSON watch event per frame (ADDED/MODIFIED/DELETED/BOOKMARK/
+        ERROR).  Raises :class:`WatchGone` when the server answers 410 —
+        the resourceVersion was compacted away and the caller must relist.
+        Bookmarks are requested by default so the cache's resumption point
+        keeps advancing through quiet stretches.
+        """
+        params = {"watch": "1"}
+        if resource_version:
+            params["resourceVersion"] = str(resource_version)
+        if allow_bookmarks:
+            params["allowWatchBookmarks"] = "true"
+        if label_selector:
+            params["labelSelector"] = label_selector
+        stream = self._session.stream(
+            f"{self.config.server}/api/v1/nodes",
+            params=params,
+            timeout=timeout,
+            read_timeout=read_timeout,
+        )
+        stream.raise_for_status()
+        return stream
 
     # Events-walk bounds: these fetches run against an API server that is
     # ALREADY degraded (the node is sick), possibly for several nodes at
@@ -774,7 +958,7 @@ class KubeClient:
             ),
             "limit": str(limit),
         }
-        items, leftover = self._paged_list(
+        items, leftover, _rv = self._paged_list(
             "/api/v1/events", params, timeout, max_pages=self.EVENTS_MAX_PAGES
         )
         if leftover:

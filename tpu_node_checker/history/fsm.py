@@ -140,10 +140,18 @@ class HealthFSM:
         """Feed one round's verdict; returns ``(from, to)`` on a transition.
 
         ``ok=None`` means *no evidence this round* (a quarantined node whose
-        probe report never arrived): state, streaks and the flap window all
-        hold — absence must neither heal nor sicken, exactly the rule the
-        cordon path applies to ``level="missing"`` reports.
+        probe report never arrived, or — under ``--watch-stream`` — a node
+        the event stream stayed silent about): state, streaks and the flap
+        window all hold — absence must neither heal nor sicken, exactly the
+        rule the cordon path applies to ``level="missing"`` reports.  A
+        silent stream therefore never banks healthy rounds toward
+        ``--uncordon-after`` nor bad rounds toward ``--cordon-after``; only
+        an observed verdict advances a streak.  For a node this machine has
+        never seen, no-evidence observes NOTHING: absence must not mint a
+        HEALTHY machine either.
         """
+        if ok is None and node not in self.nodes and not uncordoned_out_of_band:
+            return None
         h = self.nodes.setdefault(node, NodeHealth())
         before = h.state
         if uncordoned_out_of_band and h.state in (FAILED, CHRONIC):

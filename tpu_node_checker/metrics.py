@@ -54,7 +54,13 @@ Exported metric families:
 * ``tpu_node_checker_watch_breaker_open`` /
   ``tpu_node_checker_watch_breaker_consecutive_failures`` — watch-mode
   circuit-breaker state ("the monitor itself is degraded" is alertable
-  separately from "the fleet is degraded").
+  separately from "the fleet is degraded");
+* ``tpu_node_checker_watch_stream_events_total{type}`` /
+  ``tpu_node_checker_watch_relists_total{reason}`` /
+  ``tpu_node_checker_watch_stream_age_seconds`` — watch-stream mode
+  (``--watch-stream``): events folded into the node cache by type, full
+  LISTs by cause (seed / 410 gone / stream loss — steady state adds none),
+  and seconds since the stream last showed life.
 
 This docstring is the package's metric index: tnc-lint's
 ``drift-readme-metrics`` rule (TNC202) fails CI when a family is emitted
@@ -531,6 +537,40 @@ def render_metrics(
                 "http_5xx; 'none' = zero retries so far).",
                 samples,
             )
+    ws = payload.get("watch_stream")
+    if ws is not None:
+        # Watch-stream mode (--watch-stream): event-driven round telemetry.
+        # events climbing while relists stay flat is the stream doing its
+        # job; relists climbing with it means the stream keeps dying and
+        # every "incremental" round is secretly a full LIST again.
+        events = ws.get("events_total") or {}
+        family(
+            "tpu_node_checker_watch_stream_events_total",
+            "counter",
+            "Watch-stream events consumed since process start, by type "
+            "(ADDED/MODIFIED/DELETED/BOOKMARK/ERROR; 'none' = no events "
+            "yet).",
+            [({"type": t}, float(n)) for t, n in sorted(events.items())]
+            or [({"type": "none"}, 0.0)],
+        )
+        relists = ws.get("relists_total") or {}
+        family(
+            "tpu_node_checker_watch_relists_total",
+            "counter",
+            "Full node LISTs performed, by reason (seed = startup, gone = "
+            "410 resourceVersion expiry, stream_end / stream_error = the "
+            "watch connection died) — steady state adds none.",
+            [({"reason": r}, float(n)) for r, n in sorted(relists.items())]
+            or [({"reason": "none"}, 0.0)],
+        )
+        family(
+            "tpu_node_checker_watch_stream_age_seconds",
+            "gauge",
+            "Seconds since the stream last showed life (an event, a "
+            "bookmark, or a (re)connect) — the staleness detector for the "
+            "event-driven cache.",
+            [({}, float(ws.get("stream_age_seconds", 0.0)))],
+        )
     if "total_nodes" in payload:
         # Partial degradation: 1 when a NON-essential phase (events fetch,
         # cordon/uncordon sweep) lost data this round.  The grade gauges

@@ -51,6 +51,7 @@ from tpu_node_checker.server.snapshot import (
     FleetSnapshot,
     TrendCache,
     build_snapshot,
+    build_snapshot_delta,
 )
 
 # At most one auth-failure notification per this many seconds: a scanner
@@ -231,17 +232,38 @@ class FleetStateServer:
 
     # -- publication (the check loop's side) ---------------------------------
 
-    def publish(self, result, breaker: Optional[dict] = None) -> FleetSnapshot:
+    def publish(
+        self, result, breaker: Optional[dict] = None, changed=None
+    ) -> FleetSnapshot:
         """One completed round → one immutable snapshot, atomically swapped.
 
         Called from the watch loop between rounds; request threads keep
         serving the PREVIOUS snapshot until the assignment lands, so a
         poller never observes a half-built round.
+
+        ``changed`` (watch-stream mode) is the set of node names whose
+        payload entries differ from the previous publish: the new snapshot
+        is then DELTA-built — unchanged per-node entities, fragments and
+        evidence docs carried over from the live snapshot by reference
+        (see :func:`~tpu_node_checker.server.snapshot.build_snapshot_delta`)
+        instead of re-encoded.  ``None`` (poll mode, first round, or a
+        non-round previous snapshot) builds from scratch.
         """
         self._seq += 1
-        snap = build_snapshot(
-            result.payload, result.exit_code, self._seq, round(time.time(), 3)
-        )
+        prev = self._snap
+        if (
+            changed is not None
+            and prev is not None
+            and prev.source == "round"
+        ):
+            snap = build_snapshot_delta(
+                prev, result.payload, result.exit_code, self._seq,
+                round(time.time(), 3), changed,
+            )
+        else:
+            snap = build_snapshot(
+                result.payload, result.exit_code, self._seq, round(time.time(), 3)
+            )
         metrics_body = self._render_fleet_metrics(result, breaker)
         # Swap order: metrics first, snapshot last — the snapshot's seq is
         # what readiness and the hammer test key on.
@@ -254,6 +276,15 @@ class FleetStateServer:
         """Standalone mode: install an externally built (store) snapshot."""
         self._seq = max(self._seq + 1, snap.seq)
         self._snap = snap
+
+    def refresh_metrics(self, result, breaker: Optional[dict] = None) -> None:
+        """A steady watch-stream tick: served content is unchanged (no
+        snapshot swap, every poller's ETag keeps 304-ing) but the scrape
+        surface must keep breathing — ``last_run_timestamp_seconds`` and
+        the stream-age gauge move every tick, or the staleness alerts
+        would fire on a perfectly healthy, merely quiet fleet."""
+        self._metrics_body = self._render_fleet_metrics(result, breaker)
+        self._breaker = breaker
 
     def mark_error(self, breaker: Optional[dict] = None) -> None:
         """A check round failed: the last snapshot keeps serving (state is

@@ -64,11 +64,14 @@ class FleetSnapshot:
     ``entities`` holds the collection endpoints (summary / nodes / slices),
     ``node_entities`` one pre-encoded body per node, and ``node_docs`` the
     raw per-node dicts the control plane's evidence rules read — all
-    build-once, mutate-never.
+    build-once, mutate-never.  ``node_fragments`` keeps each node entry's
+    exact bytes inside the ``nodes`` collection body, so a delta build
+    (:func:`build_snapshot_delta`) re-encodes only the changed entries and
+    byte-joins the rest.
     """
 
     __slots__ = ("seq", "ts", "exit_code", "source", "entities",
-                 "node_entities", "node_docs", "docs")
+                 "node_entities", "node_docs", "docs", "node_fragments")
 
     def __init__(self, seq: int, ts: float, exit_code: Optional[int], source: str):
         self.seq = seq
@@ -78,22 +81,40 @@ class FleetSnapshot:
         self.entities: Dict[str, Entity] = {}
         self.node_entities: Dict[str, Entity] = {}
         self.node_docs: Dict[str, dict] = {}
+        self.node_fragments: Dict[str, bytes] = {}
         # The un-serialized collection docs (references, not copies): what
         # the bench's cold-encode cost model re-encodes per request.
         self.docs: Dict[str, dict] = {}
 
 
-def build_snapshot(
-    payload: dict, exit_code: int, seq: int, ts: float
-) -> FleetSnapshot:
-    """A check round's payload → the round's immutable snapshot.
+def build_fragment(obj) -> bytes:
+    """One node entry's exact bytes inside the ``nodes`` collection body —
+    encoded with the same options ``json_entity`` uses, so fragment-joined
+    bodies are byte-identical to whole-document encodes."""
+    return json.dumps(obj, ensure_ascii=False).encode("utf-8")
 
-    The summary is a roll-up (what a dashboard tile or CI gate polls); the
-    nodes/slices endpoints carry the payload's own entries verbatim — the
-    API must never re-derive (and drift from) what the round computed.
+
+def build_joined_entity(head: dict, key: str, fragments) -> Entity:
+    """``{**head, key: [...]}`` as an Entity, the list byte-joined from
+    pre-encoded fragments instead of re-encoding every element.
+
+    The byte-identity contract with ``json_entity(dict(head, key=list))``
+    is pinned by tests: ``json.dumps`` default separators are ``", "`` /
+    ``": "``, so the head's closing brace is replaced by the joined array.
     """
-    snap = FleetSnapshot(seq, ts, exit_code, "round")
-    nodes = payload.get("nodes") or []
+    prefix = json.dumps(head, ensure_ascii=False)[:-1].encode("utf-8")
+    body = (
+        prefix
+        + f', "{key}": ['.encode("utf-8")
+        + b", ".join(fragments)
+        + b"]}\n"
+    )
+    return Entity(body)
+
+
+def build_summary_doc(payload: dict, exit_code: int, seq: int, ts: float) -> dict:
+    """The fleet roll-up doc (what a dashboard tile or CI gate polls) —
+    ONE definition shared by the full and delta snapshot builders."""
     slices = payload.get("slices") or []
     summary = {
         "round": seq,
@@ -111,24 +132,111 @@ def build_snapshot(
         "degraded": bool(payload.get("degraded")),
     }
     for key in ("probe_summary", "history", "expected_chips",
-                "expected_chips_met", "api_transport"):
+                "expected_chips_met", "api_transport", "watch_stream"):
         if payload.get(key) is not None:
             summary[key] = payload[key]
-    nodes_doc = {"round": seq, "ts": ts, "count": len(nodes), "nodes": nodes}
-    slices_doc = {"round": seq, "ts": ts, "slices": slices}
+    return summary
+
+
+def build_slices_entity(payload: dict, seq: int, ts: float):
+    slices_doc = {"round": seq, "ts": ts, "slices": payload.get("slices") or []}
     if payload.get("multislices") is not None:
         slices_doc["multislices"] = payload["multislices"]
+    return slices_doc, json_entity(slices_doc)
+
+
+def build_snapshot(
+    payload: dict, exit_code: int, seq: int, ts: float
+) -> FleetSnapshot:
+    """A check round's payload → the round's immutable snapshot.
+
+    The summary is a roll-up (what a dashboard tile or CI gate polls); the
+    nodes/slices endpoints carry the payload's own entries verbatim — the
+    API must never re-derive (and drift from) what the round computed.
+    """
+    snap = FleetSnapshot(seq, ts, exit_code, "round")
+    nodes = payload.get("nodes") or []
+    summary = build_summary_doc(payload, exit_code, seq, ts)
+    nodes_doc = {"round": seq, "ts": ts, "count": len(nodes), "nodes": nodes}
+    slices_doc, slices_entity = build_slices_entity(payload, seq, ts)
     snap.docs = {"summary": summary, "nodes": nodes_doc, "slices": slices_doc}
-    for key, doc in snap.docs.items():
-        snap.entities[key] = json_entity(doc)
+    snap.entities["summary"] = json_entity(summary)
+    snap.entities["slices"] = slices_entity
+    fragments = []
     for n in nodes:
+        frag = build_fragment(n)
+        fragments.append(frag)
         name = n.get("name")
         if not isinstance(name, str) or not name:
             continue
         snap.node_docs[name] = n
+        snap.node_fragments[name] = frag
         snap.node_entities[name] = json_entity(
             {"round": seq, "ts": ts, "node": n}
         )
+    snap.entities["nodes"] = build_joined_entity(
+        {"round": seq, "ts": ts, "count": len(nodes)}, "nodes", fragments
+    )
+    return snap
+
+
+def build_snapshot_delta(
+    prev: FleetSnapshot,
+    payload: dict,
+    exit_code: int,
+    seq: int,
+    ts: float,
+    changed,
+) -> FleetSnapshot:
+    """A round's payload → a snapshot that REUSES the previous round's
+    per-node work for every node outside ``changed``.
+
+    The steady-state cost model of the watch-stream tentpole: the summary
+    and slices docs (small) are re-encoded every publish, but per-node
+    entities, evidence docs and collection-body fragments are carried over
+    by reference for unchanged nodes — so a 5k-node fleet with 50 changed
+    nodes pays 50 entry encodes plus one byte-join, not 5 000 encodes.
+    Unchanged per-node entities keep the round/ts of the round that last
+    touched them (their bytes — and therefore ETags — are unchanged by
+    construction: a poller's cached 304 stays valid until the node itself
+    moves).
+
+    ``changed`` is the set of node names whose payload entries differ from
+    the previous round; callers own its correctness.  Nodes absent from
+    ``prev`` are encoded fresh regardless, so an over-small ``prev`` (or a
+    node that flickered out and back) degrades to full-encode, never to a
+    stale entry.
+    """
+    snap = FleetSnapshot(seq, ts, exit_code, "round")
+    nodes = payload.get("nodes") or []
+    summary = build_summary_doc(payload, exit_code, seq, ts)
+    nodes_doc = {"round": seq, "ts": ts, "count": len(nodes), "nodes": nodes}
+    slices_doc, slices_entity = build_slices_entity(payload, seq, ts)
+    snap.docs = {"summary": summary, "nodes": nodes_doc, "slices": slices_doc}
+    snap.entities["summary"] = json_entity(summary)
+    snap.entities["slices"] = slices_entity
+    fragments = []
+    for n in nodes:
+        name = n.get("name")
+        named = isinstance(name, str) and bool(name)
+        if named and name not in changed and name in prev.node_fragments:
+            frag = prev.node_fragments[name]
+            fragments.append(frag)
+            snap.node_docs[name] = prev.node_docs[name]
+            snap.node_fragments[name] = frag
+            snap.node_entities[name] = prev.node_entities[name]
+            continue
+        frag = build_fragment(n)
+        fragments.append(frag)
+        if named:
+            snap.node_docs[name] = n
+            snap.node_fragments[name] = frag
+            snap.node_entities[name] = json_entity(
+                {"round": seq, "ts": ts, "node": n}
+            )
+    snap.entities["nodes"] = build_joined_entity(
+        {"round": seq, "ts": ts, "count": len(nodes)}, "nodes", fragments
+    )
     return snap
 
 

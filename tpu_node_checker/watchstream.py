@@ -1,0 +1,552 @@
+"""Watch-stream incremental rounds: O(changes) steady state.
+
+The poll-mode watch loop re-pulls the entire NodeList every round and
+rebuilds the world — grading, hysteresis, payload, snapshot re-encode —
+even when nothing changed (``nodes5k_paged_internal_p50_ms`` ≈ 177 ms in
+BENCH_r05, paid every interval).  This module replaces the re-LIST with a
+Kubernetes ``watch`` stream and turns the round into a cheap tick over an
+in-memory cache:
+
+* one initial paginated LIST seeds a :class:`NodeCache` keyed by node name
+  and yields the ``resourceVersion`` the stream resumes from;
+* a reader thread (:class:`_StreamWorker`) consumes
+  ``GET /api/v1/nodes?watch=1&allowWatchBookmarks=true`` and folds
+  ADDED/MODIFIED/DELETED events into the cache in place, tracking which
+  nodes' GRADING INPUTS actually changed (kubelet heartbeat timestamps
+  churn constantly; labels/taints/conditions/allocatable rarely do);
+* each round the loop calls :meth:`StreamRoundEngine.tick`: zero pending
+  changes short-circuits to the cached result (sub-millisecond at 5k
+  nodes), otherwise only the changed nodes are re-extracted and fed to the
+  hysteresis FSM, and the caller delta-patches the served snapshot
+  (``server/snapshot.build_snapshot_delta``) instead of re-encoding 5 000
+  unchanged entries;
+* a 410 Gone or any stream loss triggers exactly ONE clean relist through
+  the same retry/backoff ladder every LIST rides; a relist that fails
+  raises out of the tick and charges the existing ``WatchBreaker`` — no
+  second failure path.
+
+Evidence semantics (DESIGN.md §12): a silent stream is *no new evidence*.
+Nodes with no event since the last tick are NOT re-observed by the FSM —
+silence neither banks healthy rounds toward ``--uncordon-after`` nor bad
+rounds toward ``--cordon-after``.  One-shot and poll-mode rounds are
+untouched: this module is reached only behind ``--watch-stream``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+# Watch event types per the Kubernetes API (meta/v1 WatchEvent).
+EVENT_TYPES = ("ADDED", "MODIFIED", "DELETED", "BOOKMARK", "ERROR")
+
+
+def grading_view(node: dict) -> tuple:
+    """The grading-relevant projection of one raw node object.
+
+    Everything ``detect.extract_node_info`` reads — name, labels,
+    annotations, spec (unschedulable/taints), allocatable/capacity, and
+    conditions MINUS their heartbeat timestamps.  Two nodes with equal
+    views grade identically, so a MODIFIED event whose view is unchanged
+    (a kubelet status heartbeat, a lease bump serialized onto the object)
+    updates the cache without dirtying the node — the property that keeps
+    steady-state ticks at O(changes) on a chatty API server.
+    """
+    meta = node.get("metadata") if isinstance(node.get("metadata"), dict) else {}
+    status = node.get("status") if isinstance(node.get("status"), dict) else {}
+    conditions = status.get("conditions")
+    cond_sig: tuple = ()
+    if isinstance(conditions, list):
+        cond_sig = tuple(
+            (
+                c.get("type"),
+                c.get("status"),
+                c.get("reason"),
+                c.get("message"),
+            )
+            for c in conditions
+            if isinstance(c, dict)
+        )
+    return (
+        meta.get("name"),
+        meta.get("labels"),
+        meta.get("annotations"),
+        node.get("spec"),
+        status.get("allocatable"),
+        status.get("capacity"),
+        cond_sig,
+    )
+
+
+class WatchStats:
+    """Thread-shared stream telemetry → ``tpu_node_checker_watch_*``.
+
+    Written by the reader thread (per event) and the engine (per relist /
+    reconnect), read by the tick when it builds the payload's
+    ``watch_stream`` block — every access under the one lock.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: Dict[str, int] = {}
+        self._relists: Dict[str, int] = {}
+        self._last_activity = time.monotonic()
+        self._connected = False
+
+    def count_event(self, etype: str) -> None:
+        with self._lock:
+            self._events[etype] = self._events.get(etype, 0) + 1
+            self._last_activity = time.monotonic()
+
+    def count_relist(self, reason: str) -> None:
+        with self._lock:
+            self._relists[reason] = self._relists.get(reason, 0) + 1
+            self._last_activity = time.monotonic()
+
+    def set_connected(self, connected: bool) -> None:
+        with self._lock:
+            self._connected = connected
+            if connected:
+                self._last_activity = time.monotonic()
+
+    def as_dict(self) -> dict:
+        """The payload's ``watch_stream`` block (a fresh snapshot dict —
+        published payloads are immutable, so counters are copied out)."""
+        with self._lock:
+            return {
+                "events_total": dict(self._events),
+                "relists_total": dict(self._relists),
+                "stream_age_seconds": round(
+                    time.monotonic() - self._last_activity, 3
+                ),
+                "connected": self._connected,
+            }
+
+
+class NodeCache:
+    """The fleet's raw node objects, folded from LIST + watch events.
+
+    One writer thread (the stream reader) applies events; the tick drains
+    the changed-name set.  Raw node dicts are REPLACED whole on every
+    apply, never mutated in place, so references handed out by
+    :meth:`drain` stay safe to read without the lock.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, dict] = {}
+        self._views: Dict[str, tuple] = {}
+        self._changed: Set[str] = set()
+        self._removed: Set[str] = set()
+        self.resource_version: Optional[str] = None
+
+    def seed(self, items: List[dict], resource_version: Optional[str]) -> None:
+        """Replace the cache with a fresh LIST, diffing against what was
+        already held: only nodes that appeared, vanished, or changed their
+        grading view land in the changed/removed sets — a relist after a
+        brief stream hiccup dirties (and later re-encodes) almost nothing."""
+        fresh: Dict[str, dict] = {}
+        fresh_views: Dict[str, tuple] = {}
+        for node in items:
+            meta = node.get("metadata") if isinstance(node.get("metadata"), dict) else {}
+            name = meta.get("name")
+            if not isinstance(name, str) or not name:
+                continue
+            fresh[name] = node
+            fresh_views[name] = grading_view(node)
+        with self._lock:
+            for name, view in fresh_views.items():
+                if self._views.get(name) != view:
+                    self._changed.add(name)
+                self._removed.discard(name)
+            for name in self._nodes:
+                if name not in fresh:
+                    self._removed.add(name)
+                    self._changed.discard(name)
+            self._nodes = fresh
+            self._views = fresh_views
+            self.resource_version = resource_version
+
+    def apply(self, etype: str, obj: dict) -> None:
+        """Fold one ADDED/MODIFIED/DELETED event into the cache."""
+        if not isinstance(obj, dict):
+            return
+        meta = obj.get("metadata") if isinstance(obj.get("metadata"), dict) else {}
+        name = meta.get("name")
+        if not isinstance(name, str) or not name:
+            return
+        rv = meta.get("resourceVersion")
+        view = grading_view(obj) if etype != "DELETED" else None
+        with self._lock:
+            if rv:
+                self.resource_version = str(rv)
+            if etype == "DELETED":
+                self._nodes.pop(name, None)
+                self._views.pop(name, None)
+                self._changed.discard(name)
+                self._removed.add(name)
+                return
+            changed = self._views.get(name) != view
+            self._nodes[name] = obj
+            self._views[name] = view
+            self._removed.discard(name)
+            if changed:
+                self._changed.add(name)
+
+    def note_bookmark(self, obj: dict) -> None:
+        """BOOKMARK events carry only a resourceVersion: advance the
+        resumption point, touch nothing else."""
+        meta = (obj or {}).get("metadata") if isinstance(obj, dict) else None
+        rv = (meta or {}).get("resourceVersion")
+        if rv:
+            with self._lock:
+                self.resource_version = str(rv)
+
+    def pending(self) -> int:
+        """Changed + removed names not yet drained (test/bench seam)."""
+        with self._lock:
+            return len(self._changed) + len(self._removed)
+
+    def drain(self) -> Tuple[Dict[str, dict], FrozenSet[str]]:
+        """Take this tick's deltas: ``(changed name → raw node, removed)``.
+
+        Clears both sets; the returned raw dicts are the cache's current
+        objects (safe: applies replace, never mutate)."""
+        with self._lock:
+            changed = {
+                name: self._nodes[name]
+                for name in self._changed
+                if name in self._nodes
+            }
+            removed = frozenset(self._removed)
+            self._changed = set()
+            self._removed = set()
+            return changed, removed
+
+
+class _StreamWorker(threading.Thread):
+    """Reader thread for ONE established watch stream.
+
+    Deliberately dumb: it decodes frames and folds them into the cache
+    until the stream ends — by clean EOF, 410 replayed as an ERROR event,
+    a decode error, or a socket error/timeout — then records why and
+    exits.  It makes NO API calls: reconnecting and relisting happen in
+    the tick, synchronously, where a failure rides the existing
+    round-failure path (and its breaker) instead of dying unseen in a
+    background thread.
+    """
+
+    def __init__(self, stream, cache: NodeCache, stats: WatchStats):
+        super().__init__(name="tnc-watch-stream", daemon=True)
+        self._stream = stream
+        self._cache = cache
+        self._stats = stats
+        self.exit_reason = "stream_end"
+
+    def run(self) -> None:
+        try:
+            for line in self._stream.iter_lines():
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    # A frame that is not JSON means the decode framing is
+                    # lost — resynchronizing mid-stream is guesswork, and a
+                    # relist re-establishes truth cheaply.
+                    self.exit_reason = "stream_error"
+                    return
+                etype = event.get("type")
+                obj = event.get("object")
+                self._stats.count_event(
+                    etype if etype in EVENT_TYPES else "ERROR"
+                )
+                if etype == "BOOKMARK":
+                    self._cache.note_bookmark(obj)
+                elif etype == "ERROR":
+                    # The in-band 410 replay: a Status object on the stream
+                    # when the resourceVersion expired under us.
+                    code = obj.get("code") if isinstance(obj, dict) else None
+                    self.exit_reason = "gone" if code == 410 else "stream_error"
+                    return
+                elif etype in ("ADDED", "MODIFIED", "DELETED"):
+                    self._cache.apply(etype, obj)
+                # Unknown types are counted (as ERROR) and skipped: a new
+                # event kind must not kill the stream.
+            self.exit_reason = "stream_end"
+        except Exception:  # tnc: allow-broad-except(any read failure — timeout, reset, TLS teardown — is the one 'stream lost' outcome; the tick relists)
+            self.exit_reason = "stream_error"
+        finally:
+            self._stats.set_connected(False)
+            self._stream.close()
+
+
+class StreamRoundEngine:
+    """The watch loop's round engine under ``--watch-stream``.
+
+    Owns the node cache, the stream worker, and the per-node grading
+    caches (NodeInfo + serialized payload entry per node).  ``tick()`` is
+    the whole round: ensure the stream lives (relisting through the retry
+    ladder when it does not), drain the cache's deltas, re-grade only the
+    changed nodes, and return a fresh ``CheckResult`` plus the changed
+    name set the snapshot delta-patcher consumes.
+
+    Single-threaded by contract: ticks run on the watch loop's thread; the
+    only concurrent writer is the stream worker, and the cache/stats locks
+    are the only shared state between them.
+    """
+
+    def __init__(self, args):
+        from tpu_node_checker import checker
+
+        self.args = args
+        self.cache = NodeCache()
+        self.stats = WatchStats()
+        self._registry = checker._registry_from_args(args)
+        self._worker: Optional[_StreamWorker] = None
+        self._stream = None
+        self._client = None
+        self._seeded = False
+        # Per-node grading caches, keyed by node name: the NodeInfo and its
+        # payload entry are rebuilt only when the node's grading view
+        # changed — everything else is reused by reference.
+        self._infos: Dict[str, object] = {}
+        self._entries: Dict[str, dict] = {}
+        self._accel_names: List[str] = []
+        self._entries_list: List[dict] = []
+        self._last_result = None
+        self._last_history_rollup: Optional[dict] = None
+
+    # -- stream lifecycle ----------------------------------------------------
+
+    def _connect(self, timer) -> None:
+        """(Re)establish LIST + WATCH.  Every path that needs a fresh LIST
+        funnels through here, so "full relist only on stream loss" is a
+        structural property, not a convention.
+
+        The dead worker's exit reason is consumed exactly once: if the
+        relist below succeeds but the watch connect then fails (the tick
+        raises into the breaker path), the NEXT tick sees no pending
+        reason and retries only the connect — one stream loss is one
+        relist, never one per failed reconnect attempt.
+        """
+        from tpu_node_checker import checker
+        from tpu_node_checker.cluster import WatchGone, resolve_cluster_config
+
+        reason = None
+        if not self._seeded:
+            reason = "seed"
+        elif self._worker is not None:
+            reason = self._worker.exit_reason
+        self._worker = None
+        with timer.phase("config"):
+            cfg = resolve_cluster_config(
+                getattr(self.args, "kubeconfig", None),
+                getattr(self.args, "context", None),
+            )
+            # Fresh shared retry budget per (re)connect, exactly like a
+            # poll-mode round: the relist rides the same graded ladder.
+            checker._ROUND_POLICY["policy"] = checker._build_retry_policy(self.args)
+            client = checker._cached_client(cfg)
+            self._client = client
+        label_selector = getattr(self.args, "label_selector", None)
+        if reason is not None:
+            with timer.phase("list"):
+                items, rv = client.list_nodes_with_rv(label_selector=label_selector)
+            self.cache.seed(items, rv)
+            self.stats.count_relist(reason)
+            self._seeded = True
+        with timer.phase("watch_connect"):
+            try:
+                stream = client.watch_nodes(
+                    self.cache.resource_version, label_selector=label_selector
+                )
+            except WatchGone:
+                # The LIST's resourceVersion already expired (aggressive
+                # compaction): one more relist, then the connect must stick.
+                items, rv = client.list_nodes_with_rv(label_selector=label_selector)
+                self.cache.seed(items, rv)
+                self.stats.count_relist("gone")
+                stream = client.watch_nodes(
+                    self.cache.resource_version, label_selector=label_selector
+                )
+        self._stream = stream
+        self.stats.set_connected(True)
+        worker = _StreamWorker(stream, self.cache, self.stats)
+        self._worker = worker
+        worker.start()
+
+    def stream_alive(self) -> bool:
+        return self._worker is not None and self._worker.is_alive()
+
+    def abort_stream(self) -> None:
+        """Tear the stream down (failed tick / shutdown): the next tick
+        reconnects from scratch.  Closing the socket is also what unblocks
+        a reader parked in ``readline``."""
+        if self._stream is not None:
+            self._stream.close()
+            self._stream = None
+        self.stats.set_connected(False)
+
+    def close(self) -> None:
+        self.abort_stream()
+
+    # -- the round -----------------------------------------------------------
+
+    def tick(self):
+        """One watch-stream round → ``(CheckResult, changed_names)``.
+
+        ``changed_names`` is the frozenset the snapshot delta-patcher
+        keys on: empty means nothing observable moved and the caller can
+        skip publishing entirely.  Raises (exactly like ``run_check``)
+        when the stream is down and the relist fails — the watch loop's
+        breaker/backoff path handles it.
+        """
+        from tpu_node_checker import checker
+        from tpu_node_checker.utils.timing import PhaseTimer
+
+        timer = PhaseTimer()
+        if not self.stream_alive():
+            self._connect(timer)
+        with timer.phase("drain"):
+            changed_raw, removed = self.cache.drain()
+        if not changed_raw and not removed and self._last_result is not None:
+            return self._steady_result(timer), frozenset()
+        changed = self._grade(changed_raw, removed, timer)
+        result = self._build_result(timer, changed)
+        self._last_result = result
+        return result, changed
+
+    def _grade(self, changed_raw, removed, timer) -> FrozenSet[str]:
+        """Re-extract ONLY the changed nodes; returns the set of payload
+        node names whose entries must be re-encoded downstream."""
+        from tpu_node_checker import checker
+        from tpu_node_checker.detect import extract_node_info
+        from tpu_node_checker.report import _node_entry
+
+        changed_names: Set[str] = set()
+        with timer.phase("detect"):
+            for name in removed:
+                self._infos.pop(name, None)
+                self._entries.pop(name, None)
+                changed_names.add(name)
+            for name, raw in changed_raw.items():
+                info = extract_node_info(raw, self._registry)
+                if info.accelerators > 0 or info.families:
+                    self._infos[name] = info
+                    changed_names.add(name)
+                else:
+                    # A CPU node: invisible to the payload.  If it USED to
+                    # be an accelerator node (label stripped), drop it.
+                    if self._infos.pop(name, None) is not None:
+                        changed_names.add(name)
+                    self._entries.pop(name, None)
+            self._accel_names = sorted(self._infos)
+        history = checker._build_history(self.args)
+        if history is not None:
+            with timer.phase("history"):
+                evidence = [
+                    self._infos[n]
+                    for n in self._accel_names
+                    if n in changed_names
+                ]
+                # Only nodes with fresh events observe a verdict: a silent
+                # stream is no new evidence (DESIGN §12) — state, streaks
+                # and flap windows hold for everyone else.
+                checker._update_history(history, evidence)
+                history["store"].flush()
+            self._last_history_rollup = checker._history_payload(
+                history, [self._infos[n] for n in self._accel_names]
+            )
+        # NOTE: no remediation sweep here — --cordon-failed/--uncordon-
+        # recovered require a probe source (cli.py), and every probe source
+        # is rejected with --watch-stream, so the flags cannot reach this
+        # engine.  When stream mode grows probe-report change detection,
+        # the sweep belongs after the history phase, with any PATCHed node
+        # fed back into changed_names.
+        with timer.phase("render"):
+            for name in changed_names:
+                info = self._infos.get(name)
+                if info is None:
+                    self._entries.pop(name, None)
+                else:
+                    self._entries[name] = _node_entry(info)
+            self._entries_list = [self._entries[n] for n in self._accel_names]
+        return frozenset(changed_names)
+
+    def _build_result(self, timer, changed: FrozenSet[str]):
+        """Assemble a fresh CheckResult over the cached fleet — the
+        grading itself is ``checker.grade_fleet``, the SAME ladder
+        ``run_check`` applies, so the two modes cannot drift; only the
+        per-node work is amortized into the caches."""
+        from tpu_node_checker import checker
+        from tpu_node_checker.detect import group_multislices, group_slices
+
+        accel = [self._infos[n] for n in self._accel_names]
+        ready = [n for n in accel if n.ready and n.schedulable]
+        effective_ready = [n for n in ready if n.effectively_ready]
+        with timer.phase("slices"):
+            slices = group_slices(accel)
+            multislices = group_multislices(
+                slices, getattr(self.args, "multislice_label", None) or ()
+            )
+        exit_code, expected_key, expected_n, have_chips = checker.grade_fleet(
+            self.args, accel, effective_ready, slices
+        )
+        with timer.phase("payload"):
+            payload = {
+                "total_nodes": len(accel),
+                "ready_nodes": len(effective_ready),
+                "total_chips": sum(n.accelerators for n in accel),
+                "ready_chips": sum(n.accelerators for n in effective_ready),
+                "nodes": self._entries_list,
+                "slices": [s.to_dict() for s in slices],
+            }
+            if multislices:
+                payload["multislices"] = [m.to_dict() for m in multislices]
+            checker.stamp_expected_chips(
+                payload, expected_key, expected_n, have_chips
+            )
+            if self._last_history_rollup is not None:
+                payload["history"] = self._last_history_rollup
+            if self._client is not None:
+                stats = getattr(self._client, "transport_stats", lambda: {})()
+                if stats:
+                    payload["api_transport"] = stats
+            payload["watch_stream"] = self.stats.as_dict()
+            payload["exit_code"] = exit_code
+        payload["timings_ms"] = timer.as_dict()
+        result = checker.CheckResult(
+            exit_code=exit_code,
+            accel=accel,
+            ready=effective_ready,
+            slices=slices,
+            multislices=multislices,
+            payload=payload,
+        )
+        return result
+
+    def _steady_result(self, timer):
+        """Zero pending changes: a fresh result object wrapping the cached
+        round.  The top-level payload dict is NEW (published snapshots
+        reference the old one and must never see mutation); the heavy
+        sub-objects — node entries, slices — are shared by reference.  The
+        transition log is emptied: an actionable transition alerts on the
+        tick that observed it, never again on every silent tick after.
+        """
+        from tpu_node_checker import checker
+
+        last = self._last_result
+        payload = dict(last.payload)
+        if payload.get("history") is not None:
+            payload["history"] = {**payload["history"], "transitions": []}
+        payload["watch_stream"] = self.stats.as_dict()
+        payload["timings_ms"] = timer.as_dict()
+        return checker.CheckResult(
+            exit_code=last.exit_code,
+            accel=last.accel,
+            ready=last.ready,
+            slices=last.slices,
+            multislices=last.multislices,
+            payload=payload,
+        )
