@@ -1,0 +1,28 @@
+"""Seeds for TNC011 on the worker-pool shape: the accept-loop READ path
+(fast responders, header extraction) takes no locks — a lock there
+serializes every worker — while accept-side bookkeeping (connection
+registry, shed guard) legitimately may."""
+
+import threading
+
+
+class AcceptWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._routes = {}
+        self._accepted = 0
+
+    def _respond_fast(self, line, out):
+        with self._lock:  # EXPECT[TNC011]
+            route = self._routes.get(line)
+        if route is not None:
+            out += route
+        return route
+
+    def _get_route(self, line):
+        return self._routes.get(line)  # near-miss: lock-free read path
+
+    def _count_accept(self, conn):  # near-miss: accept bookkeeping, not the read path
+        with self._lock:
+            self._accepted += 1
+        return conn

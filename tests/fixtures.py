@@ -14,6 +14,8 @@ Configs (BASELINE.json):
 
 from __future__ import annotations
 
+import json
+import time
 from typing import List, Optional
 
 
@@ -698,3 +700,124 @@ def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = No
             pass
 
     return Handler
+
+
+# ---------------------------------------------------------------------------
+# Fleet-API poller hammer (shared by tests/test_server.py, the serving-scale
+# tests and bench.py's load harness)
+# ---------------------------------------------------------------------------
+
+
+def hammer_fleet_api(port, paths, swaps, clients=16, reconnect=False,
+                     thread_prefix="tnc-test-hammer"):
+    """``clients`` keep-alive pollers loop over ``paths`` (re-sending each
+    path's last ETag) while ``swaps()`` runs on the caller's thread; returns
+    the flat ``[(path, status, etag, body)]`` record list.
+
+    ``reconnect=True`` makes a poller redial on connection loss instead of
+    failing — the worker-restart hammer: a killed connection yields no
+    record (the in-flight response may be torn), every COMPLETED response
+    still lands in the records for the 200/304 contract check.  Without it,
+    any client error fails the caller via the returned ``errors`` being
+    asserted empty here.
+    """
+    import http.client
+    import threading
+
+    done = threading.Event()
+    start = threading.Barrier(clients + 1)
+    records = [[] for _ in range(clients)]
+    errors = []
+
+    def dial():
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                return http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 5s redial backoff against a REAL listener mid-restart)
+
+    def worker(slot):
+        conn = dial()
+        try:
+            start.wait(timeout=10)
+            last_etag = {}
+            while not done.is_set():
+                for path in paths:
+                    headers = {}
+                    if path in last_etag:
+                        headers["If-None-Match"] = last_etag[path]
+                    try:
+                        conn.request("GET", path, headers=headers)
+                        resp = conn.getresponse()
+                        body = resp.read()
+                    except (OSError, http.client.HTTPException):
+                        if not reconnect:
+                            raise
+                        # The worker under this connection was restarted:
+                        # drop the in-flight exchange, redial, carry on.
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        conn = dial()
+                        continue
+                    etag = resp.headers.get("ETag")
+                    if resp.status == 200:
+                        last_etag[path] = etag
+                    records[slot].append((path, resp.status, etag, body))
+        except Exception as exc:  # noqa: BLE001 — surfaced as a failure below
+            errors.append(f"client {slot}: {exc!r}")
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(
+            target=worker, args=(i,), name=f"{thread_prefix}-{i}", daemon=True
+        )
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    start.wait(timeout=10)
+    swaps()
+    done.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "hammer client wedged"
+    assert not errors, errors
+    flat = [r for rec in records for r in rec]
+    assert len(flat) > clients, "the hammer never actually hammered"
+    return flat
+
+
+def assert_poll_contract(flat, bijection=True):
+    """The serving contract over hammer records: nothing outside 200/304,
+    every 200 parses (no torn reads), and — when ``bijection`` — ETag ↔
+    body ↔ round is a bijection per path (one ETag never names two bodies
+    or spans two rounds)."""
+    assert {status for _, status, _, _ in flat} <= {200, 304}, sorted(
+        {status for _, status, _, _ in flat}
+    )
+    etag_to_round = {}
+    etag_to_body = {}
+    rounds_seen = set()
+    for path, status, etag, body in flat:
+        if status != 200:
+            continue
+        doc = json.loads(body)  # raises on a torn body
+        if not bijection:
+            continue
+        rnd = doc["round"]
+        rounds_seen.add(rnd)
+        key = (path, etag)
+        assert etag_to_body.setdefault(key, body) == body
+        assert etag_to_round.setdefault(key, rnd) == rnd
+    if bijection:
+        per_round_etags = {}
+        for (path, etag), rnd in etag_to_round.items():
+            per_round_etags.setdefault((path, rnd), set()).add(etag)
+        assert all(len(v) == 1 for v in per_round_etags.values())
+    return rounds_seen

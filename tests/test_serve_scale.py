@@ -1,0 +1,518 @@
+"""Serving at scale: the SO_REUSEPORT worker pool, the fast path, the
+write-path token bucket, and stale-while-revalidate trend serving.
+
+The contract under test, layer by layer:
+
+* the **fast path** (prebuilt wire responses keyed on request-line bytes)
+  answers exactly what the routed path would — status, body, ETag,
+  negotiation headers — for every GET shape it claims (plain / gzip /
+  If-None-Match), and everything else falls through to the routed stack;
+* the **worker pool** serves one port from N accept loops, survives
+  rolling worker restarts under a reconnecting hammer with nothing but
+  200/304 on completed exchanges, falls back to a single listener where
+  ``SO_REUSEPORT`` is missing, and sheds connections over the per-worker
+  cap with a fast 503 instead of pinning handler threads;
+* the **token bucket** refuses authenticated writes over ``--write-rps``
+  with 429 + a ``Retry-After`` that round-trips through
+  ``utils/retry.parse_retry_after`` (fake clock, zero real sleeps);
+* **SWR trend serving** hands a reader the stale entity the instant the
+  signature moves and rebuilds exactly once per change, off-thread.
+
+Same wall-clock policy as tests/test_server.py: waits are bounded polls on
+REAL cross-thread effects, never pacing sleeps, and every test is timed.
+"""
+
+import gzip
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+from tpu_node_checker.server import workers as workers_mod
+from tpu_node_checker.server.app import FleetStateServer
+from tpu_node_checker.server.ratelimit import TokenBucket, retry_after_header
+from tpu_node_checker.server.snapshot import (
+    TrendCache,
+    build_snapshot,
+    build_snapshot_delta,
+)
+from tpu_node_checker.utils.retry import parse_retry_after
+
+WALL_CLOCK_BUDGET_S = 20.0
+
+
+@pytest.fixture(autouse=True)
+def _wall_clock_guard():
+    t0 = time.perf_counter()
+    yield
+    elapsed = time.perf_counter() - t0
+    assert elapsed < WALL_CLOCK_BUDGET_S, (
+        f"serve-scale test burned {elapsed:.1f}s of wall-clock — a real "
+        "sleep or a wedged handler leaked in"
+    )
+
+
+def _result(nodes=None):
+    args = cli.parse_args(["--json"])
+    return checker.run_check(
+        args,
+        nodes=[json.loads(json.dumps(n))
+               for n in (nodes or fx.tpu_v5e_256_slice())],
+    )
+
+
+def _req(port, method, path, headers=None, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.headers.items()), resp.read()
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# Fast path ≡ routed path
+# ---------------------------------------------------------------------------
+
+
+class TestFastPathParity:
+    PARITY_HEADERS = ("ETag", "Content-Type", "Content-Length", "Vary",
+                      "Cache-Control", "Content-Encoding")
+
+    def _server(self):
+        srv = FleetStateServer(0, host="127.0.0.1")
+        srv.publish(_result())
+        return srv
+
+    def _pair(self, srv, path, headers):
+        """The same GET through both stacks: the bare path rides the fast
+        table; a query string misses the request-line key and rides the
+        routed fallback into the identical entity."""
+        fast = _req(srv.port, "GET", path, headers)
+        routed = _req(srv.port, "GET", path + "?routed=1", headers)
+        return fast, routed
+
+    @pytest.mark.parametrize("path", ["/api/v1/summary", "/api/v1/nodes",
+                                      "/api/v1/slices"])
+    def test_plain_get_parity(self, path):
+        srv = self._server()
+        try:
+            assert srv.fast_routes, "publish built no fast table"
+            (fs, fh, fb), (rs, rh, rb) = self._pair(srv, path, {})
+            assert (fs, fb) == (rs, rb)
+            for key in self.PARITY_HEADERS:
+                assert fh.get(key) == rh.get(key), key
+        finally:
+            srv.close()
+
+    def test_gzip_and_304_parity(self):
+        srv = self._server()
+        try:
+            gz_headers = {"Accept-Encoding": "gzip, br"}
+            (fs, fh, fb), (rs, rh, rb) = self._pair(
+                srv, "/api/v1/nodes", gz_headers
+            )
+            assert fs == rs == 200
+            assert fh["Content-Encoding"] == rh["Content-Encoding"] == "gzip"
+            assert gzip.decompress(fb) == gzip.decompress(rb)
+            etag = fh["ETag"]
+            for headers in (
+                {"If-None-Match": etag},
+                {"If-None-Match": f'"nope", {etag}'},  # list form
+                {"If-None-Match": f"W/{etag}"},        # weak compare
+                {"If-None-Match": "*"},
+            ):
+                (fs, fh, fb), (rs, _, _) = self._pair(
+                    srv, "/api/v1/nodes", headers
+                )
+                assert fs == rs == 304, headers
+                assert fb == b"" and fh["ETag"] == etag
+        finally:
+            srv.close()
+
+    def test_non_fast_shapes_fall_through(self):
+        srv = self._server()
+        try:
+            # HEAD rides the routed stack but keeps the GET's headers.
+            g = _req(srv.port, "GET", "/api/v1/summary")
+            h = _req(srv.port, "HEAD", "/api/v1/summary")
+            assert h[0] == 200 and h[2] == b""
+            assert h[1]["Content-Length"] == str(len(g[2]))
+            assert h[1]["ETag"] == g[1]["ETag"]
+            # Unknown path / wrong method keep the routed answers.
+            assert _req(srv.port, "GET", "/api/v2/summary")[0] == 404
+            status, headers, _ = _req(srv.port, "POST", "/api/v1/summary")
+            assert status == 405 and "GET" in headers["Allow"]
+        finally:
+            srv.close()
+
+    def test_malformed_and_oversized_requests_are_bounded(self):
+        srv = self._server()
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port), timeout=10) as s:
+                s.sendall(b"NONSENSE\r\n\r\n")
+                assert s.recv(1024).startswith(b"HTTP/1.1 400 ")
+            with socket.create_connection(("127.0.0.1", srv.port), timeout=10) as s:
+                s.sendall(b"GET / HTTP/1.1\r\nX-Pad: " + b"x" * 70000)
+                assert s.recv(1024).startswith(b"HTTP/1.1 431 ")
+        finally:
+            srv.close()
+
+    def test_pipelined_requests_batch_on_one_connection(self):
+        srv = self._server()
+        try:
+            etag = _req(srv.port, "GET", "/api/v1/summary")[1]["ETag"]
+            req = (
+                "GET /api/v1/summary HTTP/1.1\r\nHost: x\r\n"
+                f"If-None-Match: {etag}\r\n\r\n"
+            ).encode()
+            with socket.create_connection(("127.0.0.1", srv.port), timeout=10) as s:
+                s.sendall(req * 50)
+                got = b""
+                while got.count(b"HTTP/1.1 304") < 50:
+                    data = s.recv(1 << 20)
+                    assert data, "server closed mid-pipeline"
+                    got += data
+            # The batch landed in requests_total in one merge.
+            _, _, body = _req(srv.port, "GET", "/metrics")
+            assert (
+                'tpu_node_checker_api_server_requests_total{method="GET",'
+                'route="/api/v1/summary",status="304"}' in body.decode()
+            )
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Worker pool: multi-worker serving, rolling restarts, fallback, shedding
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerPool:
+    def test_multi_worker_shares_one_port(self):
+        srv = FleetStateServer(0, host="127.0.0.1", workers=2)
+        try:
+            assert srv.workers_active == 2 and srv.reuseport
+            srv.publish(_result())
+            # Many fresh connections: the kernel spreads them over both
+            # accept loops; every one answers the same round.
+            for _ in range(8):
+                status, _, body = _req(srv.port, "GET", "/api/v1/summary")
+                assert status == 200 and json.loads(body)["round"] == 1
+            _, _, body = _req(srv.port, "GET", "/metrics")
+            assert "tpu_node_checker_api_server_workers 2.0" in body.decode()
+        finally:
+            srv.close()
+
+    def test_hammer_bijection_across_worker_restarts(self):
+        # The acceptance-shape hammer: reconnecting pollers see ONLY
+        # 200/304 on completed exchanges while rounds publish and workers
+        # roll one at a time underneath.
+        srv = FleetStateServer(0, host="127.0.0.1", workers=2)
+        result = _result()
+        srv.publish(result)
+        try:
+            def swaps():
+                for i in range(6):
+                    srv.publish(result)
+                    srv.restart_worker(i % srv.workers_active)
+
+            flat = fx.hammer_fleet_api(
+                srv.port, ("/api/v1/summary", "/api/v1/nodes"), swaps,
+                clients=8, reconnect=True,
+                thread_prefix="tnc-test-restart-hammer",
+            )
+            rounds_seen = fx.assert_poll_contract(flat)
+            assert rounds_seen  # completed 200s were actually observed
+            assert srv.workers_active == 2  # every restart re-filled the pool
+        finally:
+            srv.close()
+
+    def test_single_listener_fallback_without_reuseport(self, monkeypatch):
+        monkeypatch.setattr(workers_mod, "reuseport_available", lambda: False)
+        srv = FleetStateServer(0, host="127.0.0.1", workers=4)
+        try:
+            assert srv.workers_active == 1 and not srv.reuseport
+            srv.publish(_result())
+            assert _req(srv.port, "GET", "/api/v1/summary")[0] == 200
+        finally:
+            srv.close()
+
+    def test_slow_loris_pool_is_shed_not_seated(self):
+        # Two idle connections fill the per-worker cap; the third is
+        # answered 503 straight from the accept loop.  Freeing a slot
+        # seats new connections again.
+        srv = FleetStateServer(0, host="127.0.0.1", max_connections=2)
+        srv.publish(_result())
+        try:
+            loris = [
+                socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+                for _ in range(2)
+            ]
+            status, headers, _ = _req(srv.port, "GET", "/api/v1/summary")
+            assert status == 503
+            assert headers.get("Connection") == "close"
+            assert headers.get("Retry-After")
+            loris[0].close()
+            deadline = time.monotonic() + 10
+            status = None
+            while time.monotonic() < deadline:
+                status = _req(srv.port, "GET", "/api/v1/summary")[0]
+                if status == 200:
+                    break
+                time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL handler thread to notice the closed socket and free its slot)
+            assert status == 200
+            loris[1].close()
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Write-path token bucket
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clock = FakeClock()
+        bucket = TokenBucket(rate=2.0, burst=3.0, monotonic=clock)
+        assert [bucket.try_acquire() for _ in range(3)] == [0.0, 0.0, 0.0]
+        wait = bucket.try_acquire()
+        assert wait == pytest.approx(0.5)  # 1 token at 2/s
+        clock.t += wait
+        assert bucket.try_acquire() == 0.0
+        # Refill caps at burst: a long quiet spell buys burst, not more.
+        clock.t += 3600.0
+        assert [bucket.try_acquire() for _ in range(3)] == [0.0, 0.0, 0.0]
+        assert bucket.try_acquire() > 0.0
+
+    def test_default_burst_floors_at_one(self):
+        clock = FakeClock()
+        bucket = TokenBucket(rate=0.1, monotonic=clock)
+        assert bucket.burst == 1.0
+        assert bucket.try_acquire() == 0.0
+        assert bucket.try_acquire() == pytest.approx(10.0)
+
+    def test_rate_must_be_positive(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0.0)
+
+    def test_retry_after_round_trips_through_the_retry_parser(self):
+        # The 429's Retry-After must be parseable by the SAME parser the
+        # checker's retry ladder uses, and honoring it must always find a
+        # token: ceil + floor-at-1 ≥ the true wait.
+        for wait in (0.05, 0.5, 1.0, 1.2, 7.9):
+            header = retry_after_header(wait)
+            parsed = parse_retry_after(header)
+            assert parsed is not None and parsed >= wait
+            assert parsed == float(int(header))  # delta-seconds form
+
+
+class TestWriteRateLimitEndToEnd:
+    def _server(self, limiter):
+        calls = []
+
+        def control(name, action, dry_run, node, snap):
+            calls.append((name, action))
+            return 200, {"applied": True}
+
+        srv = FleetStateServer(
+            0, host="127.0.0.1", token="s3cret", control=control,
+            write_limiter=limiter,
+        )
+        srv.publish(_result())
+        return srv, calls
+
+    def test_429_with_retry_after_then_recovery(self):
+        clock = FakeClock()
+        srv, calls = self._server(
+            TokenBucket(rate=1.0, burst=2.0, monotonic=clock)
+        )
+        node = json.loads(
+            _req(srv.port, "GET", "/api/v1/nodes")[2]
+        )["nodes"][0]["name"]
+        auth = {"Authorization": "Bearer s3cret"}
+        path = f"/api/v1/nodes/{node}/cordon"
+        try:
+            assert _req(srv.port, "POST", path, auth)[0] == 200
+            assert _req(srv.port, "POST", path, auth)[0] == 200
+            status, headers, body = _req(srv.port, "POST", path, auth)
+            assert status == 429
+            assert len(calls) == 2  # the refused request never reached control
+            wait = parse_retry_after(headers["Retry-After"])
+            assert wait is not None and wait >= 1
+            doc = json.loads(body)
+            assert doc["node"] == node and "rate limit" in doc["error"]
+            # Honoring the header finds a token (fake clock, no sleeping).
+            clock.t += wait
+            assert _req(srv.port, "POST", path, auth)[0] == 200
+            _, _, metrics = _req(srv.port, "GET", "/metrics")
+            assert (
+                "tpu_node_checker_api_server_rate_limited_total 1.0"
+                in metrics.decode()
+            )
+        finally:
+            srv.close()
+
+    def test_auth_rejections_bypass_the_bucket(self):
+        # 401s must not burn tokens: a scanner cannot starve the
+        # legitimate token holder by being refused fast.
+        clock = FakeClock()
+        srv, calls = self._server(
+            TokenBucket(rate=1.0, burst=1.0, monotonic=clock)
+        )
+        try:
+            for _ in range(3):
+                assert _req(
+                    srv.port, "POST", "/api/v1/nodes/x/cordon",
+                    {"Authorization": "Bearer wrong"},
+                )[0] == 401
+            node = json.loads(
+                _req(srv.port, "GET", "/api/v1/nodes")[2]
+            )["nodes"][0]["name"]
+            assert _req(
+                srv.port, "POST", f"/api/v1/nodes/{node}/cordon",
+                {"Authorization": "Bearer s3cret"},
+            )[0] == 200
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Stale-while-revalidate trend serving
+# ---------------------------------------------------------------------------
+
+
+class TestTrendSWR:
+    def _cache(self, tmp_path, monkeypatch):
+        log = tmp_path / "trend.jsonl"
+        log.write_text(
+            json.dumps({"ts": 1_700_000_000.0, "exit_code": 0}) + "\n"
+        )
+        release = threading.Event()
+        builds = []
+
+        real = checker.compute_trend_summary
+
+        def gated(path):
+            builds.append(path)
+            if len(builds) > 1:  # rebuilds block until the test releases
+                assert release.wait(timeout=10)
+            return real(path)
+
+        monkeypatch.setattr(checker, "compute_trend_summary", gated)
+        return TrendCache(str(log)), log, release, builds
+
+    def test_stale_served_during_rebuild_exactly_one_rebuild(
+        self, tmp_path, monkeypatch
+    ):
+        cache, log, release, builds = self._cache(tmp_path, monkeypatch)
+        first = cache.entity(1)  # first build: synchronous
+        assert cache.rebuilds == 1 and len(builds) == 1
+        assert cache.entity(1) is first  # steady state: cache hit
+        with open(log, "a") as f:
+            f.write(json.dumps({"ts": 1_700_000_060.0, "exit_code": 3}) + "\n")
+        # Signature moved: readers get the STALE entity immediately while
+        # the one rebuild blocks on the gate.
+        for _ in range(3):
+            assert cache.entity(1) is first
+        assert cache.stale_served == 3
+        assert len(builds) == 2  # exactly one background rebuild spawned
+        release.set()
+        deadline = time.monotonic() + 10
+        while cache.rebuilds < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL tnc-trend-swr thread to commit its entity)
+        assert cache.rebuilds == 2
+        fresh = cache.entity(1)
+        assert fresh is not first
+        assert json.loads(fresh.raw)["rounds"] == 2
+        assert len(builds) == 2  # the fresh entity is a cache hit, no rebuild
+
+    def test_seq_move_also_revalidates_async(self, tmp_path, monkeypatch):
+        cache, _log, release, builds = self._cache(tmp_path, monkeypatch)
+        release.set()
+        first = cache.entity(1)
+        assert cache.entity(2) is first  # stale on seq move, rebuild spawned
+        deadline = time.monotonic() + 10
+        while cache.rebuilds < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL tnc-trend-swr thread to commit its entity)
+        assert cache.rebuilds == 2 and len(builds) == 2
+
+
+# ---------------------------------------------------------------------------
+# Publish-time compression: delta gz members, /metrics split
+# ---------------------------------------------------------------------------
+
+
+class TestPublishTimeCompression:
+    def _rounds(self):
+        nodes = fx.tpu_v5p_64_slice()[:8]
+        r1 = _result(nodes)
+        sick = [json.loads(json.dumps(n)) for n in nodes]
+        sick[3]["status"]["conditions"][1]["status"] = "False"
+        r2 = _result(sick)
+        return nodes, r1, r2
+
+    def test_delta_gz_members_decompress_to_the_full_body(self):
+        nodes, r1, r2 = self._rounds()
+        changed = {nodes[3]["metadata"]["name"]}
+        prev = build_snapshot(r1.payload, r1.exit_code, 1, 100.0)
+        delta = build_snapshot_delta(
+            prev, r2.payload, r2.exit_code, 2, 200.0, changed
+        )
+        entity = delta.entities["nodes"]
+        assert entity.gz is not None
+        assert gzip.decompress(entity.gz) == entity.raw
+
+    def test_unchanged_gz_fragments_reuse_by_reference(self):
+        nodes, r1, r2 = self._rounds()
+        changed = {nodes[3]["metadata"]["name"]}
+        prev = build_snapshot(r1.payload, r1.exit_code, 1, 100.0)
+        d1 = build_snapshot_delta(
+            prev, r2.payload, r2.exit_code, 2, 200.0, changed
+        )
+        d2 = build_snapshot_delta(
+            d1, r1.payload, r1.exit_code, 3, 300.0, changed
+        )
+        for n in nodes:
+            name = n["metadata"]["name"]
+            if name in changed:
+                assert d2.node_gz_fragments[name] is not d1.node_gz_fragments[name]
+            else:
+                # Deflated once (the migration delta), reused forever after.
+                assert d2.node_gz_fragments[name] is d1.node_gz_fragments[name]
+        assert gzip.decompress(d2.entities["nodes"].gz) == d2.entities["nodes"].raw
+
+    def test_metrics_gzip_is_member_concatenation_of_the_plain_body(self):
+        srv = FleetStateServer(0, host="127.0.0.1")
+        srv.publish(_result())
+        try:
+            status, headers, gz_body = _req(
+                srv.port, "GET", "/metrics", {"Accept-Encoding": "gzip"}
+            )
+            assert status == 200 and headers["Content-Encoding"] == "gzip"
+            text = gzip.decompress(gz_body).decode()
+            # Round families from the cached prefix member + live stats
+            # families from the per-scrape member, one coherent exposition.
+            assert 'tpu_node_checker_chips{state="ready"} 256' in text
+            assert "tpu_node_checker_api_server_requests_total" in text
+            assert "tpu_node_checker_api_server_workers 1.0" in text
+            assert "tpu_node_checker_api_server_swr_stale_served_total 0" in text
+            plain = _req(srv.port, "GET", "/metrics")[2].decode()
+            assert 'tpu_node_checker_chips{state="ready"} 256' in plain
+        finally:
+            srv.close()

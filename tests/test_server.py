@@ -404,63 +404,26 @@ class TestDeltaSnapshots:
         nodes, r1, r2 = self._two_rounds()
         changed = frozenset({nodes[3]["metadata"]["name"]})
         server.publish(r1)
-        port = server.port
-        done = threading.Event()
-        start = threading.Barrier(17)
-        records = [[] for _ in range(16)]
-        errors = []
+        paths = (
+            "/api/v1/summary", "/api/v1/nodes",
+            "/api/v1/nodes/" + nodes[0]["metadata"]["name"],
+            "/api/v1/nodes/" + nodes[3]["metadata"]["name"],
+        )
 
-        def worker(slot):
-            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
-            try:
-                start.wait(timeout=10)
-                last_etag = {}
-                paths = (
-                    "/api/v1/summary", "/api/v1/nodes",
-                    "/api/v1/nodes/" + nodes[0]["metadata"]["name"],
-                    "/api/v1/nodes/" + nodes[3]["metadata"]["name"],
-                )
-                while not done.is_set():
-                    for path in paths:
-                        headers = {}
-                        if path in last_etag:
-                            headers["If-None-Match"] = last_etag[path]
-                        conn.request("GET", path, headers=headers)
-                        resp = conn.getresponse()
-                        body = resp.read()
-                        if resp.status == 200:
-                            last_etag[path] = resp.headers.get("ETag")
-                        records[slot].append((path, resp.status, body))
-            except Exception as exc:  # noqa: BLE001 — surfaced as a failure below
-                errors.append(f"client {slot}: {exc!r}")
-            finally:
-                conn.close()
+        def swaps():
+            # 25 live incremental swaps, alternating the sick/healthy
+            # rounds, every one a delta publish against the snapshot in
+            # service.
+            for i in range(25):
+                server.publish(r2 if i % 2 == 0 else r1, changed=changed)
 
-        threads = [
-            threading.Thread(
-                target=worker, args=(i,), name=f"tnc-test-delta-hammer-{i}",
-                daemon=True,
-            )
-            for i in range(16)
-        ]
-        for t in threads:
-            t.start()
-        start.wait(timeout=10)
-        # 25 live incremental swaps, alternating the sick/healthy rounds,
-        # every one a delta publish against the snapshot in service.
-        for i in range(25):
-            server.publish(r2 if i % 2 == 0 else r1, changed=changed)
-        done.set()
-        for t in threads:
-            t.join(timeout=10)
-            assert not t.is_alive(), "delta-hammer client wedged"
-        assert not errors, errors
-        flat = [r for rec in records for r in rec]
-        assert len(flat) > 16
-        assert {status for _, status, _ in flat} <= {200, 304}
-        for _, status, body in flat:
-            if status == 200:
-                json.loads(body)  # raises on a torn body
+        flat = fx.hammer_fleet_api(
+            server.port, paths, swaps, thread_prefix="tnc-test-delta-hammer"
+        )
+        # Per-node entities keep their round stamp across delta publishes
+        # by design (a node's round is its last-modified round), so the
+        # bijection here is the 200/304 + parses contract only.
+        fx.assert_poll_contract(flat, bijection=False)
 
 
 # ---------------------------------------------------------------------------
@@ -474,84 +437,28 @@ class TestHammer:
     ROUNDS = 25
 
     def test_no_torn_reads_no_500s_etag_stable_within_round(self, server):
+        # The client loop + bijection checks live in tests/fixtures.py
+        # (hammer_fleet_api / assert_poll_contract) so the serving-scale
+        # tests and bench.py's load harness hammer with the SAME contract.
         nodes = fx.tpu_v5p_64_slice()[:8]
         result = _result(nodes)
         server.publish(result)
-        port = server.port
-        done = threading.Event()
-        start = threading.Barrier(self.CLIENTS + 1)
-        records = [[] for _ in range(self.CLIENTS)]
-        errors = []
+        paths = self.ENDPOINTS + (
+            "/api/v1/nodes/" + nodes[0]["metadata"]["name"],
+        )
 
-        def worker(slot):
-            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
-            try:
-                start.wait(timeout=10)
-                last_etag = {}
-                while not done.is_set():
-                    for path in self.ENDPOINTS + ("/api/v1/nodes/" + nodes[0]["metadata"]["name"],):
-                        headers = {}
-                        if path in last_etag:
-                            headers["If-None-Match"] = last_etag[path]
-                        conn.request("GET", path, headers=headers)
-                        resp = conn.getresponse()
-                        body = resp.read()
-                        etag = resp.headers.get("ETag")
-                        if resp.status == 200:
-                            last_etag[path] = etag
-                        records[slot].append((path, resp.status, etag, body))
-            except Exception as exc:  # noqa: BLE001 — surfaced as a failure below
-                errors.append(f"client {slot}: {exc!r}")
-            finally:
-                conn.close()
+        def swaps():
+            # Swap ROUNDS snapshots under the pollers — no pacing, the
+            # tightest interleave we can produce.
+            for _ in range(self.ROUNDS):
+                server.publish(result)
 
-        threads = [
-            threading.Thread(
-                target=worker, args=(i,), name=f"tnc-test-hammer-{i}",
-                daemon=True,
-            )
-            for i in range(self.CLIENTS)
-        ]
-        for t in threads:
-            t.start()
-        start.wait(timeout=10)
-        # Swap ROUNDS snapshots under the pollers — no pacing, the tightest
-        # interleave we can produce.
-        for _ in range(self.ROUNDS):
-            server.publish(result)
-        done.set()
-        for t in threads:
-            t.join(timeout=10)
-            assert not t.is_alive(), "hammer client wedged"
-        assert not errors, errors
-
-        flat = [r for rec in records for r in rec]
-        assert len(flat) > self.CLIENTS  # the hammer actually hammered
-        # Zero 500s, zero anything outside the 200/304 contract.
-        assert {status for _, status, _, _ in flat} <= {200, 304}
-        # Every 200 is complete, valid JSON — no torn reads mid-swap.
-        etag_to_round = {}
-        etag_to_body = {}
-        rounds_seen = set()
-        for path, status, etag, body in flat:
-            if status != 200:
-                continue
-            doc = json.loads(body)  # raises on a torn body
-            rnd = doc["round"]
-            rounds_seen.add(rnd)
-            key = (path, etag)
-            # ETag ↔ representation is a bijection: one ETag never names
-            # two bodies (stable within a round) ...
-            assert etag_to_body.setdefault(key, body) == body
-            # ... and one ETag never spans two rounds (changes across rounds).
-            assert etag_to_round.setdefault(key, rnd) == rnd
-        # Distinct rounds were actually observed mid-flight, and each
-        # (path, round) pair carried exactly one ETag.
+        flat = fx.hammer_fleet_api(
+            server.port, paths, swaps, clients=self.CLIENTS
+        )
+        rounds_seen = fx.assert_poll_contract(flat)
+        # Distinct rounds were actually observed mid-flight.
         assert len(rounds_seen) > 1
-        per_round_etags = {}
-        for (path, etag), rnd in etag_to_round.items():
-            per_round_etags.setdefault((path, rnd), set()).add(etag)
-        assert all(len(v) == 1 for v in per_round_etags.values())
 
 
 # ---------------------------------------------------------------------------
@@ -887,7 +794,16 @@ class TestTrendCache:
         p.write_text("\n".join(lines) + "\n")
         return p
 
-    def test_trend_served_and_cached_until_file_changes(self, tmp_path):
+    @staticmethod
+    def _await_rebuilds(trend, n, deadline_s=10.0):
+        """SWR rebuilds land on a background thread: bounded poll until the
+        counter reaches ``n`` (never a fixed sleep)."""
+        deadline = time.monotonic() + deadline_s
+        while trend.rebuilds < n and time.monotonic() < deadline:
+            time.sleep(0.005)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL tnc-trend-swr rebuild thread to commit; no clock to fake across threads)
+        assert trend.rebuilds == n, trend.rebuilds
+
+    def test_trend_served_stale_then_revalidated_on_file_change(self, tmp_path):
         path = self._log(tmp_path)
         srv = FleetStateServer(0, host="127.0.0.1", trend_path=str(path))
         try:
@@ -899,11 +815,18 @@ class TestTrendCache:
             for _ in range(5):
                 _req(srv.port, "GET", "/api/v1/trend")
             assert srv._trend.rebuilds == 1
-            # Another process appends a round → mtime/size move → rebuild.
+            assert srv._trend.stale_served == 0
+            # Another process appends a round → mtime/size move → the
+            # reader is served the PREVIOUS entity immediately (SWR) while
+            # exactly one rebuild runs off-thread.
             with open(path, "a") as f:
                 f.write(json.dumps({"ts": 1_700_000_300.0, "exit_code": 3}) + "\n")
             status, _, body = _req(srv.port, "GET", "/api/v1/trend")
-            assert json.loads(body)["rounds"] == 4
+            assert status == 200 and json.loads(body)["rounds"] == 3  # stale
+            assert srv._trend.stale_served >= 1
+            self._await_rebuilds(srv._trend, 2)
+            status, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            assert json.loads(body)["rounds"] == 4  # revalidated
             assert srv._trend.rebuilds == 2
         finally:
             srv.close()
@@ -915,8 +838,8 @@ class TestTrendCache:
             srv.publish(_result([_tpu_node()]))
             _req(srv.port, "GET", "/api/v1/trend")
             srv.publish(_result([_tpu_node()]))  # seq moves, file does not
-            _req(srv.port, "GET", "/api/v1/trend")
-            assert srv._trend.rebuilds == 2
+            _req(srv.port, "GET", "/api/v1/trend")  # stale + async rebuild
+            self._await_rebuilds(srv._trend, 2)
         finally:
             srv.close()
 

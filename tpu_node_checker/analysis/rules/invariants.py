@@ -83,8 +83,9 @@ class BlockingReadPath(Rule):
     slug = "blocking-read-path"
     code = "TNC011"
     doc = ("the fleet API snapshot read path (server GET handlers, "
-           "``negotiate``, everything in snapshot.py that is not a builder) "
-           "takes no locks and does no blocking I/O")
+           "``negotiate``, the worker pool's fast-path responders, "
+           "everything in snapshot.py that is not a builder) takes no "
+           "locks and does no blocking I/O")
 
     # Builder-side functions in snapshot.py: run once per round, off the
     # request path, so blocking work is their job.
@@ -108,6 +109,20 @@ class BlockingReadPath(Rule):
         elif ctx.path == "tpu_node_checker/server/router.py":
             for node in ast.walk(ctx.tree):
                 if isinstance(node, ast.FunctionDef) and node.name == "negotiate":
+                    yield node
+        elif ctx.path == "tpu_node_checker/server/workers.py":
+            # The accept-loop read path: the serve loop, fast-table
+            # responders and header extraction run per request — a lock
+            # there serializes every worker at 50k req/s.  The routed
+            # fallback (`_respond_routed`) legitimately does socket I/O
+            # (body reads), and accept-side bookkeeping (connection
+            # registry, shed guard) may lock — neither is scanned.
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.FunctionDef) and (
+                    node.name in ("_respond_fast", "_header_value",
+                                  "_serve_connection")
+                    or node.name.startswith("_get")
+                ):
                     yield node
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:

@@ -1922,6 +1922,18 @@ def _make_serve_control(args):
     return control
 
 
+def _serve_pool_kwargs(args) -> dict:
+    """The serving-scale knobs both --serve modes share: worker count and
+    the write-path token bucket (``None`` write_rps = unlimited)."""
+    kwargs = {"workers": getattr(args, "serve_workers", None) or 1}
+    write_rps = getattr(args, "write_rps", None)
+    if write_rps:
+        from tpu_node_checker.server.ratelimit import TokenBucket
+
+        kwargs["write_limiter"] = TokenBucket(write_rps)
+    return kwargs
+
+
 def serve_store(args) -> int:
     """``--serve PORT`` without ``--watch``: serve a RECORDED store.
 
@@ -1990,14 +2002,25 @@ def serve_store(args) -> int:
         control=None,  # no live round → no evidence → writes answer 503
         trend_path=trend_path,
         refresh=refresh,
+        **_serve_pool_kwargs(args),
     )
     holder["server"] = server
     try:
         refresh()
     except OSError as exc:
         print(f"Cannot read store {source}: {exc} (serving not-ready)", file=sys.stderr)
+    requested_workers = getattr(args, "serve_workers", None) or 1
+    if server.workers_active != requested_workers:
+        print(
+            f"--serve-workers {requested_workers}: SO_REUSEPORT unavailable "
+            f"on this platform — serving with {server.workers_active} "
+            "listener.",
+            file=sys.stderr,
+        )
     print(
-        f"Serving fleet state API on port {server.port} over "
+        f"Serving fleet state API on port {server.port} "
+        f"({server.workers_active} worker"
+        f"{'s' if server.workers_active != 1 else ''}) over "
         f"{'history store ' + history_path if history_path else 'trend log ' + trend_path}"
         " (standalone: no check rounds run here; writes disabled).",
         file=sys.stderr,
@@ -2092,10 +2115,21 @@ def watch(args) -> int:
             token=resolve_serve_token(getattr(args, "serve_token", None)),
             control=_make_serve_control(args),
             trend_path=getattr(args, "log_jsonl", None),
+            **_serve_pool_kwargs(args),
         )
+        requested_workers = getattr(args, "serve_workers", None) or 1
+        if fleet_server.workers_active != requested_workers:
+            print(
+                f"--serve-workers {requested_workers}: SO_REUSEPORT "
+                "unavailable on this platform — serving with "
+                f"{fleet_server.workers_active} listener.",
+                file=sys.stderr,
+            )
         print(
             f"Serving fleet state API on port {fleet_server.port} "
-            "(/api/v1/{summary,nodes,slices,trend}, /healthz, /readyz, "
+            f"({fleet_server.workers_active} worker"
+            f"{'s' if fleet_server.workers_active != 1 else ''}: "
+            "/api/v1/{summary,nodes,slices,trend}, /healthz, /readyz, "
             "/metrics).",
             file=sys.stderr,
         )

@@ -154,6 +154,20 @@ def build_parser() -> argparse.ArgumentParser:
                        "cordon|uncordon, evidence/FSM-gated with ?dry_run=1 "
                        "support, audit-logged; with no token configured every "
                        "write answers 403 (reads stay open)")
+    serve.add_argument("--serve-workers", type=int, default=None, metavar="N",
+                       help="with --serve: accept-loop workers sharing the "
+                       "port via SO_REUSEPORT (default 1; falls back to a "
+                       "single listener where the option is unavailable) — "
+                       "hot read endpoints are answered from wire responses "
+                       "prebuilt once per round, so read throughput scales "
+                       "to tens of thousands of polls per second")
+    serve.add_argument("--write-rps", type=float, default=None, metavar="RATE",
+                       help="with --serve: token-bucket rate limit on the "
+                       "authenticated cordon/uncordon write path — sustained "
+                       "RATE requests/second (burst of the same size, "
+                       "minimum 1); refusals answer 429 with a Retry-After "
+                       "the caller's retry ladder can honor (default: "
+                       "unlimited)")
 
     probe = p.add_argument_group("Chip probe (data-plane liveness)")
     probe.add_argument("--probe", action="store_true",
@@ -367,6 +381,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "(use poll-mode --watch)")
     if args.serve_token and args.serve is None:
         p.error("--serve-token requires --serve")
+    if args.serve_workers is not None:
+        if args.serve is None:
+            p.error("--serve-workers requires --serve")
+        if args.serve_workers < 1:
+            p.error("--serve-workers must be at least 1")
+    if args.write_rps is not None:
+        if args.serve is None:
+            p.error("--write-rps requires --serve")
+        if args.write_rps <= 0:
+            p.error("--write-rps must be positive (omit the flag for "
+                    "unlimited writes)")
     if args.slack_on_change and args.watch is None:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:

@@ -60,7 +60,16 @@ Exported metric families:
   ``tpu_node_checker_watch_stream_age_seconds`` — watch-stream mode
   (``--watch-stream``): events folded into the node cache by type, full
   LISTs by cause (seed / 410 gone / stream loss — steady state adds none),
-  and seconds since the stream last showed life.
+  and seconds since the stream last showed life;
+* ``tpu_node_checker_api_server_workers`` — accept loops serving the
+  fleet API (``--serve-workers`` SO_REUSEPORT pool size; 1 = single
+  listener, including the no-SO_REUSEPORT fallback);
+* ``tpu_node_checker_api_server_rate_limited_total`` — authenticated
+  write requests refused 429 by the ``--write-rps`` token bucket;
+* ``tpu_node_checker_api_server_swr_stale_served_total`` — ``/api/v1/trend``
+  responses served stale while a background rebuild ran
+  (stale-while-revalidate hits; a climbing rate with no matching rebuilds
+  means the trend log is churning faster than it can be summarized).
 
 This docstring is the package's metric index: tnc-lint's
 ``drift-readme-metrics`` rule (TNC202) fails CI when a family is emitted

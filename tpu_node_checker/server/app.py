@@ -31,18 +31,18 @@ attribute swap.
 
 from __future__ import annotations
 
+import gzip as _gzip
 import json
 import sys
 import threading
 import time
-from http.server import ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 from tpu_node_checker.server.auth import check_write_auth
+from tpu_node_checker.server.ratelimit import retry_after_header
 from tpu_node_checker.server.router import (
     Request,
     Response,
-    RoutedHandler,
     Router,
     json_response,
     negotiate,
@@ -53,10 +53,26 @@ from tpu_node_checker.server.snapshot import (
     build_snapshot,
     build_snapshot_delta,
 )
+from tpu_node_checker.server.workers import (
+    DEFAULT_MAX_CONNECTIONS,
+    WorkerPool,
+    build_fast_routes,
+)
 
 # At most one auth-failure notification per this many seconds: a scanner
 # hammering the write path must not turn Slack into the amplifier.
 _AUTH_EVENT_INTERVAL_S = 60.0
+
+# /metrics compression split: the round-family prefix is static between
+# publishes — compressed ONCE per publish at the thorough level — while the
+# per-scrape stats block (it moves every scrape) gets the cheapest level;
+# the two gzip members concatenate into one valid stream (RFC 1952).
+_METRICS_PREFIX_GZIP_LEVEL = 6
+_METRICS_STATS_GZIP_LEVEL = 1
+
+# The read endpoints hot enough to earn prebuilt wire responses in the
+# worker pool's fast table (everything else rides the routed fallback).
+_FAST_PATHS = ("summary", "nodes", "slices")
 
 
 class ServerStats:
@@ -72,6 +88,7 @@ class ServerStats:
         self.latency: Dict[str, list] = {}  # route -> [sum_ms, count]
         self.in_flight = 0
         self.auth_failures = 0
+        self.rate_limited = 0
 
     def track_in_flight(self, delta: int) -> None:
         with self._lock:
@@ -85,9 +102,25 @@ class ServerStats:
             bucket[0] += elapsed_ms
             bucket[1] += 1
 
+    def merge_fast(self, counts: Dict[Tuple[str, int], int]) -> None:
+        """Batched fast-path GET counts (one lock round per flush, not per
+        request — the 50k req/s path cannot afford per-request locking).
+        Fast-path requests carry no per-request latency sample: they are
+        answered from prebuilt bytes inside a batch, so the latency summary
+        covers the routed path, where the timing is real.
+        """
+        with self._lock:
+            for (route, status), n in counts.items():
+                key = ("GET", route, status)
+                self.requests[key] = self.requests.get(key, 0) + n
+
     def mark_auth_failure(self) -> None:
         with self._lock:
             self.auth_failures += 1
+
+    def mark_rate_limited(self) -> None:
+        with self._lock:
+            self.rate_limited += 1
 
     def prometheus_lines(self) -> list:
         from tpu_node_checker.metrics import _line  # shared escaping rules
@@ -97,6 +130,7 @@ class ServerStats:
             latency = {k: list(v) for k, v in self.latency.items()}
             in_flight = self.in_flight
             auth_failures = self.auth_failures
+            rate_limited = self.rate_limited
         lines = [
             "# HELP tpu_node_checker_api_server_requests_total HTTP requests "
             "served by the fleet state API, by method/route/status.",
@@ -142,6 +176,14 @@ class ServerStats:
                 "tpu_node_checker_api_server_auth_failures_total",
                 float(auth_failures),
             ),
+            "# HELP tpu_node_checker_api_server_rate_limited_total "
+            "Authenticated write requests refused 429 by the --write-rps "
+            "token bucket.",
+            "# TYPE tpu_node_checker_api_server_rate_limited_total counter",
+            _line(
+                "tpu_node_checker_api_server_rate_limited_total",
+                float(rate_limited),
+            ),
         ]
         return lines
 
@@ -169,22 +211,37 @@ class FleetStateServer:
         refresh: Optional[Callable] = None,
         on_event: Optional[Callable] = None,
         pre_serialized: bool = True,
+        workers: int = 1,
+        max_connections: int = DEFAULT_MAX_CONNECTIONS,
+        write_limiter=None,
     ):
         self._snap: Optional[FleetSnapshot] = None
         self._seq = 0
         self._breaker: Optional[dict] = None
-        self._metrics_body = b"# tpu-node-checker: no check completed yet\n"
+        default_metrics = b"# tpu-node-checker: no check completed yet\n"
+        # (plain body, gzipped body) as ONE tuple so a scrape racing a
+        # publish never pairs one round's prefix with another's gz.
+        self._metrics = (
+            default_metrics,
+            _gzip.compress(default_metrics, _METRICS_PREFIX_GZIP_LEVEL, mtime=0),
+        )
         self._token = token
         self._control = control
         self._refresh = refresh
         self.on_event = on_event
         self._trend = TrendCache(trend_path) if trend_path else None
         self._stats = ServerStats()
+        self._write_limiter = write_limiter
         self._last_auth_event = 0.0
         # Bench seam: pre_serialized=False re-encodes the endpoint body on
         # every request — the pre-snapshot cost model, measured against the
         # cached path by bench.py's serve case.  Never used in production.
         self._pre_serialized = pre_serialized
+        # The worker pool's fast table: request-line bytes → prebuilt wire
+        # responses, swapped atomically per publish (empty = every request
+        # rides the routed path — standalone store mode keeps it empty so
+        # the per-request refresh() seam always runs).
+        self.fast_routes: dict = {}
 
         router = Router()
         router.add("GET", "/healthz", self._get_healthz)
@@ -197,38 +254,49 @@ class FleetStateServer:
         router.add("GET", "/api/v1/trend", self._get_trend)
         router.add("POST", "/api/v1/nodes/{name}/cordon", self._post_control)
         router.add("POST", "/api/v1/nodes/{name}/uncordon", self._post_control)
+        self.router = router
 
-        outer = self
-
-        class Handler(RoutedHandler):
-            pass
-
-        Handler.router = router
-        Handler.observe = lambda self, *a: outer._stats.observe(*a)
-        Handler.track_in_flight = lambda self, d: outer._stats.track_in_flight(d)
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._server.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._server.serve_forever,
-            name="tnc-fleet-api",
-            daemon=True,
+        self._pool = WorkerPool(
+            host, port, app=self, workers=workers,
+            max_connections=max_connections,
         )
-        self._thread.start()
+
+    # -- the worker pool's serving seam --------------------------------------
+
+    def observe(self, method: str, route: str, status: int, ms: float) -> None:
+        self._stats.observe(method, route, status, ms)
+
+    def track_in_flight(self, delta: int) -> None:
+        self._stats.track_in_flight(delta)
+
+    def count_fast(self, counts: dict) -> None:
+        self._stats.merge_fast(counts)
 
     # -- lifecycle -----------------------------------------------------------
 
     @property
     def port(self) -> int:
-        return self._server.server_address[1]
+        return self._pool.port
 
     @property
     def stats(self) -> ServerStats:
         return self._stats
 
+    @property
+    def workers_active(self) -> int:
+        return self._pool.workers
+
+    @property
+    def reuseport(self) -> bool:
+        return self._pool.reuseport
+
+    def restart_worker(self, index: int) -> None:
+        """Rolling-restart seam: replace one accept loop in place (the
+        restart-hammer test drives this; ops can too, via SIGHUP one day)."""
+        self._pool.restart(index)
+
     def close(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+        self._pool.close()
 
     # -- publication (the check loop's side) ---------------------------------
 
@@ -243,8 +311,9 @@ class FleetStateServer:
 
         ``changed`` (watch-stream mode) is the set of node names whose
         payload entries differ from the previous publish: the new snapshot
-        is then DELTA-built — unchanged per-node entities, fragments and
-        evidence docs carried over from the live snapshot by reference
+        is then DELTA-built — unchanged per-node entities, fragments,
+        gzip members and evidence docs carried over from the live snapshot
+        by reference
         (see :func:`~tpu_node_checker.server.snapshot.build_snapshot_delta`)
         instead of re-encoded.  ``None`` (poll mode, first round, or a
         non-round previous snapshot) builds from scratch.
@@ -264,16 +333,30 @@ class FleetStateServer:
             snap = build_snapshot(
                 result.payload, result.exit_code, self._seq, round(time.time(), 3)
             )
-        metrics_body = self._render_fleet_metrics(result, breaker)
-        # Swap order: metrics first, snapshot last — the snapshot's seq is
-        # what readiness and the hammer test key on.
-        self._metrics_body = metrics_body
+        metrics = self._render_fleet_metrics(result, breaker)
+        fast = (
+            build_fast_routes(
+                {f"/api/v1/{key}": snap.entities[key] for key in _FAST_PATHS}
+            )
+            if self._pre_serialized and self._refresh is None
+            else {}
+        )
+        # Swap order: metrics and the fast table first, snapshot last — the
+        # snapshot's seq is what readiness and the hammer test key on, and
+        # each reference is internally consistent on its own.
+        self._metrics = metrics
         self._breaker = breaker
+        self.fast_routes = fast
         self._snap = snap
         return snap
 
     def publish_snapshot(self, snap: FleetSnapshot) -> None:
-        """Standalone mode: install an externally built (store) snapshot."""
+        """Standalone mode: install an externally built (store) snapshot.
+
+        The fast table stays EMPTY on purpose: standalone reads must ride
+        the routed path so the per-request ``refresh()`` seam keeps
+        watching the store file for rewrites.
+        """
         self._seq = max(self._seq + 1, snap.seq)
         self._snap = snap
 
@@ -283,7 +366,7 @@ class FleetStateServer:
         surface must keep breathing — ``last_run_timestamp_seconds`` and
         the stream-age gauge move every tick, or the staleness alerts
         would fire on a perfectly healthy, merely quiet fleet."""
-        self._metrics_body = self._render_fleet_metrics(result, breaker)
+        self._metrics = self._render_fleet_metrics(result, breaker)
         self._breaker = breaker
 
     def mark_error(self, breaker: Optional[dict] = None) -> None:
@@ -292,10 +375,13 @@ class FleetStateServer:
         data must stop gating schedulers once the monitor itself is down."""
         self._breaker = breaker
 
-    def _render_fleet_metrics(self, result, breaker) -> bytes:
+    def _render_fleet_metrics(self, result, breaker) -> Tuple[bytes, bytes]:
+        """→ (plain body, gzip member of it): the round-family prefix of
+        every scrape, compressed once per publish, never per scrape."""
         from tpu_node_checker.metrics import render_metrics
 
-        return render_metrics(result, breaker=breaker).encode("utf-8")
+        body = render_metrics(result, breaker=breaker).encode("utf-8")
+        return body, _gzip.compress(body, _METRICS_PREFIX_GZIP_LEVEL, mtime=0)
 
     # -- readiness -----------------------------------------------------------
 
@@ -386,22 +472,46 @@ class FleetStateServer:
         """The round's fleet families + this server's live request stats.
 
         The stats block moves on every scrape (it counts the scrape
-        itself), so a conditional ETag could never hit — served directly,
-        gzip only when asked, no per-request hashing or compression paid
-        by scrapers that didn't opt in.  The ``--metrics-port`` surface,
-        whose body IS round-static, keeps the full ETag treatment.
+        itself), so a conditional ETag could never hit.  Compression is
+        split along the same line: the round-family prefix's gzip member
+        was cached at publish time, so an opted-in scrape pays level-1
+        deflate of the (small) moving stats block only — the two members
+        concatenate into one stream whose plain-text decode is
+        byte-identical to the uncompressed body.  The ``--metrics-port``
+        surface, whose body IS round-static, keeps the full ETag treatment.
         """
-        import gzip as _gzip
+        from tpu_node_checker.metrics import METRICS_CONTENT_TYPE, _line
 
-        from tpu_node_checker.metrics import METRICS_CONTENT_TYPE
-
-        body = self._metrics_body + (
-            "\n".join(self._stats.prometheus_lines()) + "\n"
-        ).encode("utf-8")
+        prefix, prefix_gz = self._metrics
+        lines = self._stats.prometheus_lines()
+        lines += [
+            "# HELP tpu_node_checker_api_server_workers Accept loops "
+            "serving this fleet API (SO_REUSEPORT pool size; 1 = single "
+            "listener).",
+            "# TYPE tpu_node_checker_api_server_workers gauge",
+            _line(
+                "tpu_node_checker_api_server_workers",
+                float(self._pool.workers),
+            ),
+            "# HELP tpu_node_checker_api_server_swr_stale_served_total "
+            "/api/v1/trend responses served stale while a rebuild ran "
+            "(stale-while-revalidate hits).",
+            "# TYPE tpu_node_checker_api_server_swr_stale_served_total "
+            "counter",
+            _line(
+                "tpu_node_checker_api_server_swr_stale_served_total",
+                float(self._trend.stale_served if self._trend else 0),
+            ),
+        ]
+        stats_block = ("\n".join(lines) + "\n").encode("utf-8")
         headers = {"Content-Type": METRICS_CONTENT_TYPE, "Vary": "Accept-Encoding"}
         if "gzip" in (req.headers.get("Accept-Encoding") or "").lower():
-            body = _gzip.compress(body, 6)
+            body = prefix_gz + _gzip.compress(
+                stats_block, _METRICS_STATS_GZIP_LEVEL, mtime=0
+            )
             headers["Content-Encoding"] = "gzip"
+        else:
+            body = prefix + stats_block
         return Response(200, body, headers)
 
     # -- write handlers -------------------------------------------------------
@@ -423,6 +533,26 @@ class FleetStateServer:
             if status == 401:
                 resp.headers["WWW-Authenticate"] = "Bearer"
             return resp
+        if self._write_limiter is not None:
+            wait = self._write_limiter.try_acquire()
+            if wait > 0.0:
+                # Authenticated but over the --write-rps bucket: 429 with a
+                # Retry-After the caller's retry ladder can honor — a token
+                # holder's runaway loop backs off instead of turning every
+                # eligible request into a control-plane PATCH.
+                self._stats.mark_rate_limited()
+                self._audit(
+                    name, action, 429, applied=False,
+                    reason="write rate limit exceeded", remote=req.remote,
+                )
+                resp = json_response(
+                    429,
+                    {"error": "write rate limit exceeded — retry after the "
+                              "Retry-After delay", "node": name,
+                     "action": action},
+                )
+                resp.headers["Retry-After"] = retry_after_header(wait)
+                return resp
         if self._control is None:
             return json_response(
                 503,

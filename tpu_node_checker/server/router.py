@@ -120,6 +120,32 @@ def gunzip(data: bytes) -> bytes:
     return _gzip.decompress(data)
 
 
+def route_request(router: "Router", method: str, target: str, headers,
+                  body: bytes, remote: str) -> Tuple[Response, str]:
+    """The dispatch core both HTTP stacks share → ``(response, pattern)``.
+
+    :class:`RoutedHandler` (the ``--metrics-port`` surface) and the
+    fleet-API worker pool's fallback path
+    (:mod:`~tpu_node_checker.server.workers`) parse bytes differently but
+    MUST route identically — query parsing, 404/405 shapes, the handler
+    try/except — so that logic lives here exactly once.  ``pattern`` is
+    the matched route pattern (``"(unmatched)"`` for 404/405), the label
+    request metrics key on.
+    """
+    parsed = urllib.parse.urlsplit(target)
+    query = dict(urllib.parse.parse_qsl(parsed.query)) if parsed.query else {}
+    resolved = router.resolve(method, parsed.path)
+    if isinstance(resolved, Response):
+        return resolved, "(unmatched)"
+    handler, params, pattern = resolved
+    request = Request(method, parsed.path, params, query, headers, body, remote)
+    try:
+        response = handler(request)
+    except Exception as exc:  # tnc: allow-broad-except(a handler bug must not kill the serving thread)
+        response = json_response(500, {"error": f"internal error: {exc}"})
+    return response, pattern
+
+
 class Router:
     """Ordered route table: ``(method, pattern)`` → handler.
 
@@ -234,26 +260,15 @@ class RoutedHandler(BaseHTTPRequestHandler):
         route_label = "(unmatched)"
         status = 500
         try:
-            parsed = urllib.parse.urlsplit(self.path)
-            query = dict(urllib.parse.parse_qsl(parsed.query))
-            resolved = self.router.resolve(method, parsed.path)
             # Drain the body BEFORE answering, resolved or not: a 404/405
             # that skips an unread POST body leaves its bytes in the
             # socket, and the next keep-alive request on the connection
             # would be parsed starting at the leftovers.
             body = self._read_body() if method in ("POST", "PUT") else b""
-            if isinstance(resolved, Response):
-                response = resolved
-            else:
-                handler, params, route_label = resolved
-                request = Request(
-                    method, parsed.path, params, query,
-                    self.headers, body, self.client_address[0],
-                )
-                try:
-                    response = handler(request)
-                except Exception as exc:  # tnc: allow-broad-except(a handler bug must not kill the thread)
-                    response = json_response(500, {"error": f"internal error: {exc}"})
+            response, route_label = route_request(
+                self.router, method, self.path, self.headers, body,
+                self.client_address[0],
+            )
             status = response.status
             self._send(response, head_only=(method == "HEAD"))
         except (BrokenPipeError, ConnectionResetError):

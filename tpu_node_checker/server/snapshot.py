@@ -36,20 +36,29 @@ _GZIP_MIN_BYTES = 256
 
 
 class Entity:
-    """One immutable HTTP representation: raw bytes + gzip variant + ETag."""
+    """One immutable HTTP representation: raw bytes + gzip variant + ETag.
+
+    ``gz`` may be supplied precomputed — the delta builder hands in a
+    multi-member gzip stream concatenated from per-node cached members
+    (RFC 1952 members decompress back to exactly ``raw``), so a delta
+    publish compresses only the CHANGED bytes.  Either way the variant is
+    served only when it actually saved bytes.
+    """
 
     __slots__ = ("raw", "gz", "etag", "content_type")
 
-    def __init__(self, raw: bytes, content_type: str = JSON_CONTENT_TYPE):
+    def __init__(self, raw: bytes, content_type: str = JSON_CONTENT_TYPE,
+                 gz: Optional[bytes] = None):
         self.raw = raw
         self.content_type = content_type
-        # mtime=0 pins the gzip header, so identical bodies compress to
-        # identical bytes — representation equality mirrors ETag equality.
-        gz = (
-            gzip.compress(raw, _GZIP_LEVEL, mtime=0)
-            if len(raw) >= _GZIP_MIN_BYTES
-            else None
-        )
+        if gz is None:
+            # mtime=0 pins the gzip header, so identical bodies compress to
+            # identical bytes — representation equality mirrors ETag equality.
+            gz = (
+                gzip.compress(raw, _GZIP_LEVEL, mtime=0)
+                if len(raw) >= _GZIP_MIN_BYTES
+                else None
+            )
         self.gz = gz if gz is not None and len(gz) < len(raw) else None
         self.etag = '"' + hashlib.sha256(raw).hexdigest()[:32] + '"'
 
@@ -71,7 +80,8 @@ class FleetSnapshot:
     """
 
     __slots__ = ("seq", "ts", "exit_code", "source", "entities",
-                 "node_entities", "node_docs", "docs", "node_fragments")
+                 "node_entities", "node_docs", "docs", "node_fragments",
+                 "node_gz_fragments")
 
     def __init__(self, seq: int, ts: float, exit_code: Optional[int], source: str):
         self.seq = seq
@@ -82,6 +92,11 @@ class FleetSnapshot:
         self.node_entities: Dict[str, Entity] = {}
         self.node_docs: Dict[str, dict] = {}
         self.node_fragments: Dict[str, bytes] = {}
+        # Per-node gzip MEMBERS (", " separator folded in) of the entries
+        # inside the nodes collection body — populated by delta builds so
+        # the next delta re-compresses only changed entries (full builds
+        # leave it empty; the first delta migrates in one O(n) pass).
+        self.node_gz_fragments: Dict[str, bytes] = {}
         # The un-serialized collection docs (references, not copies): what
         # the bench's cold-encode cost model re-encodes per request.
         self.docs: Dict[str, dict] = {}
@@ -94,22 +109,43 @@ def build_fragment(obj) -> bytes:
     return json.dumps(obj, ensure_ascii=False).encode("utf-8")
 
 
-def build_joined_entity(head: dict, key: str, fragments) -> Entity:
+def gzip_fragment(frag: bytes) -> bytes:
+    """One node entry (+ its ``", "`` separator) as a standalone gzip
+    member — the unit the member-joined collection gz is concatenated
+    from, cacheable per node across delta publishes."""
+    return gzip.compress(b", " + frag, _GZIP_LEVEL, mtime=0)
+
+
+def build_joined_entity(head: dict, key: str, fragments,
+                        gz_fragments=None) -> Entity:
     """``{**head, key: [...]}`` as an Entity, the list byte-joined from
     pre-encoded fragments instead of re-encoding every element.
 
     The byte-identity contract with ``json_entity(dict(head, key=list))``
     is pinned by tests: ``json.dumps`` default separators are ``", "`` /
     ``": "``, so the head's closing brace is replaced by the joined array.
+
+    ``gz_fragments`` (delta builds) is one gzip member per fragment AFTER
+    the first (each covering ``", " + fragment``); the gzip variant is then
+    the member concatenation ``gz(prefix + frag0) + members + gz(tail)`` —
+    a multi-member stream whose decompression is byte-identical to the
+    plain body, built without re-deflating any unchanged node.
     """
-    prefix = json.dumps(head, ensure_ascii=False)[:-1].encode("utf-8")
-    body = (
-        prefix
-        + f', "{key}": ['.encode("utf-8")
-        + b", ".join(fragments)
-        + b"]}\n"
-    )
-    return Entity(body)
+    prefix = (
+        json.dumps(head, ensure_ascii=False)[:-1] + f', "{key}": ['
+    ).encode("utf-8")
+    tail = b"]}\n"
+    body = prefix + b", ".join(fragments) + tail
+    gz = None
+    if gz_fragments is not None and fragments and len(body) >= _GZIP_MIN_BYTES:
+        joined = bytearray(
+            gzip.compress(prefix + fragments[0], _GZIP_LEVEL, mtime=0)
+        )
+        for member in gz_fragments[1:]:
+            joined += member
+        joined += gzip.compress(tail, _GZIP_LEVEL, mtime=0)
+        gz = bytes(joined)
+    return Entity(body, gz=gz)
 
 
 def build_summary_doc(payload: dict, exit_code: int, seq: int, ts: float) -> dict:
@@ -193,9 +229,10 @@ def build_snapshot_delta(
 
     The steady-state cost model of the watch-stream tentpole: the summary
     and slices docs (small) are re-encoded every publish, but per-node
-    entities, evidence docs and collection-body fragments are carried over
-    by reference for unchanged nodes — so a 5k-node fleet with 50 changed
-    nodes pays 50 entry encodes plus one byte-join, not 5 000 encodes.
+    entities, evidence docs, collection-body fragments AND their gzip
+    members are carried over by reference for unchanged nodes — so a
+    5k-node fleet with 50 changed nodes pays 50 entry encodes (and 50
+    deflates) plus one byte-join, not 5 000.
     Unchanged per-node entities keep the round/ts of the round that last
     touched them (their bytes — and therefore ETags — are unchanged by
     construction: a poller's cached 304 stays valid until the node itself
@@ -216,26 +253,37 @@ def build_snapshot_delta(
     snap.entities["summary"] = json_entity(summary)
     snap.entities["slices"] = slices_entity
     fragments = []
+    gz_fragments = []
     for n in nodes:
         name = n.get("name")
         named = isinstance(name, str) and bool(name)
         if named and name not in changed and name in prev.node_fragments:
             frag = prev.node_fragments[name]
+            # Compressed-fragment reuse BY REFERENCE: the member was
+            # deflated the round this node last changed (or in the one-off
+            # migration pass after a full build, which stores no members).
+            gz_frag = prev.node_gz_fragments.get(name) or gzip_fragment(frag)
             fragments.append(frag)
+            gz_fragments.append(gz_frag)
             snap.node_docs[name] = prev.node_docs[name]
             snap.node_fragments[name] = frag
+            snap.node_gz_fragments[name] = gz_frag
             snap.node_entities[name] = prev.node_entities[name]
             continue
         frag = build_fragment(n)
+        gz_frag = gzip_fragment(frag)
         fragments.append(frag)
+        gz_fragments.append(gz_frag)
         if named:
             snap.node_docs[name] = n
             snap.node_fragments[name] = frag
+            snap.node_gz_fragments[name] = gz_frag
             snap.node_entities[name] = json_entity(
                 {"round": seq, "ts": ts, "node": n}
             )
     snap.entities["nodes"] = build_joined_entity(
-        {"round": seq, "ts": ts, "count": len(nodes)}, "nodes", fragments
+        {"round": seq, "ts": ts, "count": len(nodes)}, "nodes", fragments,
+        gz_fragments,
     )
     return snap
 
@@ -384,21 +432,27 @@ def build_trendlog_snapshot(path: str, seq: int, ts: float) -> FleetSnapshot:
 
 
 class TrendCache:
-    """``/api/v1/trend`` body cache over a ``--log-jsonl`` trend log.
+    """``/api/v1/trend`` cache over a ``--log-jsonl`` trend log —
+    **stale-while-revalidate**.
 
-    Rebuilds only when the cache key moves: the publication seq (a new
-    round landed in THIS process) or the file's mtime/size signature (a
-    store written by another process).  A stat per request is the entire
-    steady-state cost; the JSONL re-read + summary math runs once per
-    change, not once per poll.
+    Steady state is a stat per request.  When the cache key moves (the
+    publication seq — a new round in THIS process — or the file's
+    mtime/size signature — a store written by another process), the reader
+    is served the PREVIOUS entity immediately and ONE rebuild runs on a
+    background thread; the fresh entity swaps in when it lands.  A
+    trend-log rewrite therefore never stalls a reader behind the JSONL
+    re-read + summary math.  Only the very first build (nothing stale to
+    serve yet) blocks the requester, exactly as before SWR.
     """
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
         self._key = None
+        self._pending = None  # key a background rebuild is running for
         self._entity: Optional[Entity] = None
         self.rebuilds = 0  # observability + test seam
+        self.stale_served = 0  # → ..._swr_stale_served_total
 
     def _signature(self, seq: int):
         from tpu_node_checker.history.store import file_signature
@@ -407,20 +461,49 @@ class TrendCache:
 
     def entity(self, seq: int) -> Entity:
         key = self._signature(seq)
-        # tnc: allow-blocking-read-path(the trend cache is the sanctioned exception — DESIGN §10: one stat per request, the lock guards a rebuild that runs once per round/file change, never per poll)
+        # tnc: allow-blocking-read-path(the sanctioned exception — DESIGN §10/§13: one stat per request; the lock guards flag flips and the FIRST build only, every later rebuild runs on a tnc-trend-swr thread while readers get the stale entity)
         with self._lock:
             if key == self._key and self._entity is not None:
                 return self._entity
-            # Lazy import: checker imports the server package, so the
-            # reverse edge must resolve at call time, not import time.
-            from tpu_node_checker.checker import compute_trend_summary
-
-            summary, reason, _rounds, skipped = compute_trend_summary(self.path)
-            if summary is None:
-                body = {"rounds": 0, "skipped_lines": skipped, "error": reason}
-            else:
-                body = summary
-            self._entity = json_entity(body)
+            if self._entity is not None:
+                # Stale-while-revalidate: serve what we have NOW; exactly
+                # one rebuild per key change runs off-thread.
+                if self._pending != key:
+                    self._pending = key
+                    threading.Thread(
+                        target=self._rebuild, args=(key,),
+                        name="tnc-trend-swr", daemon=True,
+                    ).start()
+                self.stale_served += 1
+                return self._entity
+            # First build: nothing stale to serve, so the requester pays
+            # for it (the pre-SWR behavior, once per process).
+            entity = self._build_entity()
+            self._entity = entity
             self._key = key
             self.rebuilds += 1
-            return self._entity
+            return entity
+
+    def _rebuild(self, key) -> None:
+        entity = self._build_entity()
+        with self._lock:  # tnc: allow-blocking-read-path(runs on the tnc-trend-swr thread, never a request thread; the lock guards the commit flags only)
+            # Last writer wins: commit unconditionally (the build read the
+            # file as it is NOW), clear pending only if no newer key change
+            # superseded this rebuild mid-flight.
+            self._entity = entity
+            self._key = key
+            if self._pending == key:
+                self._pending = None
+            self.rebuilds += 1
+
+    def _build_entity(self) -> Entity:
+        # Lazy import: checker imports the server package, so the reverse
+        # edge must resolve at call time, not import time.
+        from tpu_node_checker.checker import compute_trend_summary
+
+        summary, reason, _rounds, skipped = compute_trend_summary(self.path)
+        if summary is None:
+            body = {"rounds": 0, "skipped_lines": skipped, "error": reason}
+        else:
+            body = summary
+        return json_entity(body)
