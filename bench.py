@@ -56,6 +56,20 @@ Watch-stream evidence (the incremental-rounds tentpole):
   relists happen exactly on seed + injected stream loss + injected 410 —
   never on a steady or churn round.
 
+Federation evidence (the multi-cluster tentpole):
+
+* ``nodes100k_federated_*`` — 20 fixture clusters × 5k nodes, each a REAL
+  FleetStateServer behind one FederationEngine (the fleet API as the
+  inter-tier protocol).  The seed round pays 20 full fetches + the 100k
+  merge; a STEADY round is all conditional GETs — the run ASSERTS
+  fixture-side that 21 unchanged rounds produced nothing but 304s and the
+  merged nodes entity was reused by reference — and a 1-cluster churn
+  round re-fetches/re-merges exactly one shard (both ASSERTED below the
+  seed cost).  Killing one fixture cluster must degrade ONLY that shard:
+  the global summary keeps serving, healthy, with the dead cluster listed
+  degraded and staleness-labeled.  ``..._merge_full_p50_ms`` isolates the
+  merge tier (a cold re-join of 100k cached node bytes + gzip members).
+
 Fleet-API serving evidence (the snapshot-cache tentpole):
 
 * ``serve_etag_hit_p50_ms`` — GET /api/v1/nodes on the 2k-node round with
@@ -742,6 +756,154 @@ def main() -> int:
     os.unlink(watch_kubeconfig)
     checker.reset_client_cache()
 
+    # Multi-cluster federation at 100k-node scale (this PR's tentpole): 20
+    # fixture clusters × 5k nodes, each a REAL FleetStateServer speaking
+    # the production inter-tier protocol, behind one FederationEngine.
+    # The seed round pays 20 full fetches + the full 100k merge; after
+    # that an UNCHANGED round costs one conditional GET per endpoint per
+    # cluster — 304s asserted fixture-side — and the merged nodes entity
+    # is reused whole.  A 1-cluster churn round re-fetches and re-merges
+    # exactly one shard.  Killing one fixture cluster degrades only that
+    # shard while /api/v1/global/summary keeps serving with the dead
+    # cluster labeled stale.
+    from tpu_node_checker.federation.aggregator import FederationEngine
+    from tpu_node_checker.federation.merge import build_global_snapshot
+    from tpu_node_checker.server.app import FleetStateServer as _FedFSS
+
+    fed_clusters = 20
+    fed_nodes_per_cluster = 5000
+
+    def _fed_payload(cname: str, flip: int = 0) -> dict:
+        nodes = [
+            {
+                "name": f"{cname}-tpu-{i:04d}",
+                "ready": True,
+                "accelerators": 4,
+                "families": ["google.com/tpu"],
+                "nodepool": f"{cname}-pool-{i // 250}",
+                "generation": "v5e" if flip % 2 == 0 else "v5p",
+            }
+            for i in range(fed_nodes_per_cluster)
+        ]
+        return {
+            "total_nodes": len(nodes), "ready_nodes": len(nodes),
+            "total_chips": len(nodes) * 4, "ready_chips": len(nodes) * 4,
+            "nodes": nodes, "slices": [], "cluster": cname,
+            "cluster_source": "flag", "exit_code": 0,
+        }
+
+    class _FedRound:
+        def __init__(self, payload):
+            self.payload = payload
+            self.exit_code = 0
+
+    fed_servers = {}
+    for c in range(fed_clusters):
+        cname = f"cluster-{c:02d}"
+        srv = _FedFSS(0, host="127.0.0.1")
+        srv.publish(_FedRound(_fed_payload(cname)))
+        fed_servers[cname] = srv
+    fed_endpoints = tempfile.NamedTemporaryFile(
+        "w", suffix=".endpoints.json", delete=False
+    )
+    json.dump(
+        {"clusters": [
+            {"name": cname, "url": f"http://127.0.0.1:{srv.port}"}
+            for cname, srv in fed_servers.items()
+        ]},
+        fed_endpoints,
+    )
+    fed_endpoints.close()
+    fed_args = cli.parse_args(
+        ["--federate", fed_endpoints.name, "--serve", "0",
+         "--federate-workers", "4", "--retry-budget", "0"]
+    )
+    fed_engine = FederationEngine(fed_args)
+    t0 = time.perf_counter()
+    fed_snap = fed_engine.round()
+    federated_seed_ms = (time.perf_counter() - t0) * 1e3
+    fed_summary = json.loads(fed_snap.entity("global/summary").raw)
+    assert fed_summary["total_nodes"] == fed_clusters * fed_nodes_per_cluster
+    assert fed_summary["healthy"] is True, fed_summary
+    assert fed_summary["clusters"]["fresh"] == fed_clusters
+
+    def _fed_status_counts():
+        counts: dict = {}
+        for srv in fed_servers.values():
+            for (_m, _route, status), n in srv.stats.requests.items():
+                counts[status] = counts.get(status, 0) + n
+        return counts
+
+    before_counts = _fed_status_counts()
+    fed_steady = []
+    for _ in range(21):
+        t0 = time.perf_counter()
+        snap2 = fed_engine.round()
+        fed_steady.append((time.perf_counter() - t0) * 1e3)
+        assert snap2.entity("global/nodes") is fed_snap.entity("global/nodes")
+    federated_steady_p50 = statistics.median(fed_steady)
+    steady_delta = {
+        status: n - before_counts.get(status, 0)
+        for status, n in _fed_status_counts().items()
+        if n != before_counts.get(status, 0)
+    }
+    # Fixture-side ground truth: 21 unchanged rounds × 20 clusters × 2
+    # endpoints = nothing but 304s.
+    assert steady_delta == {304: 21 * fed_clusters * 2}, steady_delta
+
+    # The merge tier alone, full rebuild (prev=None): what a cold
+    # aggregator pays to re-join 100k cached node bytes + gzip members.
+    merge_samples = []
+    fed_views = list(fed_engine.views.values())
+    for _ in range(5):
+        t0 = time.perf_counter()
+        build_global_snapshot(fed_views, 999, time.time(), prev=None)
+        merge_samples.append((time.perf_counter() - t0) * 1e3)
+    federated_merge_full_p50 = statistics.median(merge_samples)
+
+    # 1-cluster churn: republish one upstream round per tick; the round
+    # re-fetches (200s) and re-merges exactly that shard.
+    churn_name = "cluster-07"
+    fed_churn = []
+    for rnd in range(5):
+        fed_servers[churn_name].publish(
+            _FedRound(_fed_payload(churn_name, flip=rnd + 1))
+        )
+        before_fresh = fed_engine.views[churn_name].fetch_fresh
+        t0 = time.perf_counter()
+        snap3 = fed_engine.round()
+        fed_churn.append((time.perf_counter() - t0) * 1e3)
+        assert fed_engine.views[churn_name].fetch_fresh == before_fresh + 2
+        assert snap3.entity("global/nodes") is not fed_snap.entity("global/nodes")
+    federated_churn1_p50 = statistics.median(fed_churn)
+    # O(changed clusters), not O(nodes): an all-304 round and a 1-of-20
+    # churn round must both sit far below the seed's full fetch+merge.
+    assert federated_steady_p50 < federated_seed_ms, (
+        federated_steady_p50, federated_seed_ms
+    )
+    assert federated_churn1_p50 < federated_seed_ms, (
+        federated_churn1_p50, federated_seed_ms
+    )
+
+    # Shard degradation: kill one fixture cluster — the global summary
+    # keeps serving with ONLY that shard degraded and staleness labeled.
+    dead_name = "cluster-13"
+    fed_servers[dead_name].close()
+    fed_snap_dead = fed_engine.round()
+    dead_summary = json.loads(fed_snap_dead.entity("global/summary").raw)
+    assert dead_summary["healthy"] is True, dead_summary  # fresh shards agree
+    assert dead_summary["degraded"] is True
+    assert dead_summary["degraded_clusters"] == [dead_name], dead_summary
+    assert dead_summary["total_nodes"] == fed_clusters * fed_nodes_per_cluster
+    dead_entry = json.loads(
+        fed_snap_dead.cluster_entity(dead_name).raw
+    )["cluster"]
+    assert dead_entry["staleness"]["rounds"] == 1, dead_entry
+    fed_engine.close()
+    for srv in fed_servers.values():
+        srv.close()
+    os.unlink(fed_endpoints.name)
+
     # The 5k-node paged walk over HTTPS — where per-page handshakes hurt
     # most (~11 pages/round).  Pooled transport vs the pre-pool equivalent
     # (keep_alive=False: a fresh connection, and a fresh TLS handshake, per
@@ -844,6 +1006,18 @@ def main() -> int:
                 "serve_sustained_rps": round(serve_rps),
                 "serve_p99_ms": round(serve_p99, 3),
                 "serve_workers": 2,
+                "nodes100k_federated_seed_ms": round(federated_seed_ms, 2),
+                "nodes100k_federated_steady_p50_ms": round(
+                    federated_steady_p50, 2
+                ),
+                "nodes100k_federated_churn1_p50_ms": round(
+                    federated_churn1_p50, 2
+                ),
+                "nodes100k_federated_merge_full_p50_ms": round(
+                    federated_merge_full_p50, 2
+                ),
+                "federated_clusters": fed_clusters,
+                "federated_workers": 4,
                 "nodes5k_paged_https_p50_ms": (
                     round(nodes5k_tls_p50, 2) if nodes5k_tls_p50 is not None else None
                 ),

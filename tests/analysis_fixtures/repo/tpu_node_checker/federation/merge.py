@@ -1,0 +1,26 @@
+"""Seeds for TNC011 on the federation merge shape: the merged-snapshot
+READ path (GlobalSnapshot's entity accessors, what every
+/api/v1/global/* GET rides) takes no locks, while the merge builders —
+run once per round, after the fetch workers joined — legitimately may."""
+
+import threading
+
+
+class GlobalView:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entities = {}
+        self._cluster_entities = {}
+
+    def cluster_entity(self, name):
+        with self._lock:  # EXPECT[TNC011]
+            return self._cluster_entities.get(name)
+
+    def entity(self, key):
+        return self._entities[key]  # near-miss: lock-free read path
+
+    def build_global(self, views):  # near-miss: builder, off the read path
+        with self._lock:
+            merged = {v["name"]: v for v in views}
+        self._entities = {"global/summary": merged}
+        return merged

@@ -1,0 +1,982 @@
+"""Multi-cluster federation: endpoints registry, merge rules, aggregator e2e.
+
+The contracts under test (DESIGN.md §14):
+
+* **shard-degraded-never-fleet** — an unreachable/stale cluster marks only
+  its shard degraded (staleness-labeled, last-known data serving); the
+  global summary keeps answering and ``healthy`` is judged over FRESH
+  clusters only;
+* **O(changed clusters)** — an unchanged cluster costs one 304 per
+  endpoint per round (asserted fixture-side), and the merged nodes entity
+  (bytes, gzip, ETag) is reused BY REFERENCE when nothing moved;
+* **byte identity** — a federated view of one cluster carries that
+  cluster's node entries byte-identical to the cluster's own
+  ``/api/v1/nodes`` body;
+* the endpoints file is live: clusters joining/leaving between rounds
+  reshape the view, a malformed rewrite keeps the last good set;
+* ``tnc --federate`` exits 143 on SIGTERM like every serving mode.
+
+Wall-clock guard: same policy as tests/test_server.py — nothing here
+sleeps for real; fixture fetches are loopback and retries are disabled
+(``--retry-budget 0``) except where a test exercises the ladder.
+"""
+
+import gzip
+import http.client
+import json
+import threading
+import time
+import types
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+from tpu_node_checker.federation.aggregator import FederationEngine, federate
+from tpu_node_checker.federation.endpoints import (
+    EndpointsError,
+    load_endpoints,
+    shard_clusters,
+)
+from tpu_node_checker.federation.merge import (
+    ClusterView,
+    build_global_snapshot,
+    extract_node_entries,
+)
+from tpu_node_checker.server.app import FleetStateServer
+from tpu_node_checker.server.snapshot import build_snapshot
+
+WALL_CLOCK_BUDGET_S = 20.0
+
+
+@pytest.fixture(autouse=True)
+def _wall_clock_guard():
+    t0 = time.perf_counter()
+    yield
+    elapsed = time.perf_counter() - t0
+    assert elapsed < WALL_CLOCK_BUDGET_S, (
+        f"federation test burned {elapsed:.1f}s of wall-clock — a real "
+        "sleep or a wedged fetch leaked in"
+    )
+
+
+def _req(port, method, path, headers=None, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.headers.items()), resp.read()
+    finally:
+        conn.close()
+
+
+def _round_payload(cluster, n, healthy=True, name_prefix=None):
+    prefix = name_prefix or f"{cluster}-node"
+    return {
+        "total_nodes": n,
+        "ready_nodes": n if healthy else 0,
+        "total_chips": n * 4,
+        "ready_chips": n * 4 if healthy else 0,
+        "nodes": [
+            {"name": f"{prefix}-{i}", "ready": healthy,
+             "accelerators": 4, "padding": "x" * 40}
+            for i in range(n)
+        ],
+        "slices": [],
+        "cluster": cluster,
+        "cluster_source": "flag",
+        "exit_code": 0 if healthy else 3,
+    }
+
+
+class _Round:
+    def __init__(self, payload, exit_code=0):
+        self.payload = payload
+        self.exit_code = exit_code
+
+
+def _fixture_cluster(cluster, n, healthy=True, name_prefix=None):
+    """One upstream per-cluster checker: a REAL fleet state API with a
+    published round — the inter-tier protocol is the production wire."""
+    srv = FleetStateServer(0, host="127.0.0.1")
+    payload = _round_payload(cluster, n, healthy=healthy,
+                             name_prefix=name_prefix)
+    srv.publish(_Round(payload, payload["exit_code"]))
+    return srv
+
+
+def _write_endpoints(path, servers):
+    path.write_text(json.dumps({
+        "clusters": [
+            {"name": name, "url": f"http://127.0.0.1:{srv.port}"}
+            for name, srv in servers
+        ]
+    }))
+
+
+def _args(path, extra=()):
+    return cli.parse_args(
+        ["--federate", str(path), "--serve", "0", "--retry-budget", "0",
+         *extra]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Endpoints registry
+# ---------------------------------------------------------------------------
+
+
+class TestEndpoints:
+    def test_load_valid(self, tmp_path):
+        p = tmp_path / "endpoints.json"
+        p.write_text(json.dumps({"clusters": [
+            {"name": "us-a", "url": "http://a:8080/"},
+            {"name": "eu-b", "url": "https://b:8080", "token": "t"},
+        ]}))
+        eps = load_endpoints(str(p))
+        assert [(e.name, e.url, e.token) for e in eps] == [
+            ("us-a", "http://a:8080", None),
+            ("eu-b", "https://b:8080", "t"),
+        ]
+
+    @pytest.mark.parametrize("doc,hint", [
+        ("not json {", "not valid JSON"),
+        (json.dumps([]), "'clusters' list"),
+        (json.dumps({"clusters": []}), "empty"),
+        (json.dumps({"clusters": ["x"]}), "not an object"),
+        (json.dumps({"clusters": [{"url": "http://a"}]}), "no 'name'"),
+        (json.dumps({"clusters": [{"name": "a/b", "url": "http://a"}]}),
+         "must not contain '/'"),
+        (json.dumps({"clusters": [{"name": "a", "url": "ftp://a"}]}),
+         "http(s)"),
+        (json.dumps({"clusters": [{"name": "a", "url": "http://a"},
+                                  {"name": "a", "url": "http://b"}]}),
+         "duplicate"),
+        (json.dumps({"clusters": [{"name": "a", "url": "http://a",
+                                   "token": 5}]}), "token"),
+    ])
+    def test_malformed_is_a_named_error(self, tmp_path, doc, hint):
+        p = tmp_path / "endpoints.json"
+        p.write_text(doc)
+        with pytest.raises(EndpointsError) as err:
+            load_endpoints(str(p))
+        assert hint in str(err.value)
+
+
+class TestShardClusters:
+    def test_every_cluster_assigned_exactly_once(self):
+        names = [f"cluster-{i}" for i in range(50)]
+        shards = shard_clusters(names, 4)
+        flat = [n for shard in shards.values() for n in shard]
+        assert sorted(flat) == sorted(names)
+        assert set(shards) <= set(range(4))
+
+    def test_deterministic_and_stable_under_cluster_churn(self):
+        names = [f"cluster-{i}" for i in range(30)]
+        first = shard_clusters(names, 4)
+        again = shard_clusters(names, 4)
+        assert first == again
+        # Adding clusters never moves an existing one (the consistent-hash
+        # property that keeps a worker's keep-alive connections warm).
+        grown = shard_clusters(names + ["brand-new"], 4)
+        slot_of = {n: s for s, shard in grown.items() for n in shard}
+        for slot, shard in first.items():
+            for name in shard:
+                assert slot_of[name] == slot
+
+    def test_worker_resize_moves_a_minority(self):
+        names = [f"cluster-{i}" for i in range(200)]
+        before = {n: s for s, shard in shard_clusters(names, 4).items()
+                  for n in shard}
+        after = {n: s for s, shard in shard_clusters(names, 5).items()
+                 for n in shard}
+        moved = sum(1 for n in names if before[n] != after[n])
+        # Ideal is ~1/5; allow generous slack, but far below "rehash all".
+        assert moved < len(names) // 2, moved
+
+    def test_single_worker_short_circuit(self):
+        assert shard_clusters(["a", "b"], 1) == {0: ["a", "b"]}
+
+
+# ---------------------------------------------------------------------------
+# Entry extraction + merge units
+# ---------------------------------------------------------------------------
+
+
+class TestExtractNodeEntries:
+    def test_round_trips_a_real_snapshot_body(self):
+        payload = _round_payload("us-a", 5)
+        snap = build_snapshot(payload, 0, 7, 123.0)
+        body = snap.entities["nodes"].raw
+        entries, head = extract_node_entries(body)
+        assert head["round"] == 7 and head["count"] == 5
+        assert head["cluster"] == "us-a"
+        assert json.loads(b"[" + entries + b"]") == payload["nodes"]
+
+    def test_empty_fleet(self):
+        snap = build_snapshot({"nodes": [], "cluster": "us-a"}, 0, 1, 1.0)
+        entries, head = extract_node_entries(snap.entities["nodes"].raw)
+        assert entries == b"" and head["count"] == 0
+
+    def test_malformed_body_raises(self):
+        with pytest.raises(ValueError):
+            extract_node_entries(b'{"no": "nodes here"}')
+
+
+def _view(name, n, healthy=True, stale_rounds=0, url=None):
+    view = ClusterView(name, url or f"http://{name}:8080")
+    payload = _round_payload(name, n, healthy=healthy)
+    snap = build_snapshot(payload, payload["exit_code"], 3, 100.0)
+    entries, head = extract_node_entries(snap.entities["nodes"].raw)
+    view.summary_doc = json.loads(snap.entities["summary"].raw)
+    view.summary_etag = snap.entities["summary"].etag
+    view.nodes_entries = entries
+    view.nodes_etag = snap.entities["nodes"].etag
+    view.nodes_count = head["count"]
+    view.nodes_round = head["round"]
+    view.record_success()
+    for _ in range(stale_rounds):
+        view.record_failure("ConnectionRefusedError: injected")
+    return view
+
+
+class TestMerge:
+    def test_duplicate_node_names_across_clusters_both_survive(self):
+        # The same node name in two clusters is NOT a conflict: the global
+        # view keys cluster/node, so each lives under its cluster block.
+        a2 = ClusterView("us-a", "http://us-a:8080")
+        pa = _round_payload("us-a", 3, name_prefix="shared-node")
+        sa = build_snapshot(pa, 0, 1, 1.0)
+        a2.summary_doc = json.loads(sa.entities["summary"].raw)
+        a2.nodes_entries, ha = extract_node_entries(sa.entities["nodes"].raw)
+        a2.nodes_count, a2.nodes_round = ha["count"], ha["round"]
+        a2.nodes_etag = sa.entities["nodes"].etag
+        a2.record_success()
+        b2 = ClusterView("eu-b", "http://eu-b:8080")
+        pb = _round_payload("eu-b", 2, name_prefix="shared-node")
+        sb = build_snapshot(pb, 0, 1, 1.0)
+        b2.summary_doc = json.loads(sb.entities["summary"].raw)
+        b2.nodes_entries, hb = extract_node_entries(sb.entities["nodes"].raw)
+        b2.nodes_count, b2.nodes_round = hb["count"], hb["round"]
+        b2.nodes_etag = sb.entities["nodes"].etag
+        b2.record_success()
+        snap = build_global_snapshot([a2, b2], 1, 10.0)
+        doc = json.loads(snap.entity("global/nodes").raw)
+        assert doc["count"] == 5
+        by_cluster = {c["cluster"]: c for c in doc["clusters"]}
+        assert [n["name"] for n in by_cluster["us-a"]["nodes"]] == [
+            "shared-node-0", "shared-node-1", "shared-node-2"
+        ]
+        assert [n["name"] for n in by_cluster["eu-b"]["nodes"]] == [
+            "shared-node-0", "shared-node-1"
+        ]
+
+    def test_one_stale_one_fresh_summary_semantics(self):
+        fresh = _view("us-a", 4)
+        stale = _view("eu-b", 2, stale_rounds=3)
+        snap = build_global_snapshot([fresh, stale], 5, 10.0)
+        summary = json.loads(snap.entity("global/summary").raw)
+        # The fleet verdict comes from the FRESH cluster; the stale shard
+        # is labeled, its last-known numbers still counted.
+        assert summary["healthy"] is True
+        assert summary["degraded"] is True
+        assert summary["degraded_clusters"] == ["eu-b"]
+        assert summary["clusters"] == {
+            "total": 2, "with_data": 2, "fresh": 1, "degraded": 1
+        }
+        assert summary["total_nodes"] == 6  # 4 fresh + 2 last-known
+        clusters = json.loads(snap.entity("global/clusters").raw)["clusters"]
+        stale_entry = next(c for c in clusters if c["cluster"] == "eu-b")
+        assert stale_entry["degraded"] is True
+        assert stale_entry["staleness"]["rounds"] == 3
+        assert "injected" in stale_entry["error"]
+        # The stale cluster's block is marked stale in the nodes body too.
+        nodes = json.loads(snap.entity("global/nodes").raw)
+        marked = {c["cluster"]: c.get("stale") for c in nodes["clusters"]}
+        assert marked == {"us-a": None, "eu-b": True}
+
+    def test_unhealthy_fresh_cluster_sinks_global_healthy(self):
+        good = _view("us-a", 2)
+        bad = _view("eu-b", 2, healthy=False)
+        summary = json.loads(
+            build_global_snapshot([good, bad], 1, 1.0)
+            .entity("global/summary").raw
+        )
+        assert summary["healthy"] is False
+        assert summary["unhealthy_clusters"] == ["eu-b"]
+        assert summary["degraded"] is False  # unhealthy ≠ degraded shard
+
+    def test_no_fresh_data_is_not_healthy_but_still_serves(self):
+        stale = _view("us-a", 3, stale_rounds=1)
+        summary = json.loads(
+            build_global_snapshot([stale], 1, 1.0)
+            .entity("global/summary").raw
+        )
+        assert summary["healthy"] is False
+        assert summary["total_nodes"] == 3  # last-known keeps serving
+
+    def test_nodes_entity_reused_by_reference_when_unchanged(self):
+        a, b = _view("us-a", 3), _view("eu-b", 3)
+        first = build_global_snapshot([a, b], 1, 1.0)
+        second = build_global_snapshot([a, b], 2, 2.0, prev=first)
+        assert second.entity("global/nodes") is first.entity("global/nodes")
+        # A freshness flip invalidates exactly that cluster's block.
+        b.record_failure("boom")
+        block_a = a.block()
+        third = build_global_snapshot([a, b], 3, 3.0, prev=second)
+        assert third.entity("global/nodes") is not first.entity("global/nodes")
+        assert a.block() is block_a  # unchanged cluster: bytes reused
+
+    def test_etagless_upstream_content_change_rebuilds_nodes(self):
+        # An upstream behind a validator-stripping proxy sends no ETag;
+        # the fetch tier then keys the merge caches on a content hash
+        # (nodes_fp) — without it the global nodes body would freeze at
+        # its first-fetched content forever.
+        view = _view("us-a", 2)
+        view.nodes_etag = None
+        view.nodes_fp = "sha256:first"
+        first = build_global_snapshot([view], 1, 1.0)
+        payload = _round_payload("us-a", 3)
+        snap = build_snapshot(payload, 0, 2, 2.0)
+        view.nodes_entries, head = extract_node_entries(
+            snap.entities["nodes"].raw
+        )
+        view.nodes_count = head["count"]
+        view.nodes_fp = "sha256:second"
+        second = build_global_snapshot([view], 2, 2.0, prev=first)
+        assert second.entity("global/nodes") is not first.entity("global/nodes")
+        assert json.loads(second.entity("global/nodes").raw)["count"] == 3
+        # ... while an unchanged fingerprint still reuses by reference.
+        third = build_global_snapshot([view], 3, 3.0, prev=second)
+        assert third.entity("global/nodes") is second.entity("global/nodes")
+        # A round advance over IDENTICAL entries (fp unchanged) must still
+        # rebuild — the block head embeds the upstream round, and the
+        # content hash covers only the entries bytes.
+        view.nodes_round = (view.nodes_round or 0) + 1
+        fourth = build_global_snapshot([view], 4, 4.0, prev=third)
+        assert fourth.entity("global/nodes") is not third.entity("global/nodes")
+        by_cluster = json.loads(fourth.entity("global/nodes").raw)["clusters"]
+        assert by_cluster[0]["round"] == view.nodes_round
+
+    def test_gzip_member_concat_decompresses_byte_identical(self):
+        views = [_view(f"c{i:02d}", 8) for i in range(4)]
+        snap = build_global_snapshot(views, 1, 1.0)
+        entity = snap.entity("global/nodes")
+        assert entity.gz is not None
+        assert gzip.decompress(entity.gz) == entity.raw
+
+
+# ---------------------------------------------------------------------------
+# Aggregator end-to-end (real fixture clusters, real HTTP both tiers)
+# ---------------------------------------------------------------------------
+
+
+class TestFederationE2E:
+    def _fleet(self, tmp_path, specs):
+        servers = [(name, _fixture_cluster(name, n)) for name, n in specs]
+        endpoints = tmp_path / "endpoints.json"
+        _write_endpoints(endpoints, servers)
+        return dict(servers), endpoints
+
+    def test_merged_view_serves_and_polls_304(self, tmp_path):
+        servers, endpoints = self._fleet(
+            tmp_path, [("us-a", 5), ("eu-b", 3)]
+        )
+        engine = FederationEngine(_args(endpoints))
+        agg = FleetStateServer(0, host="127.0.0.1", federation=True,
+                               readiness=engine.readiness)
+        try:
+            engine.round(agg)
+            status, headers, body = _req(agg.port, "GET", "/api/v1/global/summary")
+            assert status == 200
+            summary = json.loads(body)
+            assert summary["healthy"] is True
+            assert summary["total_nodes"] == 8
+            etag = headers["ETag"]
+            # A poller re-sending the ETag rides a 304 — the global surface
+            # speaks the same conditional protocol as the per-cluster tier.
+            status, _, _ = _req(agg.port, "GET", "/api/v1/global/summary",
+                                headers={"If-None-Match": etag})
+            assert status == 304
+            status, _, body = _req(agg.port, "GET", "/api/v1/global/nodes")
+            doc = json.loads(body)
+            assert doc["count"] == 8 and doc["cluster_count"] == 2
+            status, _, body = _req(
+                agg.port, "GET", "/api/v1/global/clusters/eu-b"
+            )
+            assert status == 200
+            assert json.loads(body)["cluster"]["reachable"] is True
+            assert _req(agg.port, "GET", "/api/v1/global/clusters/nope")[0] == 404
+            # The per-cluster round surface redirects, not 503s, here.
+            status, _, body = _req(agg.port, "GET", "/api/v1/summary")
+            assert status == 404 and b"global" in body
+        finally:
+            agg.close()
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_steady_round_costs_one_304_per_endpoint(self, tmp_path):
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 4)])
+        engine = FederationEngine(_args(endpoints))
+        try:
+            first = engine.round()
+            upstream = servers["us-a"]
+            before = dict(upstream.stats.requests)
+            second = engine.round()
+            after = dict(upstream.stats.requests)
+            delta = {k: after[k] - before.get(k, 0)
+                     for k in after if after[k] != before.get(k, 0)}
+            # Fixture-side ground truth: the unchanged round cost exactly
+            # one conditional GET per endpoint, both answered 304.
+            assert delta == {
+                ("GET", "/api/v1/summary", 304): 1,
+                ("GET", "/api/v1/nodes", 304): 1,
+            }, delta
+            assert second.entity("global/nodes") is first.entity("global/nodes")
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_killed_cluster_degrades_only_its_shard(self, tmp_path):
+        servers, endpoints = self._fleet(
+            tmp_path, [("us-a", 5), ("eu-b", 3)]
+        )
+        engine = FederationEngine(_args(endpoints))
+        agg = FleetStateServer(0, host="127.0.0.1", federation=True,
+                               readiness=engine.readiness)
+        try:
+            engine.round(agg)
+            servers["eu-b"].close()
+            engine.round(agg)
+            status, _, body = _req(agg.port, "GET", "/api/v1/global/summary")
+            assert status == 200  # the fleet keeps serving
+            summary = json.loads(body)
+            assert summary["healthy"] is True  # judged over fresh shards
+            assert summary["degraded"] is True
+            assert summary["degraded_clusters"] == ["eu-b"]
+            assert summary["total_nodes"] == 8  # last-known still counted
+            # /readyz stays 200 (not blind) and carries per-cluster detail.
+            status, _, body = _req(agg.port, "GET", "/readyz")
+            assert status == 200
+            detail = json.loads(body)["clusters"]["eu-b"]
+            assert detail["reachable"] is False
+            assert detail["staleness_rounds"] == 1
+            # Staleness grows per round.
+            engine.round(agg)
+            _, _, body = _req(agg.port, "GET", "/api/v1/global/clusters/eu-b")
+            assert json.loads(body)["cluster"]["staleness"]["rounds"] == 2
+            # Kill the LAST cluster too: the aggregator goes blind → 503.
+            servers["us-a"].close()
+            engine.round(agg)
+            status, _, body = _req(agg.port, "GET", "/readyz")
+            assert status == 503
+            assert "every cluster shard is degraded" in json.loads(body)["reason"]
+            # ... while the data surface still serves the labeled view.
+            assert _req(agg.port, "GET", "/api/v1/global/summary")[0] == 200
+        finally:
+            agg.close()
+            engine.close()
+
+    def test_cluster_disappearing_and_joining_between_rounds(self, tmp_path):
+        servers, endpoints = self._fleet(
+            tmp_path, [("us-a", 2), ("eu-b", 2)]
+        )
+        engine = FederationEngine(_args(endpoints))
+        try:
+            snap = engine.round()
+            assert json.loads(snap.entity("global/summary").raw)[
+                "clusters"]["total"] == 2
+            # eu-b leaves the endpoints file between rounds.
+            _write_endpoints(endpoints, [("us-a", servers["us-a"])])
+            snap = engine.round()
+            summary = json.loads(snap.entity("global/summary").raw)
+            assert summary["clusters"]["total"] == 1
+            assert summary["total_nodes"] == 2
+            doc = json.loads(snap.entity("global/nodes").raw)
+            assert [c["cluster"] for c in doc["clusters"]] == ["us-a"]
+            assert snap.cluster_entity("eu-b") is None
+            # A third cluster joins.
+            servers["ap-c"] = _fixture_cluster("ap-c", 1)
+            _write_endpoints(
+                endpoints,
+                [("us-a", servers["us-a"]), ("ap-c", servers["ap-c"])],
+            )
+            snap = engine.round()
+            assert json.loads(snap.entity("global/summary").raw)[
+                "total_nodes"] == 3
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_malformed_endpoints_rewrite_keeps_last_good_set(self, tmp_path):
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 2)])
+        engine = FederationEngine(_args(endpoints))
+        try:
+            engine.round()
+            endpoints.write_text("{ not json")
+            snap = engine.round()  # keeps serving the last good registry
+            summary = json.loads(snap.entity("global/summary").raw)
+            assert summary["clusters"]["total"] == 1
+            assert summary["healthy"] is True
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_single_cluster_federated_view_is_byte_identical(self, tmp_path):
+        """The merge adds nothing and loses nothing: one cluster's entries
+        inside the global nodes body are the cluster's own bytes, and the
+        embedded summary is the cluster's own summary doc."""
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 6)])
+        engine = FederationEngine(_args(endpoints))
+        try:
+            snap = engine.round()
+            _, _, upstream_nodes = _req(
+                servers["us-a"].port, "GET", "/api/v1/nodes"
+            )
+            upstream_entries, head = extract_node_entries(upstream_nodes)
+            global_body = snap.entity("global/nodes").raw
+            # The cluster's block inside the global body is EXACTLY its own
+            # entries bytes, re-framed — nothing re-encoded, nothing lost.
+            expected_block = (
+                json.dumps(
+                    {"cluster": "us-a", "round": head["round"],
+                     "count": head["count"]},
+                    ensure_ascii=False,
+                )[:-1].encode("utf-8")
+                + b', "nodes": [' + upstream_entries + b"]}"
+            )
+            assert expected_block in global_body
+            assert global_body.count(upstream_entries) == 1
+            _, _, upstream_summary = _req(
+                servers["us-a"].port, "GET", "/api/v1/summary"
+            )
+            embedded = json.loads(snap.cluster_entity("us-a").raw)["summary"]
+            assert embedded == json.loads(upstream_summary)
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_upstream_name_mismatch_is_surfaced(self, tmp_path):
+        srv = _fixture_cluster("their-name", 2)
+        endpoints = tmp_path / "endpoints.json"
+        _write_endpoints(endpoints, [("our-name", srv)])
+        engine = FederationEngine(_args(endpoints))
+        try:
+            snap = engine.round()
+            entry = json.loads(snap.cluster_entity("our-name").raw)["cluster"]
+            assert entry["reported_cluster"] == "their-name"
+        finally:
+            engine.close()
+            srv.close()
+
+    def test_federate_mode_loop_exits_143_on_sigterm(self, tmp_path, monkeypatch):
+        """The exit-code contract: the aggregator is a serving mode and
+        stops cleanly like one (cf. serve_store / watch)."""
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 2)])
+        seen = {}
+        monkeypatch.setattr(
+            checker, "_wait_for_next_round",
+            lambda stop, s: seen.setdefault("waited", True) or True,
+        )
+        try:
+            rc = federate(_args(endpoints))
+            assert rc == 128 + 15
+            assert seen == {"waited": True}
+        finally:
+            for srv in servers.values():
+                srv.close()
+
+    def test_global_routes_on_a_plain_checker_404_helpfully(self):
+        srv = _fixture_cluster("us-a", 1)
+        try:
+            status, _, body = _req(srv.port, "GET", "/api/v1/global/summary")
+            assert status == 404
+            assert b"--federate" in body
+            status, _, body = _req(srv.port, "GET", "/api/v1/global/clusters/x")
+            assert status == 404
+        finally:
+            srv.close()
+
+    def test_federation_metrics_families(self, tmp_path):
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 2)])
+        engine = FederationEngine(_args(endpoints))
+        agg = FleetStateServer(0, host="127.0.0.1", federation=True,
+                               readiness=engine.readiness)
+        try:
+            engine.round(agg)
+            servers["us-a"].close()
+            engine.round(agg)
+            _, _, body = _req(agg.port, "GET", "/metrics")
+            text = body.decode()
+            assert ('tpu_node_checker_federation_clusters{state="degraded"} '
+                    '1.0') in text
+            assert ('tpu_node_checker_federation_cluster_up{cluster="us-a"} '
+                    '0.0') in text
+            assert ('tpu_node_checker_federation_staleness_rounds'
+                    '{cluster="us-a"} 1.0') in text
+            assert ('tpu_node_checker_federation_fetch_total{cluster="us-a",'
+                    'result="fresh"} 2' in text)
+            assert "tpu_node_checker_federation_round_duration_ms" in text
+            assert "tpu_node_checker_federation_workers 4.0" in text
+            assert "tpu_node_checker_last_run_timestamp_seconds" in text
+            # The aggregator's own serving telemetry rides along.
+            assert "tpu_node_checker_api_server_requests_total" in text
+        finally:
+            agg.close()
+            engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Fetch-tier hardening (review regressions)
+# ---------------------------------------------------------------------------
+
+
+class TestFetchTierHardening:
+    def test_mangled_200_does_not_poison_the_etag_cache(self, tmp_path):
+        # A truncated/mangled 200 marks the shard failed for the round —
+        # and must NOT leave the view holding the NEW validator with the
+        # OLD data, or the next round's 304 would launder stale state as
+        # fresh until the upstream changes again.
+        servers, endpoints = TestFederationE2E._fleet(
+            self, tmp_path, [("us-a", 3)]
+        )
+        engine = FederationEngine(
+            _args(endpoints, extra=("--federate-workers", "1"))
+        )
+        try:
+            engine.round()  # seed: 3 nodes, clean
+            payload = _round_payload("us-a", 4)
+            servers["us-a"].publish(_Round(payload, 0))
+            session = engine._session(0)
+            real_get = session.get
+            corrupt = [True]
+
+            def truncating_get(url, **kw):
+                resp = real_get(url, **kw)
+                if url.endswith("/api/v1/nodes") and corrupt[0]:
+                    corrupt[0] = False
+                    resp._body = resp._body[:-10]
+                return resp
+
+            session.get = truncating_get
+            engine.round()  # fresh 200, body mangled in flight
+            view = engine.views["us-a"]
+            assert view.stale and "ValueError" in view.last_error
+            snap = engine.round()  # clean again: MUST refetch, not 304
+            assert not engine.views["us-a"].stale
+            doc = json.loads(snap.entity("global/nodes").raw)
+            assert doc["count"] == 4  # the post-mangle content, not round 1's
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_fetch_tier_fingerprints_etagless_bodies(self, tmp_path):
+        # _fetch_cluster must mint a content fingerprint when the upstream
+        # sends no ETag (validator-stripping proxy): same body → same fp,
+        # changed body → changed fp, so the merge caches track content.
+        servers, endpoints = TestFederationE2E._fleet(
+            self, tmp_path, [("us-a", 2)]
+        )
+        try:
+            engine = FederationEngine(_args(endpoints))
+            view = engine.views["us-a"]
+            upstream = servers["us-a"]
+            nodes_body = _req(upstream.port, "GET", "/api/v1/nodes")[2]
+            summary_body = _req(upstream.port, "GET", "/api/v1/summary")[2]
+
+            class _StrippedResp:
+                def __init__(self, body):
+                    self.status_code = 200
+                    self.content = body
+                    self.headers = {}  # no validators survive the proxy
+
+                def json(self):
+                    return json.loads(self.content)
+
+            bodies = {"/api/v1/nodes": nodes_body,
+                      "/api/v1/summary": summary_body}
+            session = types.SimpleNamespace(
+                get=lambda url, headers=None, timeout=None: _StrippedResp(
+                    bodies["/" + url.split("/", 3)[3]]
+                )
+            )
+            engine._fetch_cluster(session, view)
+            assert view.nodes_etag is None
+            fp = view.nodes_fp
+            assert fp and fp.startswith("sha256:")
+            engine._fetch_cluster(session, view)
+            assert view.nodes_fp == fp  # unchanged body, stable fp
+            payload = _round_payload("us-a", 5)
+            upstream.publish(_Round(payload, 0))
+            bodies["/api/v1/nodes"] = _req(
+                upstream.port, "GET", "/api/v1/nodes"
+            )[2]
+            engine._fetch_cluster(session, view)
+            assert view.nodes_fp != fp
+            engine.close()
+        finally:
+            for srv in servers.values():
+                srv.close()
+
+    def test_dead_cluster_backs_off_without_starving_shardmates(self, tmp_path):
+        # Per-cluster fetch breaker: a persistently failing upstream is
+        # re-dialed on the WatchBreaker cadence (every 2nd, 4th, then 8th
+        # round after 3 straight failures) instead of costing its worker —
+        # and every shard-mate behind it — the fetch timeout every round.
+        servers, endpoints = TestFederationE2E._fleet(
+            self, tmp_path, [("us-a", 2), ("eu-b", 2)]
+        )
+        engine = FederationEngine(
+            _args(endpoints, extra=("--federate-workers", "1"))
+        )
+        try:
+            dead_port = servers["eu-b"].port
+            servers["eu-b"].close()
+            for _ in range(6):
+                engine.round()
+            dead = engine.views["eu-b"]
+            # Dial cadence: attempts on rounds 1, 2, 3, 5 only — round 5's
+            # failure re-opened the breaker for 3 more skipped rounds.
+            assert dead.fetch_errors == 4, dead.fetch_errors
+            ok, _, detail = engine.readiness()
+            assert ok
+            assert detail["clusters"]["eu-b"]["breaker_backoff_rounds"] == 2
+            engine.round()
+            engine.round()
+            assert dead.fetch_errors == 4  # rounds 6-8 never dialed
+            # Staleness never stops counting — skipped rounds are honest.
+            assert dead.rounds_behind == 8
+            # The shard-mate sharing the single worker stayed fresh every
+            # round (1 seed round of 200s + 7 all-304 rounds).
+            mate = engine.views["us-a"]
+            assert not mate.stale
+            assert mate.fetch_not_modified == 2 * 7, mate.fetch_not_modified
+            # Recovery on the next attempted round closes the breaker.
+            servers["eu-b"] = FleetStateServer(dead_port, host="127.0.0.1")
+            servers["eu-b"].publish(_Round(_round_payload("eu-b", 2), 0))
+            engine.round()  # round 9: the breaker's next allowed attempt
+            assert not dead.stale and dead.backoff_skip == 0
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_shard_transitions_logged_once_per_edge(self, tmp_path, capsys):
+        servers, endpoints = TestFederationE2E._fleet(
+            self, tmp_path, [("us-a", 2), ("eu-b", 2)]
+        )
+        engine = FederationEngine(_args(endpoints))
+        try:
+            port = servers["eu-b"].port
+            engine.round()
+            # A clean first round logs NO transitions ("recovered" for a
+            # shard that was never lost would be startup noise).
+            assert "shard" not in capsys.readouterr().err
+            servers["eu-b"].close()
+            engine.round()
+            err = capsys.readouterr().err
+            assert "cluster 'eu-b' shard DEGRADED" in err
+            assert "us-a" not in err
+            engine.round()  # still down: the edge already logged
+            assert "DEGRADED" not in capsys.readouterr().err
+            servers["eu-b"] = FleetStateServer(port, host="127.0.0.1")
+            servers["eu-b"].publish(_Round(_round_payload("eu-b", 2), 0))
+            engine.round()
+            err = capsys.readouterr().err
+            assert "cluster 'eu-b' shard recovered" in err
+            engine.round()
+            assert "shard" not in capsys.readouterr().err
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI validation
+# ---------------------------------------------------------------------------
+
+
+class TestFederateCliValidation:
+    def test_requires_serve(self):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--federate", "eps.json"])
+
+    @pytest.mark.parametrize("extra", [
+        ["--watch", "30"],
+        ["--kubeconfig", "kc"],
+        ["--cluster-name", "x"],
+        ["--nodes-json", "f.json"],
+        ["--probe"],
+        ["--history", "h.jsonl"],
+        ["--log-jsonl", "t.jsonl"],
+        ["--slack-webhook", "https://hooks.example"],
+        ["--cordon-failed"],
+        ["--serve-token", "t"],
+        ["--write-rps", "5"],
+        ["--json"],
+        ["--trace", "t.json"],
+    ])
+    def test_round_and_write_flags_rejected(self, extra):
+        # Silent-no-op rule: the aggregator runs no rounds and serves no
+        # write path, so these flags must error, not quietly do nothing.
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--federate", "eps.json", "--serve", "0", *extra])
+
+    @pytest.mark.parametrize("extra", [
+        ["--federate-interval", "5"],
+        ["--federate-workers", "2"],
+    ])
+    def test_federate_knobs_require_federate(self, extra):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--serve", "0", "--history", "h.jsonl", *extra])
+
+    @pytest.mark.parametrize("extra", [
+        ["--federate-interval", "0"],
+        ["--federate-interval", "-1"],
+        ["--federate-workers", "0"],
+    ])
+    def test_bounds(self, extra):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--federate", "eps.json", "--serve", "0", *extra])
+
+    def test_accepted_shape(self):
+        args = cli.parse_args(
+            ["--federate", "eps.json", "--serve", "8080",
+             "--federate-interval", "5", "--federate-workers", "8",
+             "--serve-workers", "2", "--retry-budget", "3"]
+        )
+        assert args.federate == "eps.json"
+        assert args.federate_interval == 5.0
+        assert args.federate_workers == 8
+
+
+# ---------------------------------------------------------------------------
+# Cluster identity (--cluster-name satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestClusterIdentity:
+    def _run(self, extra=(), env=None, monkeypatch=None):
+        if env:
+            for k, v in env.items():
+                monkeypatch.setenv(k, v)
+        args = cli.parse_args(["--json", *extra])
+        return checker.run_check(args, nodes=fx.tpu_v5e_256_slice())
+
+    def test_payload_always_stamped_default_hostname(self, monkeypatch):
+        monkeypatch.delenv("TNC_CLUSTER_NAME", raising=False)
+        import socket
+
+        result = self._run()
+        assert result.payload["cluster"] == socket.gethostname()
+        assert result.payload["cluster_source"] == "hostname"
+
+    def test_flag_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("TNC_CLUSTER_NAME", "from-env")
+        result = self._run(extra=("--cluster-name", "from-flag"))
+        assert result.payload["cluster"] == "from-flag"
+        assert result.payload["cluster_source"] == "flag"
+
+    def test_env_fallback(self, monkeypatch):
+        result = self._run(env={"TNC_CLUSTER_NAME": "from-env"},
+                           monkeypatch=monkeypatch)
+        assert result.payload["cluster"] == "from-env"
+        assert result.payload["cluster_source"] == "env"
+
+    def test_kube_context_beats_hostname(self):
+        client = types.SimpleNamespace(
+            config=types.SimpleNamespace(context_name="gke-us-central2")
+        )
+        args = cli.parse_args(["--json"])
+        assert checker.resolve_cluster_name(args, client) == (
+            "gke-us-central2", "context"
+        )
+
+    def test_explicit_name_labels_round_metric_families(self, monkeypatch):
+        from tpu_node_checker.metrics import render_metrics
+
+        monkeypatch.delenv("TNC_CLUSTER_NAME", raising=False)
+        labeled = render_metrics(self._run(extra=("--cluster-name", "us-a")))
+        assert ('tpu_node_checker_nodes{cluster="us-a",state="ready"} 64'
+                in labeled)
+        assert ('tpu_node_checker_cluster_info{cluster="us-a",'
+                'source="flag"} 1.0') in labeled
+        # The watch-breaker families ride the same label — they are exactly
+        # the series a multi-cluster dashboard aggregates by (cluster).
+        with_breaker = render_metrics(
+            self._run(extra=("--cluster-name", "us-a")),
+            breaker={"open": True, "consecutive_failures": 3},
+        )
+        assert ('tpu_node_checker_watch_breaker_open{cluster="us-a"} 1.0'
+                in with_breaker)
+        assert ('tpu_node_checker_watch_breaker_consecutive_failures'
+                '{cluster="us-a"} 3.0') in with_breaker
+        # Inferred defaults stamp the payload (info family) but never the
+        # per-family labels — hostname churn must not mint new series.
+        default = render_metrics(self._run())
+        assert 'tpu_node_checker_nodes{state="ready"} 64' in default
+        assert "tpu_node_checker_cluster_info{cluster=" in default
+
+    def test_snapshot_heads_carry_the_cluster(self):
+        result = self._run(extra=("--cluster-name", "us-a"))
+        snap = build_snapshot(result.payload, result.exit_code, 1, 1.0)
+        assert json.loads(snap.entities["summary"].raw)["cluster"] == "us-a"
+        assert json.loads(snap.entities["nodes"].raw)["cluster"] == "us-a"
+        assert json.loads(snap.entities["slices"].raw)["cluster"] == "us-a"
+
+
+# ---------------------------------------------------------------------------
+# Router percent-decoding pins (prerequisite for cluster/node keys)
+# ---------------------------------------------------------------------------
+
+
+class TestRouterPercentDecoding:
+    def test_encoded_slash_reaches_the_handler_decoded(self):
+        payload = {
+            "total_nodes": 1, "ready_nodes": 1,
+            "nodes": [{"name": "us-a/node-0", "ready": True}],
+            "slices": [],
+        }
+        srv = FleetStateServer(0, host="127.0.0.1")
+        srv.publish(_Round(payload))
+        try:
+            status, _, body = _req(srv.port, "GET", "/api/v1/nodes/us-a%2Fnode-0")
+            assert status == 200
+            assert json.loads(body)["node"]["name"] == "us-a/node-0"
+            # A literal slash is a path separator, never a name.
+            assert _req(srv.port, "GET", "/api/v1/nodes/us-a/node-0")[0] == 404
+        finally:
+            srv.close()
+
+    def test_encoded_static_segment_matches_its_route(self):
+        srv = _fixture_cluster("us-a", 1)
+        try:
+            status, _, body = _req(srv.port, "GET", "/api/v1/%6Eodes")
+            assert status == 200
+            assert json.loads(body)["count"] == 1
+        finally:
+            srv.close()
+
+    def test_double_encoding_decodes_exactly_once(self):
+        payload = {
+            "total_nodes": 1, "ready_nodes": 1,
+            "nodes": [{"name": "a%2Fb", "ready": True}],  # literal percent
+            "slices": [],
+        }
+        srv = FleetStateServer(0, host="127.0.0.1")
+        srv.publish(_Round(payload))
+        try:
+            # %252F decodes once to the literal text "%2F" — the node's
+            # actual name — never twice to a slash.
+            status, _, body = _req(srv.port, "GET", "/api/v1/nodes/a%252Fb")
+            assert status == 200
+            assert json.loads(body)["node"]["name"] == "a%2Fb"
+        finally:
+            srv.close()

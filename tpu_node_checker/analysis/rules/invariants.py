@@ -110,6 +110,19 @@ class BlockingReadPath(Rule):
             for node in ast.walk(ctx.tree):
                 if isinstance(node, ast.FunctionDef) and node.name == "negotiate":
                     yield node
+        elif ctx.path == "tpu_node_checker/federation/merge.py":
+            # The merged-snapshot read path: GlobalSnapshot's accessors
+            # answer every /api/v1/global/* GET — a lock there serializes
+            # the aggregator's whole read surface.  Builders (build_*) and
+            # the per-cluster byte caches (block/gz_member: written only by
+            # the round thread, after the fetch workers joined) are the
+            # merge's job and legitimately do heavy work.
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.FunctionDef) and (
+                    node.name in ("entity", "cluster_entity")
+                    or node.name.startswith("_get")
+                ):
+                    yield node
         elif ctx.path == "tpu_node_checker/server/workers.py":
             # The accept-loop read path: the serve loop, fast-table
             # responders and header extraction run per request — a lock

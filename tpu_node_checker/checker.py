@@ -918,6 +918,40 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> 
     return report_entry
 
 
+def resolve_cluster_name(args, client=None):
+    """This checker's cluster identity → ``(name, source)``.
+
+    Precedence: ``--cluster-name`` flag → ``$TNC_CLUSTER_NAME`` → the
+    kubeconfig context the round resolved through → the hostname.  The name
+    is stamped into every payload (and therefore every served snapshot) as
+    the ``cluster`` key — the field a federation aggregator merges on.
+    ``source`` records the provenance: metric labeling keys on it
+    (explicitly configured names label round families; inferred defaults do
+    not, because a pod hostname churns per restart and would mint a new
+    Prometheus series every rollout).
+    """
+    flag = getattr(args, "cluster_name", None)
+    if flag:
+        return flag, "flag"
+    env = os.environ.get("TNC_CLUSTER_NAME")
+    if env:
+        return env, "env"
+    context = getattr(getattr(client, "config", None), "context_name", None)
+    if context:
+        return context, "context"
+    import socket
+
+    return socket.gethostname(), "hostname"
+
+
+def stamp_cluster_identity(payload: dict, args, client=None) -> None:
+    """Stamp the resolved cluster identity into one round payload — ONE
+    definition shared by ``run_check`` and the watch-stream tick."""
+    name, source = resolve_cluster_name(args, client)
+    payload["cluster"] = name
+    payload["cluster_source"] = source
+
+
 def grade_fleet(args, accel, effective_ready, slices):
     """The exit-code ladder plus the ``--expected-chips`` capacity math —
     ONE definition shared by ``run_check`` (one-shot / poll rounds) and the
@@ -1129,6 +1163,7 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
             stats = getattr(live_client, "transport_stats", lambda: {})()
             if stats:
                 payload["api_transport"] = stats
+        stamp_cluster_identity(payload, args, live_client)
         payload["exit_code"] = result.exit_code
     payload["timings_ms"] = timer.as_dict()
     result.payload = payload

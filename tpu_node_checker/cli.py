@@ -61,6 +61,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--context", help="kubeconfig context to use (default: current-context)")
     p.add_argument("--json", action="store_true", help="machine-readable JSON output")
     p.add_argument(
+        "--cluster-name",
+        metavar="NAME",
+        help="this checker's cluster identity (or $TNC_CLUSTER_NAME; "
+        "default: the kubeconfig context, else the hostname) — stamped "
+        "into every payload and served snapshot as the 'cluster' key, the "
+        "identity a federation aggregator merges on; explicitly "
+        "configured names (flag or env) additionally label every round "
+        "metric family with cluster=NAME (inferred defaults stay "
+        "label-free so pod-restart hostname churn cannot mint new series)",
+    )
+    p.add_argument(
         "--label-selector",
         help="server-side node label selector for the LIST call "
         "(e.g. 'cloud.google.com/gke-tpu-accelerator')",
@@ -168,6 +179,34 @@ def build_parser() -> argparse.ArgumentParser:
                        "minimum 1); refusals answer 429 with a Retry-After "
                        "the caller's retry ladder can honor (default: "
                        "unlimited)")
+
+    federate = p.add_argument_group(
+        "Multi-cluster federation (a stateless aggregator over N checkers)"
+    )
+    federate.add_argument("--federate", metavar="ENDPOINTS_JSON",
+                          help="aggregator mode (requires --serve): poll the "
+                          "per-cluster fleet state APIs registered in "
+                          "ENDPOINTS_JSON with conditional GETs (an "
+                          "unchanged cluster costs one 304 per endpoint), "
+                          "merge them into a global view keyed "
+                          "cluster/node, and serve /api/v1/global/"
+                          "{summary,clusters,clusters/NAME,nodes} — an "
+                          "unreachable or stale cluster degrades only its "
+                          "shard (staleness-labeled), never the fleet; the "
+                          "file is re-read between rounds, so a ConfigMap "
+                          "rollout adds/removes clusters live; runs no "
+                          "check rounds of its own")
+    federate.add_argument("--federate-interval", type=float, default=None,
+                          metavar="SECONDS",
+                          help="with --federate: seconds between fetch+merge "
+                          "rounds (default 10)")
+    federate.add_argument("--federate-workers", type=int, default=None,
+                          metavar="N",
+                          help="with --federate: fetcher threads the cluster "
+                          "set is consistent-hash sharded across (default "
+                          "4); assignments are stable under cluster churn, "
+                          "so each worker's keep-alive connections stay "
+                          "warm")
 
     probe = p.add_argument_group("Chip probe (data-plane liveness)")
     probe.add_argument("--probe", action="store_true",
@@ -392,6 +431,69 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         if args.write_rps <= 0:
             p.error("--write-rps must be positive (omit the flag for "
                     "unlimited writes)")
+    if args.federate:
+        if args.serve is None:
+            p.error("--federate requires --serve PORT (serving the merged "
+                    "global view is the aggregator's whole job)")
+        if args.federate_interval is not None and args.federate_interval <= 0:
+            p.error("--federate-interval must be a positive number of seconds")
+        if args.federate_workers is not None and args.federate_workers < 1:
+            p.error("--federate-workers must be at least 1")
+        for flag, on in (
+            # The aggregator runs NO check rounds and talks to NO
+            # apiserver: every round/probe/quarantine/notify flag would
+            # silently do nothing (the same silent-no-op rule --trend /
+            # --selftest / standalone --serve enforce), and its write path
+            # is disabled (remediation evidence lives one tier down), so
+            # the write-path knobs are no-ops too.
+            ("--watch", args.watch is not None),
+            ("--kubeconfig", args.kubeconfig),
+            ("--context", args.context),
+            ("--cluster-name", args.cluster_name),
+            ("--nodes-json", args.nodes_json),
+            ("--label-selector", args.label_selector),
+            ("--resource-key", args.resource_key),
+            ("--multislice-label", args.multislice_label),
+            ("--strict-slices", args.strict_slices),
+            ("--expected-chips", args.expected_chips),
+            ("--node-events", args.node_events),
+            ("--api-concurrency", args.api_concurrency is not None),
+            ("--probe", args.probe),
+            ("--emit-probe", args.emit_probe),
+            ("--probe-results", args.probe_results),
+            ("--report-fresh", args.report_fresh),
+            ("--selftest", args.selftest),
+            ("--calibrate", args.calibrate is not None),
+            ("--history", args.history),
+            ("--trend", args.trend),
+            ("--trend-nodes", args.trend_nodes),
+            ("--log-jsonl", args.log_jsonl),
+            ("--metrics-port", args.metrics_port is not None),
+            ("--slack-webhook", args.slack_webhook),
+            ("--slack-only-on-error", args.slack_only_on_error),
+            ("--slack-on-change", args.slack_on_change),
+            ("--cordon-failed", args.cordon_failed),
+            ("--uncordon-recovered", args.uncordon_recovered),
+            ("--cordon-max", args.cordon_max is not None),
+            ("--cordon-dry-run", args.cordon_dry_run),
+            ("--serve-token", args.serve_token),
+            ("--write-rps", args.write_rps is not None),
+            ("--json", args.json),
+            ("--debug", args.debug),
+            ("--trace", args.trace),
+        ):
+            if on:
+                p.error(
+                    f"--federate runs no check rounds (and serves no write "
+                    f"path): {flag} would silently do nothing"
+                )
+    else:
+        for flag, val in (
+            ("--federate-interval", args.federate_interval),
+            ("--federate-workers", args.federate_workers),
+        ):
+            if val is not None:
+                p.error(f"{flag} requires --federate")
     if args.slack_on_change and args.watch is None:
         p.error("--slack-on-change requires --watch")
     if args.probe_results_required and not args.probe_results:
@@ -655,7 +757,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             # The fleet API is the aggregator's surface (fleet snapshots,
             # cordon control); an emitter pod exposes --metrics-port only.
             p.error("--serve cannot be combined with --emit-probe")
-        if args.watch is None and not (args.history or args.log_jsonl):
+        if args.watch is None and args.federate is None and not (
+            args.history or args.log_jsonl
+        ):
             # Standalone mode serves a RECORDED store; without one the
             # server could never answer anything but 503 — the operator
             # almost certainly wanted --watch.  Checked LAST so the
@@ -723,6 +827,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # Returns only on SIGTERM (143) or via exceptions.
                 return checker.emit_probe_loop(args)
             return checker.emit_probe(args)
+        if getattr(args, "federate", None):
+            # Federation aggregator: merge N per-cluster fleet APIs into
+            # the /api/v1/global/* view.  Returns only on SIGTERM (143).
+            from tpu_node_checker.federation.aggregator import federate
+
+            return federate(args)
         if getattr(args, "watch", None) is not None:
             # Returns only on SIGTERM (143) or via signals/exceptions.
             return checker.watch(args)

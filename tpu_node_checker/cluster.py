@@ -96,6 +96,12 @@ class _Response:
     def json(self):
         return json.loads(self._body)
 
+    @property
+    def content(self) -> bytes:
+        """Raw body bytes (requests-shaped) — the federation fetch tier
+        re-frames node bodies by bytes instead of parsing them."""
+        return self._body
+
 
 class _StreamingResponse:
     """One live streaming HTTP response (a k8s ``watch``): line-iterated,
@@ -539,8 +545,10 @@ class _StdlibSession:
             self.requests_sent += 1
         return _StreamingResponse(conn, raw, url)
 
-    def get(self, url, params=None, timeout=None):
-        return self._request("GET", url, params=params, timeout=timeout)
+    def get(self, url, params=None, timeout=None, headers=None):
+        return self._request(
+            "GET", url, params=params, headers=headers, timeout=timeout
+        )
 
     def patch(self, url, data=None, headers=None, timeout=None):
         return self._request("PATCH", url, data=data, headers=headers, timeout=timeout)
@@ -557,6 +565,10 @@ class ClusterConfig:
     token: Optional[str] = None
     basic_auth: Optional[Tuple[str, str]] = None
     source: str = "unknown"  # "kubeconfig:<path>" | "in-cluster"
+    # The kubeconfig context this config resolved through (None in-cluster /
+    # offline) — the default cluster identity ``--cluster-name`` falls back
+    # to before the hostname.
+    context_name: Optional[str] = None
     _temp_files: List[str] = field(default_factory=list, repr=False)
 
     @property
@@ -675,7 +687,8 @@ def load_kubeconfig(path: str, context: Optional[str] = None) -> ClusterConfig:
         raise ClusterConfigError(f"kubeconfig {path}: cluster has no server URL")
 
     temp_files: List[str] = []
-    cfg = ClusterConfig(server=server.rstrip("/"), source=f"kubeconfig:{path}", _temp_files=temp_files)
+    cfg = ClusterConfig(server=server.rstrip("/"), source=f"kubeconfig:{path}",
+                        context_name=ctx_name, _temp_files=temp_files)
     cfg.insecure_skip_tls_verify = bool(cluster.get("insecure-skip-tls-verify"))
     if cluster.get("certificate-authority"):
         cfg.ca_file = cluster["certificate-authority"]

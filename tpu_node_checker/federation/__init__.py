@@ -1,0 +1,24 @@
+"""Multi-cluster federation: a stateless aggregator tier over N checkers.
+
+The paper's checker is single-cluster by construction (one kubeconfig, one
+NodeList); real TPU fleets span many clusters across regions.  This package
+composes N per-cluster fleet state APIs (the ``--serve`` surface each
+checker already exposes) into ONE global view:
+
+* :mod:`~tpu_node_checker.federation.endpoints` — the ``endpoints.json``
+  cluster registry and the consistent-hash sharding that assigns clusters
+  to fetcher workers;
+* :mod:`~tpu_node_checker.federation.aggregator` — the fetch tier
+  (conditional GETs over the pooled keep-alive transport: an unchanged
+  cluster costs one 304 per endpoint) and the ``tnc --federate`` mode loop;
+* :mod:`~tpu_node_checker.federation.merge` — the merge tier: per-cluster
+  node bodies re-framed BY BYTES (never re-parsed) into the
+  ``/api/v1/global/*`` snapshot, with unchanged clusters' blocks and gzip
+  members reused by reference — the same delta economics as
+  ``server/snapshot.build_snapshot_delta``, one level up.
+
+Degradation semantics generalize PR 2's rule: an unreachable or stale
+cluster marks only ITS shard degraded — never the fleet.  The global
+summary keeps serving, the dead cluster is labeled stale, and per-cluster
+fetch state rides ``/readyz`` detail and the federation metric families.
+"""

@@ -1,0 +1,499 @@
+"""The federation fetch tier and the ``tnc --federate`` mode loop.
+
+A stateless aggregator: no kubeconfig, no check rounds — every round it
+polls N per-cluster fleet state APIs (the PR 4 wire format IS the
+inter-tier protocol) with conditional GETs, folds the answers into
+per-cluster :class:`~tpu_node_checker.federation.merge.ClusterView` state,
+merges, and publishes the ``/api/v1/global/*`` snapshot through the
+existing serving stack (snapshot swap, fast routes, worker pool).
+
+Cost model: an UNCHANGED cluster costs one 304 per endpoint per round —
+the fetch rides the pooled keep-alive ``_StdlibSession`` plus the
+``utils/retry`` graded ladder (fresh budget per worker per round), so
+transient upstream hiccups retry exactly like any API call.  Clusters are
+sharded across ``--federate-workers`` fetcher threads by consistent hash
+(:func:`~tpu_node_checker.federation.endpoints.shard_clusters`), so each
+worker keeps warm connections to ITS clusters across rounds.
+
+Failure model: a failed fetch marks only that cluster's shard degraded
+(last-known data keeps serving, staleness-labeled); per-cluster fetch
+state is surfaced in ``/readyz`` detail and the
+``tpu_node_checker_federation_*`` metric families.  The aggregator goes
+not-ready only when it is BLIND — no merge round yet, or every configured
+cluster degraded.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_node_checker.federation.endpoints import (
+    EndpointsError,
+    load_endpoints,
+    shard_clusters,
+)
+from tpu_node_checker.federation.merge import (
+    ClusterView,
+    GlobalSnapshot,
+    build_global_snapshot,
+    extract_node_entries,
+)
+
+DEFAULT_INTERVAL_S = 10.0
+DEFAULT_WORKERS = 4
+# Bound on any single upstream request (dial + head + body); retries on
+# top ride the per-round policy budget.
+FETCH_TIMEOUT_S = 10.0
+# Per-cluster fetch breaker (the WatchBreaker cadence, one tier up): after
+# BREAKER_THRESHOLD consecutive failures, attempts widen to every 2nd,
+# 4th, then every BREAKER_MAX_EVERY'th round.  A black-holed upstream
+# (connect TIMEOUT, not a refusal) costs its worker up to 2 fetch
+# timeouts per attempt — without the breaker that tax lands every round
+# and stalls every shard-mate behind it.
+BREAKER_THRESHOLD = 3
+BREAKER_MAX_EVERY = 8
+
+
+class FetchError(RuntimeError):
+    """One cluster fetch failed (message says which endpoint and why)."""
+
+
+def _fetch_entity(session, view: ClusterView, base_headers: dict,
+                  path: str, etag: Optional[str]):
+    """One conditional GET → ``(response | None-for-304, new etag)``.
+
+    A 304 validates the cached state for free; anything other than 200/304
+    — including an upstream 503 "no round yet" — is this shard's failure
+    for the round.
+    """
+    headers = dict(base_headers)
+    if etag:
+        headers["If-None-Match"] = etag
+    resp = session.get(view.url + path, headers=headers,
+                       timeout=FETCH_TIMEOUT_S)
+    if resp.status_code == 304:
+        view.fetch_not_modified += 1
+        return None, etag
+    if resp.status_code != 200:
+        raise FetchError(f"{path}: HTTP {resp.status_code}")
+    view.fetch_fresh += 1
+    return resp, resp.headers.get("etag")
+
+
+class FederationEngine:
+    """Owns the cluster views, the fetcher sessions, and the merge.
+
+    ``round()`` runs on the mode loop's thread; fetcher threads live only
+    within a round (each writes ONLY its shard's views, joined before the
+    merge reads anything).  ``readiness()`` is called from request
+    threads and reads one atomically-swapped tuple — never the live views.
+    """
+
+    def __init__(self, args):
+        self.args = args
+        self.path = args.federate
+        self.interval = getattr(args, "federate_interval", None) or DEFAULT_INTERVAL_S
+        self.workers = getattr(args, "federate_workers", None) or DEFAULT_WORKERS
+        self.seq = 0
+        self.views: Dict[str, ClusterView] = {}
+        self._tokens: Dict[str, Optional[str]] = {}
+        self._sessions: Dict[int, object] = {}
+        self._prev: Optional[GlobalSnapshot] = None
+        # (ok, reason, detail) swapped whole per round — the /readyz seam.
+        self._ready: Optional[tuple] = None
+        self.last_round_ms = 0.0
+        # Startup is fail-fast: a malformed endpoints file is a config
+        # error the operator must see now, not a silently empty fleet.
+        from tpu_node_checker.history.store import file_signature
+
+        self._signature = file_signature
+        self._sig = file_signature(self.path)
+        self._apply_endpoints(load_endpoints(self.path))
+
+    # -- endpoints lifecycle ---------------------------------------------------
+
+    def _apply_endpoints(self, endpoints) -> None:
+        fresh: Dict[str, ClusterView] = {}
+        for ep in endpoints:
+            view = self.views.get(ep.name)
+            if view is None or view.url != ep.url:
+                # New cluster — or a moved URL, whose cached ETags/bytes
+                # describe the OLD endpoint and must not validate the new.
+                view = ClusterView(ep.name, ep.url)
+            fresh[ep.name] = view
+            self._tokens[ep.name] = ep.token
+        for name in set(self._tokens) - set(fresh):
+            self._tokens.pop(name, None)
+        self.views = fresh
+
+    def _maybe_reload(self) -> None:
+        """Between rounds: pick up an endpoints-file rewrite (ConfigMap
+        rollout).  A malformed rewrite keeps the LAST GOOD cluster set —
+        a fat-fingered edit must degrade nothing."""
+        sig = self._signature(self.path)
+        if sig == self._sig:
+            return
+        self._sig = sig  # never re-parse the same bad file every round
+        try:
+            endpoints = load_endpoints(self.path)
+        except (OSError, EndpointsError) as exc:
+            print(
+                f"federation: endpoints reload failed — keeping the current "
+                f"{len(self.views)} cluster(s): {exc}",
+                file=sys.stderr,
+            )
+            return
+        before = set(self.views)
+        self._apply_endpoints(endpoints)
+        after = set(self.views)
+        for name in sorted(after - before):
+            print(f"federation: cluster {name!r} joined the fleet view.",
+                  file=sys.stderr)
+        for name in sorted(before - after):
+            print(
+                f"federation: cluster {name!r} left the endpoints file — "
+                "dropped from the fleet view.",
+                file=sys.stderr,
+            )
+
+    # -- the fetch tier --------------------------------------------------------
+
+    def _session(self, slot: int):
+        session = self._sessions.get(slot)
+        if session is None:
+            from tpu_node_checker.cluster import _StdlibSession
+
+            session = _StdlibSession()
+            self._sessions[slot] = session
+        return session
+
+    def _fetch_cluster(self, session, view: ClusterView) -> None:
+        base_headers = {}
+        token = self._tokens.get(view.name)
+        if token:
+            base_headers["Authorization"] = f"Bearer {token}"
+        try:
+            resp, etag = _fetch_entity(
+                session, view, base_headers, "/api/v1/summary",
+                view.summary_etag,
+            )
+            if resp is not None:
+                doc = resp.json()
+                if not isinstance(doc, dict):
+                    raise FetchError("/api/v1/summary: not a JSON object")
+                view.summary_doc = doc
+            # The ETag lands only AFTER the body validated: a mangled 200
+            # must not leave the view holding the NEW validator with the
+            # OLD data — the next round's 304 would launder stale state
+            # as fresh indefinitely.
+            view.summary_etag = etag
+            resp, etag = _fetch_entity(
+                session, view, base_headers, "/api/v1/nodes", view.nodes_etag
+            )
+            if resp is not None:
+                entries, head = extract_node_entries(resp.content)
+                view.nodes_entries = entries
+                # Merge-cache identity for these bytes.  An upstream behind
+                # a validator-stripping proxy sends no ETag — every round
+                # is a fresh 200, and without a content key the merge
+                # would keep serving its first-cached block forever.
+                view.nodes_fp = etag or (
+                    "sha256:" + hashlib.sha256(entries).hexdigest()
+                )
+                count = head.get("count")
+                view.nodes_count = count if isinstance(count, int) else 0
+                view.nodes_round = head.get("round")
+                reported = head.get("cluster")
+                view.reported_cluster = (
+                    reported if isinstance(reported, str) else None
+                )
+            view.nodes_etag = etag
+        except Exception as exc:  # tnc: allow-broad-except(any fetch failure — refused dial, timeout, bad body, HTTP error — is the ONE shard-degraded outcome; the shard is labeled stale and the fleet keeps serving)
+            view.record_failure(f"{type(exc).__name__}: {exc}")
+            view.fetch_errors += 1
+            if view.consecutive_failures >= BREAKER_THRESHOLD:
+                view.backoff_skip = min(
+                    2 ** (view.consecutive_failures - BREAKER_THRESHOLD + 1),
+                    BREAKER_MAX_EVERY,
+                ) - 1
+            return
+        view.record_success()
+
+    def _fetch_shard(self, slot: int, names: List[str]) -> None:
+        session = self._session(slot)
+        for name in names:
+            view = self.views.get(name)
+            if view is None:
+                continue
+            if view.backoff_skip > 0:
+                # Breaker open: no dial this round.  Staleness still
+                # advances — the skipped shard stays honestly labeled.
+                view.backoff_skip -= 1
+                view.rounds_behind += 1
+                continue
+            self._fetch_cluster(session, view)
+
+    # -- the round -------------------------------------------------------------
+
+    def round(self, server=None) -> GlobalSnapshot:
+        """One federation round: reload → fetch (sharded) → merge → publish.
+
+        Returns the merged snapshot (also swapped into ``server`` when one
+        is wired).  Per-cluster failures never raise out of here — they
+        mark shards; only a bug in the merge itself would, and the mode
+        loop reports it and keeps the last snapshot serving.
+        """
+        from tpu_node_checker import checker
+
+        t0 = time.monotonic()
+        self._maybe_reload()
+        # Captured BEFORE the fetches run — record_failure/record_success
+        # move view.stale, and the transition log diffs against the state
+        # the operator last saw.  A never-attempted view (fresh start, new
+        # cluster) is stale but has no fetch history: excluding it means a
+        # first round that succeeds logs nothing and one that fails logs
+        # DEGRADED — not "recovered" for shards that were never lost.
+        before_degraded = {
+            name for name, view in self.views.items()
+            if view.stale and view.fetch_errors > 0
+        }
+        names = sorted(self.views)
+        shards = shard_clusters(names, self.workers)
+        threads = []
+        for slot, shard in sorted(shards.items()):
+            # Fresh retry policy (and budget) per worker per round — the
+            # same graded ladder every API call in this codebase rides.
+            self._session(slot).retry_policy = checker._build_retry_policy(
+                self.args
+            )
+            thread = threading.Thread(
+                target=self._fetch_shard,
+                args=(slot, shard),
+                name=f"tnc-federate-{slot}",
+                daemon=True,
+            )
+            threads.append(thread)
+            thread.start()
+        for thread in threads:
+            thread.join()
+        self.seq += 1
+        views = list(self.views.values())
+        snap = build_global_snapshot(
+            views, self.seq, round(time.time(), 3), prev=self._prev
+        )
+        self._prev = snap
+        self.last_round_ms = (time.monotonic() - t0) * 1e3
+        self._ready = self._compute_readiness(views)
+        if server is not None:
+            server.publish_global(
+                snap, metrics_body=self.render_metrics().encode("utf-8")
+            )
+        self._log_transitions(before_degraded)
+        return snap
+
+    def _log_transitions(self, before_degraded: set) -> None:
+        after = {name for name, view in self.views.items() if view.stale}
+        for name in sorted(after - before_degraded):
+            view = self.views[name]
+            print(
+                f"federation: cluster {name!r} shard DEGRADED "
+                f"({view.last_error}) — last-known data keeps serving, "
+                "staleness labeled.",
+                file=sys.stderr,
+            )
+        for name in sorted(before_degraded - after):
+            print(f"federation: cluster {name!r} shard recovered.",
+                  file=sys.stderr)
+
+    def _compute_readiness(self, views: List[ClusterView]) -> tuple:
+        detail = {
+            "clusters": {
+                v.name: {
+                    "reachable": v.consecutive_failures == 0,
+                    "consecutive_failures": v.consecutive_failures,
+                    "staleness_rounds": v.rounds_behind,
+                    **({"breaker_backoff_rounds": v.backoff_skip}
+                       if v.backoff_skip else {}),
+                    **({"error": v.last_error} if v.last_error else {}),
+                }
+                for v in views
+            }
+        }
+        if not views:
+            return False, "endpoints file registers no clusters", detail
+        if not any(v.has_data for v in views):
+            return False, "no cluster has been fetched successfully yet", detail
+        if all(v.stale for v in views):
+            # Blind, not just partially degraded: stale data keeps serving
+            # (labeled) but must stop gating schedulers.
+            return False, "every cluster shard is degraded", detail
+        return True, "ok", detail
+
+    def readiness(self) -> tuple:
+        """The server's /readyz seam → ``(ok, reason, detail)``; reads one
+        atomically-swapped tuple, never the live views."""
+        ready = self._ready
+        if ready is None:
+            return False, "no federation round completed yet", {}
+        return ready
+
+    # -- metrics ---------------------------------------------------------------
+
+    def render_metrics(self) -> str:
+        """The aggregator's scrape body — federation families only (no
+        check rounds run here)."""
+        from tpu_node_checker.metrics import _line
+
+        views = sorted(self.views.values(), key=lambda v: v.name)
+        lines = [
+            "# HELP tpu_node_checker_federation_clusters Clusters in the "
+            "federation view, by fetch state (degraded = unreachable or "
+            "stale shard).",
+            "# TYPE tpu_node_checker_federation_clusters gauge",
+        ]
+        counts = {
+            "configured": len(views),
+            "with_data": sum(1 for v in views if v.has_data),
+            "fresh": sum(1 for v in views if not v.stale),
+            "degraded": sum(1 for v in views if v.stale),
+        }
+        lines += [
+            _line("tpu_node_checker_federation_clusters", float(n),
+                  {"state": state})
+            for state, n in sorted(counts.items())
+        ]
+        lines += [
+            "# HELP tpu_node_checker_federation_cluster_up 1 while the "
+            "cluster's last fetch round succeeded.",
+            "# TYPE tpu_node_checker_federation_cluster_up gauge",
+        ]
+        lines += [
+            _line("tpu_node_checker_federation_cluster_up",
+                  0.0 if v.stale else 1.0, {"cluster": v.name})
+            for v in views
+        ]
+        lines += [
+            "# HELP tpu_node_checker_federation_staleness_rounds Federation "
+            "rounds since the cluster was last fetched successfully "
+            "(0 = fresh).",
+            "# TYPE tpu_node_checker_federation_staleness_rounds gauge",
+        ]
+        lines += [
+            _line("tpu_node_checker_federation_staleness_rounds",
+                  float(v.rounds_behind), {"cluster": v.name})
+            for v in views
+        ]
+        lines += [
+            "# HELP tpu_node_checker_federation_fetch_total Upstream fleet-"
+            "API fetches by cluster and result (fresh = 200, not_modified "
+            "= 304, error = failed round).",
+            "# TYPE tpu_node_checker_federation_fetch_total counter",
+        ]
+        for v in views:
+            for result, n in (("fresh", v.fetch_fresh),
+                              ("not_modified", v.fetch_not_modified),
+                              ("error", v.fetch_errors)):
+                lines.append(
+                    _line("tpu_node_checker_federation_fetch_total", float(n),
+                          {"cluster": v.name, "result": result})
+                )
+        with_data = [v for v in views if v.has_data]
+        lines += [
+            "# HELP tpu_node_checker_federation_nodes Nodes in the merged "
+            "global view, by state (summed over clusters' last-known "
+            "summaries, stale shards included).",
+            "# TYPE tpu_node_checker_federation_nodes gauge",
+            _line("tpu_node_checker_federation_nodes",
+                  float(sum(v.summary_doc.get("total_nodes") or 0
+                            for v in with_data)),
+                  {"state": "total"}),
+            _line("tpu_node_checker_federation_nodes",
+                  float(sum(v.summary_doc.get("ready_nodes") or 0
+                            for v in with_data)),
+                  {"state": "ready"}),
+            "# HELP tpu_node_checker_federation_round_duration_ms Wall-clock "
+            "of the last fetch+merge round.",
+            "# TYPE tpu_node_checker_federation_round_duration_ms gauge",
+            _line("tpu_node_checker_federation_round_duration_ms",
+                  round(self.last_round_ms, 3)),
+            "# HELP tpu_node_checker_federation_workers Fetcher threads the "
+            "cluster set is consistent-hash sharded across.",
+            "# TYPE tpu_node_checker_federation_workers gauge",
+            _line("tpu_node_checker_federation_workers", float(self.workers)),
+            "# HELP tpu_node_checker_last_run_timestamp_seconds Unix time "
+            "of the last completed federation round (staleness detector).",
+            "# TYPE tpu_node_checker_last_run_timestamp_seconds gauge",
+            _line("tpu_node_checker_last_run_timestamp_seconds", time.time()),
+        ]
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        for session in self._sessions.values():
+            session.close()
+        self._sessions = {}
+
+
+def federate(args) -> int:
+    """``tnc --federate endpoints.json --serve PORT``: the aggregator mode.
+
+    Serves ``/api/v1/global/{summary,clusters,clusters/{name},nodes}``
+    plus ``/healthz``, ``/readyz`` (per-cluster fetch detail) and
+    ``/metrics`` (federation families).  Control-plane writes are refused
+    (403 deny-by-default — no ``--serve-token`` here; the control seam
+    behind the gate answers 503) —
+    remediation evidence lives one tier down, in each cluster's own
+    checker.  Runs until SIGTERM (exit 143).
+    """
+    from tpu_node_checker import checker
+    from tpu_node_checker.server.app import FleetStateServer
+
+    engine = FederationEngine(args)
+    server = FleetStateServer(
+        args.serve,
+        federation=True,
+        readiness=engine.readiness,
+        **checker._serve_pool_kwargs(args),
+    )
+    requested_workers = getattr(args, "serve_workers", None) or 1
+    if server.workers_active != requested_workers:
+        print(
+            f"--serve-workers {requested_workers}: SO_REUSEPORT unavailable "
+            f"on this platform — serving with {server.workers_active} "
+            "listener.",
+            file=sys.stderr,
+        )
+    print(
+        f"Federation aggregator on port {server.port} "
+        f"({server.workers_active} worker"
+        f"{'s' if server.workers_active != 1 else ''}): "
+        f"{len(engine.views)} cluster(s) from {engine.path}, "
+        f"{engine.workers} fetcher(s), round every {engine.interval:g}s "
+        "(/api/v1/global/{summary,clusters,nodes}).",
+        file=sys.stderr,
+    )
+    stop = threading.Event()
+    prev_handler = checker._install_stop_signal(stop)
+    try:
+        while True:
+            round_start = time.monotonic()
+            try:
+                engine.round(server)
+            except Exception as exc:  # tnc: allow-broad-except(a merge bug must not kill the serving tier; the last global snapshot keeps serving and the next round retries)
+                print(f"Federation round failed: {exc}", file=sys.stderr)
+            if checker._wait_for_next_round(
+                stop,
+                max(0.0, engine.interval - (time.monotonic() - round_start)),
+            ):
+                print(
+                    "SIGTERM: federation aggregator stopped cleanly.",
+                    file=sys.stderr,
+                )
+                return 128 + 15
+    finally:
+        checker._restore_stop_signal(prev_handler)
+        engine.close()
+        server.close()

@@ -1,0 +1,134 @@
+"""The federation cluster registry: ``endpoints.json`` + worker sharding.
+
+File format (see README "Federation")::
+
+    {
+      "clusters": [
+        {"name": "us-central2-a", "url": "http://checker-a:8080"},
+        {"name": "eu-west4-b",   "url": "https://checker-b:8080",
+         "token": "..."}
+      ]
+    }
+
+``name`` is the cluster's identity in the global view (the first half of
+every ``cluster/node`` key) and must be unique; ``url`` is the base URL of
+that cluster's fleet state API (the ``--serve`` surface); ``token`` is an
+optional bearer credential sent on every fetch (reads are open by default,
+but a fronting proxy may demand one).
+
+The file is re-stat'ed between rounds (the same mtime/size signature the
+history store uses), so a ConfigMap rollout adds/removes clusters without
+restarting the aggregator — and a malformed rewrite keeps the LAST good
+set instead of killing the tier.
+
+Sharding: :func:`shard_clusters` assigns the cluster set across
+``--federate-workers`` fetcher threads by CONSISTENT HASH (a ring of
+virtual points per worker slot).  Cluster → slot assignments are stable
+under cluster churn, and changing the worker count moves only ~1/W of the
+clusters — so each worker's keep-alive connections to its clusters stay
+warm across rounds and reconfigurations.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+# Virtual ring points per worker slot: enough that a handful of clusters
+# spreads evenly over a handful of workers.
+_RING_POINTS_PER_SLOT = 64
+
+
+class EndpointsError(ValueError):
+    """endpoints.json is malformed (message says how)."""
+
+
+@dataclass(frozen=True)
+class ClusterEndpoint:
+    """One per-cluster checker's fleet API, as registered."""
+
+    name: str
+    url: str
+    token: Optional[str] = None
+
+
+def load_endpoints(path: str) -> List[ClusterEndpoint]:
+    """Parse + validate ``endpoints.json`` → the registered cluster list.
+
+    Raises :class:`EndpointsError` on malformed content (the aggregator
+    fails FAST at startup; between rounds the caller keeps the last good
+    set) and ``OSError`` when unreadable.
+    """
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError as exc:
+            raise EndpointsError(f"{path}: not valid JSON: {exc}") from exc
+    if not isinstance(doc, dict) or not isinstance(doc.get("clusters"), list):
+        raise EndpointsError(
+            f"{path}: expected an object with a 'clusters' list"
+        )
+    out: List[ClusterEndpoint] = []
+    seen: set = set()
+    for i, entry in enumerate(doc["clusters"]):
+        if not isinstance(entry, dict):
+            raise EndpointsError(f"{path}: clusters[{i}] is not an object")
+        name = entry.get("name")
+        url = entry.get("url")
+        token = entry.get("token")
+        if not isinstance(name, str) or not name:
+            raise EndpointsError(f"{path}: clusters[{i}] has no 'name'")
+        if "/" in name:
+            # The global view keys nodes "cluster/node"; a slash inside the
+            # cluster half would make the key ambiguous.
+            raise EndpointsError(
+                f"{path}: cluster name {name!r} must not contain '/'"
+            )
+        if name in seen:
+            raise EndpointsError(f"{path}: duplicate cluster name {name!r}")
+        seen.add(name)
+        if not isinstance(url, str) or not url.lower().startswith(
+            ("http://", "https://")
+        ):
+            raise EndpointsError(
+                f"{path}: clusters[{i}] ({name!r}) needs an http(s) 'url'"
+            )
+        if token is not None and not isinstance(token, str):
+            raise EndpointsError(
+                f"{path}: clusters[{i}] ({name!r}) token must be a string"
+            )
+        out.append(ClusterEndpoint(name=name, url=url.rstrip("/"), token=token))
+    if not out:
+        raise EndpointsError(f"{path}: 'clusters' is empty")
+    return out
+
+
+def _hash_point(key: str) -> int:
+    return int.from_bytes(hashlib.sha256(key.encode("utf-8")).digest()[:8], "big")
+
+
+def shard_clusters(names: List[str], workers: int) -> Dict[int, List[str]]:
+    """Consistent-hash assignment: cluster name → worker slot.
+
+    Returns ``{slot: [names...]}`` covering every name (slots with no
+    clusters are omitted).  Deterministic across processes and stable
+    under cluster add/remove; resizing the worker pool remaps only the
+    clusters nearest the new/removed slots' ring points.
+    """
+    workers = max(1, int(workers))
+    if workers == 1:
+        return {0: list(names)}
+    ring: List[tuple] = sorted(
+        (_hash_point(f"slot-{slot}#{point}"), slot)
+        for slot in range(workers)
+        for point in range(_RING_POINTS_PER_SLOT)
+    )
+    points = [p for p, _ in ring]
+    shards: Dict[int, List[str]] = {}
+    for name in names:
+        idx = bisect.bisect_right(points, _hash_point(name)) % len(ring)
+        shards.setdefault(ring[idx][1], []).append(name)
+    return shards

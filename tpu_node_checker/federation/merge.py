@@ -1,0 +1,364 @@
+"""The federation merge tier: N per-cluster snapshots → one global view.
+
+The aggregator never re-parses node bodies.  Each cluster's
+``/api/v1/nodes`` response is split ONCE into its head (a small dict the
+roll-ups read) and its entries run (the exact bytes between ``"nodes": [``
+and the closing bracket — the format both sides share, pinned by
+``server/snapshot.build_joined_entity``'s byte-identity contract).  The
+global ``/api/v1/global/nodes`` body is then a byte-join of per-cluster
+BLOCKS::
+
+    {"round": R, "ts": T, "cluster_count": K, "count": N, "clusters": [
+        {"cluster": "us-central2-a", "round": r, "count": n, "nodes": [<entries, verbatim>]},
+        ...
+    ]}
+
+so a federated view of one cluster carries that cluster's node entries
+byte-identical to the cluster's own body (pinned by test), and an
+UNCHANGED cluster (its upstream ETag still valid) reuses its block — and
+its cached gzip members — by reference: a 100k-node fleet across dozens of
+clusters costs O(changed clusters) per merge, the same delta economics as
+``build_snapshot_delta`` one tier down.
+
+Degradation rule (the shard-degraded-never-fleet invariant): a cluster
+whose fetch failed keeps its LAST-KNOWN data in the view, marked
+``stale`` with rounds/seconds-since-success staleness labels; the global
+summary's ``healthy`` verdict is computed over FRESH clusters only and the
+stale shard is listed, never allowed to sink the fleet.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_node_checker.server.snapshot import (
+    _GZIP_LEVEL,
+    _GZIP_MIN_BYTES,
+    Entity,
+    joined_prefix,
+    json_entity,
+)
+
+_NODES_MARKER = b'"nodes": ['
+
+
+def extract_node_entries(body: bytes) -> Tuple[bytes, dict]:
+    """One upstream ``/api/v1/nodes`` body → ``(entries bytes, head dict)``.
+
+    The head (round/ts/count/cluster) is parsed from the bytes BEFORE the
+    marker — never the entries themselves, so a 5k-node body costs a find
+    and a tiny ``json.loads``, not a 5k-entry parse.  Raises ``ValueError``
+    when the body does not carry the fleet API's joined-collection shape.
+    """
+    i = body.find(_NODES_MARKER)
+    if i == -1:
+        raise ValueError("no \"nodes\" array in body")
+    head = json.loads(body[:i] + _NODES_MARKER + b"]}")
+    tail = body.rstrip()
+    if not tail.endswith(b"]}"):
+        raise ValueError("body does not close a joined nodes collection")
+    entries = tail[i + len(_NODES_MARKER):-2]
+    return entries, head
+
+
+class ClusterView:
+    """One cluster's last-known state in the global view.
+
+    Written by exactly one fetcher worker per round (the consistent-hash
+    shard owner); read by the merge on the round thread AFTER the workers
+    joined — no lock needed.  Holds the byte caches the merge reuses:
+    ``block()`` (this cluster's run inside the global nodes body) and its
+    gzip members, keyed on the nodes content identity (``nodes_fp`` — the
+    upstream ETag, or a content hash for ETag-less upstreams) + the stale
+    flag.
+    """
+
+    __slots__ = (
+        "name", "url",
+        "summary_doc", "summary_etag",
+        "nodes_entries", "nodes_etag", "nodes_fp", "nodes_count",
+        "nodes_round",
+        "reported_cluster",
+        "consecutive_failures", "rounds_behind", "last_success_wall",
+        "last_error", "backoff_skip",
+        "fetch_fresh", "fetch_not_modified", "fetch_errors",
+        "_block_key", "_block", "_gz_lead", "_gz_mid",
+    )
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url
+        self.summary_doc: Optional[dict] = None
+        self.summary_etag: Optional[str] = None
+        self.nodes_entries: Optional[bytes] = None
+        self.nodes_etag: Optional[str] = None
+        # Cache identity of nodes_entries: the upstream ETag, or a content
+        # hash when the upstream sends none (a validator-stripping proxy
+        # must not freeze the merged bytes at their first-fetched content).
+        self.nodes_fp: Optional[str] = None
+        self.nodes_count = 0
+        self.nodes_round = None
+        self.reported_cluster: Optional[str] = None
+        self.consecutive_failures = 0
+        self.rounds_behind = 0
+        self.last_success_wall: Optional[float] = None
+        self.last_error: Optional[str] = None
+        # Rounds the fetch tier will SKIP before re-dialing this cluster
+        # (its per-cluster breaker: set after repeated failures so a
+        # black-holed upstream can't stall its shard-mates every round).
+        # Skipped rounds still advance rounds_behind — staleness labels
+        # keep telling the truth while the breaker waits.
+        self.backoff_skip = 0
+        self.fetch_fresh = 0
+        self.fetch_not_modified = 0
+        self.fetch_errors = 0
+        self._block_key = None
+        self._block: Optional[bytes] = None
+        self._gz_lead: Optional[bytes] = None
+        self._gz_mid: Optional[bytes] = None
+
+    # -- fetch bookkeeping (the owning worker's side) -------------------------
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self.rounds_behind = 0
+        self.backoff_skip = 0
+        self.last_success_wall = time.time()
+        self.last_error = None
+
+    def record_failure(self, error: str) -> None:
+        self.consecutive_failures += 1
+        self.rounds_behind += 1
+        self.last_error = error
+
+    # -- derived state ---------------------------------------------------------
+
+    @property
+    def has_data(self) -> bool:
+        return self.summary_doc is not None
+
+    @property
+    def stale(self) -> bool:
+        """This shard is degraded: the last fetch round did not succeed
+        (or none ever has).  Marks ONLY this cluster's entries — the
+        fleet view keeps serving around it."""
+        return self.rounds_behind > 0 or not self.has_data
+
+    def staleness(self, now_wall: Optional[float] = None) -> dict:
+        seconds = None
+        if self.last_success_wall is not None:
+            seconds = round((now_wall or time.time()) - self.last_success_wall, 1)
+        return {"rounds": self.rounds_behind, "seconds": seconds}
+
+    # -- merge-side byte caches ------------------------------------------------
+
+    def block(self) -> bytes:
+        """This cluster's run inside the global nodes body — rebuilt only
+        when the nodes content identity (upstream ETag, or the fetch
+        tier's content hash for ETag-less upstreams), the upstream round,
+        or the stale flag moved.  The round rides the key because the
+        content hash covers only the entries bytes — an ETag-less
+        upstream whose round advances over identical entries must not
+        serve a frozen ``"round"`` in its block head."""
+        key = (self.nodes_fp or self.nodes_etag, self.nodes_round, self.stale)
+        if self._block_key != key or self._block is None:
+            head = {
+                "cluster": self.name,
+                "round": self.nodes_round,
+                "count": self.nodes_count,
+            }
+            if self.stale:
+                head["stale"] = True
+            self._block = (
+                joined_prefix(head, "nodes")
+                + (self.nodes_entries or b"") + b"]}"
+            )
+            self._gz_lead = None
+            self._gz_mid = None
+            self._block_key = key
+        return self._block
+
+    def gz_member(self, lead: bool) -> bytes:
+        """The block as a standalone gzip member (``lead`` = first block in
+        the joined array, no ``", "`` separator folded in) — deflated once
+        per block change, reused by reference every round after."""
+        block = self.block()
+        if lead:
+            if self._gz_lead is None:
+                self._gz_lead = gzip.compress(block, _GZIP_LEVEL, mtime=0)
+            return self._gz_lead
+        if self._gz_mid is None:
+            self._gz_mid = gzip.compress(b", " + block, _GZIP_LEVEL, mtime=0)
+        return self._gz_mid
+
+
+class GlobalSnapshot:
+    """One merge round's immutable, pre-serialized global view.
+
+    Same discipline as :class:`~tpu_node_checker.server.snapshot.FleetSnapshot`:
+    built once per round, swapped into the server with a single attribute
+    assignment, never mutated after — so the read accessors below are a
+    dict lookup, no locks (TNC011's scan set for this module).
+    """
+
+    __slots__ = ("seq", "ts", "entities", "cluster_entities", "nodes_sig")
+
+    def __init__(self, seq: int, ts: float):
+        self.seq = seq
+        self.ts = ts
+        self.entities: Dict[str, Entity] = {}
+        self.cluster_entities: Dict[str, Entity] = {}
+        self.nodes_sig: tuple = ()
+
+    # -- the read path (lock-free by construction) ----------------------------
+
+    def entity(self, key: str) -> Entity:
+        return self.entities[key]
+
+    def cluster_entity(self, name: str) -> Optional[Entity]:
+        return self.cluster_entities.get(name)
+
+
+def build_cluster_entry(view: ClusterView, now_wall: float) -> dict:
+    """One cluster's row in ``/api/v1/global/clusters`` — identity, fetch
+    health, staleness labels, and the last-known roll-up numbers."""
+    entry = {
+        "cluster": view.name,
+        "url": view.url,
+        "reachable": view.consecutive_failures == 0,
+        "degraded": view.stale,
+        "staleness": view.staleness(now_wall),
+    }
+    if view.has_data:
+        doc = view.summary_doc
+        entry["round"] = doc.get("round")
+        entry["healthy"] = bool(doc.get("healthy"))
+        for key in ("total_nodes", "ready_nodes", "total_chips", "ready_chips"):
+            if doc.get(key) is not None:
+                entry[key] = doc[key]
+    if view.nodes_entries is not None:
+        entry["nodes"] = view.nodes_count
+    if view.stale and view.last_error:
+        entry["error"] = view.last_error
+    if view.reported_cluster and view.reported_cluster != view.name:
+        # The upstream stamps its own --cluster-name; a mismatch with the
+        # endpoints file is a misconfiguration worth surfacing, not hiding.
+        entry["reported_cluster"] = view.reported_cluster
+    return entry
+
+
+def build_global_summary(views: List[ClusterView], seq: int, ts: float) -> dict:
+    """The global roll-up.  ``healthy`` is judged over FRESH clusters only;
+    a degraded shard is LISTED (``degraded`` / ``degraded_clusters``) but
+    can never sink the fleet verdict — the invariant federation inherits
+    from PR 2's partial-degradation rule."""
+    with_data = [v for v in views if v.has_data]
+    fresh = [v for v in with_data if not v.stale]
+    degraded = sorted(v.name for v in views if v.stale)
+    unhealthy = sorted(
+        v.name for v in fresh if not v.summary_doc.get("healthy")
+    )
+
+    def total(key: str) -> int:
+        return sum(v.summary_doc.get(key) or 0 for v in with_data)
+
+    return {
+        "round": seq,
+        "ts": ts,
+        "source": "federation",
+        "clusters": {
+            "total": len(views),
+            "with_data": len(with_data),
+            "fresh": len(fresh),
+            "degraded": len(degraded),
+        },
+        # Healthy needs at least one FRESH cluster agreeing; no fresh data
+        # at all is not healthy — but it is also not a fleet-wide failure:
+        # the last-known numbers below keep serving, labeled.
+        "healthy": bool(fresh) and not unhealthy,
+        "degraded": bool(degraded),
+        "degraded_clusters": degraded,
+        "unhealthy_clusters": unhealthy,
+        "total_nodes": total("total_nodes"),
+        "ready_nodes": total("ready_nodes"),
+        "total_chips": total("total_chips"),
+        "ready_chips": total("ready_chips"),
+        "slices": {
+            "total": sum(
+                (v.summary_doc.get("slices") or {}).get("total") or 0
+                for v in with_data
+            ),
+            "complete": sum(
+                (v.summary_doc.get("slices") or {}).get("complete") or 0
+                for v in with_data
+            ),
+        },
+    }
+
+
+def build_global_snapshot(
+    views: List[ClusterView],
+    seq: int,
+    ts: float,
+    prev: Optional[GlobalSnapshot] = None,
+) -> GlobalSnapshot:
+    """One merge round → the immutable global snapshot.
+
+    The summary and clusters entities are small and rebuilt every round
+    (staleness seconds move); the NODES entity — the 100k-node body — is
+    reused WHOLE (bytes, gzip and ETag, so pollers keep 304-ing) when no
+    cluster's nodes content or freshness changed, and otherwise re-joined
+    from per-cluster blocks of which only the changed ones are re-encoded
+    or re-deflated.
+    """
+    views = sorted(views, key=lambda v: v.name)
+    snap = GlobalSnapshot(seq, ts)
+    summary = build_global_summary(views, seq, ts)
+    snap.entities["global/summary"] = json_entity(summary)
+
+    now_wall = time.time()
+    entries = [build_cluster_entry(v, now_wall) for v in views]
+    snap.entities["global/clusters"] = json_entity(
+        {"round": seq, "ts": ts, "count": len(views), "clusters": entries}
+    )
+    for view, entry in zip(views, entries):
+        snap.cluster_entities[view.name] = json_entity(
+            {"round": seq, "ts": ts, "cluster": entry,
+             "summary": view.summary_doc}
+        )
+
+    with_nodes = [v for v in views if v.nodes_entries is not None]
+    snap.nodes_sig = tuple(
+        (v.name, v.nodes_fp or v.nodes_etag, v.nodes_round, v.stale)
+        for v in with_nodes
+    )
+    if prev is not None and snap.nodes_sig == prev.nodes_sig:
+        # Nothing observable moved: the previous entity (bytes, gz AND
+        # ETag) serves on — every poller's cached ETag keeps 304-ing.
+        snap.entities["global/nodes"] = prev.entities["global/nodes"]
+        return snap
+
+    head = {
+        "round": seq,
+        "ts": ts,
+        "cluster_count": len(with_nodes),
+        "count": sum(v.nodes_count for v in with_nodes),
+    }
+    prefix = joined_prefix(head, "clusters")
+    tail = b"]}\n"
+    body = prefix + b", ".join(v.block() for v in with_nodes) + tail
+    gz = None
+    if with_nodes and len(body) >= _GZIP_MIN_BYTES:
+        # Member-concatenated gzip (RFC 1952): tiny fresh members for the
+        # prefix/tail, each cluster's CACHED member in between — only
+        # changed clusters were re-deflated above.
+        joined = bytearray(gzip.compress(prefix, _GZIP_LEVEL, mtime=0))
+        for i, v in enumerate(with_nodes):
+            joined += v.gz_member(lead=(i == 0))
+        joined += gzip.compress(tail, _GZIP_LEVEL, mtime=0)
+        gz = bytes(joined)
+    snap.entities["global/nodes"] = Entity(body, gz=gz)
+    return snap

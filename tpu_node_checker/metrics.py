@@ -69,7 +69,26 @@ Exported metric families:
 * ``tpu_node_checker_api_server_swr_stale_served_total`` — ``/api/v1/trend``
   responses served stale while a background rebuild ran
   (stale-while-revalidate hits; a climbing rate with no matching rebuilds
-  means the trend log is churning faster than it can be summarized).
+  means the trend log is churning faster than it can be summarized);
+* ``tpu_node_checker_cluster_info{cluster,source}`` — the resolved cluster
+  identity this checker stamps into every payload/snapshot
+  (``--cluster-name`` → ``$TNC_CLUSTER_NAME`` → kube context → hostname);
+  explicitly configured names (flag/env) additionally label every round
+  family above with ``cluster=...``;
+* ``tpu_node_checker_federation_clusters{state}`` /
+  ``tpu_node_checker_federation_cluster_up{cluster}`` /
+  ``tpu_node_checker_federation_staleness_rounds{cluster}`` — the
+  ``--federate`` aggregator's view of its cluster set: counts by fetch
+  state (configured/with_data/fresh/degraded), per-cluster up gauges, and
+  rounds since each cluster was last fetched successfully;
+* ``tpu_node_checker_federation_fetch_total{cluster,result}`` — upstream
+  fleet-API fetches (fresh = 200, not_modified = 304, error): a healthy
+  steady state is almost all 304s;
+* ``tpu_node_checker_federation_nodes{state}`` — total/ready nodes in the
+  merged global view (stale shards' last-known numbers included);
+* ``tpu_node_checker_federation_round_duration_ms`` /
+  ``tpu_node_checker_federation_workers`` — fetch+merge round wall-clock
+  and the consistent-hash fetcher pool size.
 
 This docstring is the package's metric index: tnc-lint's
 ``drift-readme-metrics`` rule (TNC202) fails CI when a family is emitted
@@ -101,10 +120,17 @@ def _line(name: str, value: float, labels: Optional[dict] = None) -> str:
     return f"{name} {value}"
 
 
-def _breaker_lines(breaker: dict) -> List[str]:
+def _breaker_lines(breaker: dict, cluster: Optional[str] = None) -> List[str]:
     """The watch-breaker gauge families — ONE definition, shared by the
     normal render and mark_error's no-result-yet branch (a pod that comes
-    up against a dead API server is exactly when these matter)."""
+    up against a dead API server is exactly when these matter).
+
+    ``cluster`` rides along so an explicitly configured ``--cluster-name``
+    labels these families like every other round family (the breaker is
+    exactly the series a multi-cluster dashboard aggregates ``by
+    (cluster)``); mark_error's no-result-yet branch has no resolved
+    identity yet and renders bare until the first completed round."""
+    labels = {"cluster": cluster} if cluster else None
     return [
         "# HELP tpu_node_checker_watch_breaker_open 1 while the watch-mode "
         "circuit breaker is open (consecutive failed check rounds; interval "
@@ -113,6 +139,7 @@ def _breaker_lines(breaker: dict) -> List[str]:
         _line(
             "tpu_node_checker_watch_breaker_open",
             1.0 if breaker.get("open") else 0.0,
+            labels,
         ),
         "# HELP tpu_node_checker_watch_breaker_consecutive_failures "
         "Consecutive failed watch rounds (resets to 0 on success).",
@@ -120,6 +147,7 @@ def _breaker_lines(breaker: dict) -> List[str]:
         _line(
             "tpu_node_checker_watch_breaker_consecutive_failures",
             float(breaker.get("consecutive_failures", 0)),
+            labels,
         ),
     ]
 
@@ -136,13 +164,36 @@ def render_metrics(
     separately from "the fleet is degraded"."""
     lines: List[str] = []
 
+    payload = result.payload
+    # Cluster identity (--cluster-name satellite of the federation tier):
+    # an EXPLICITLY configured name (flag/env) labels every round family —
+    # the multi-cluster Prometheus setup's aggregation key.  Inferred
+    # defaults (kube context, hostname) stamp the payload but never the
+    # labels: a pod hostname churns per restart, and each churn would mint
+    # a whole new series set.  The info family below carries the resolved
+    # identity either way.
+    cluster = payload.get("cluster")
+    cluster_label = (
+        cluster if payload.get("cluster_source") in ("flag", "env") else None
+    )
+
     def family(name: str, mtype: str, help_text: str, samples: List[Tuple[dict, float]]):
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
         for labels, value in samples:
+            if cluster_label is not None:
+                labels = {**(labels or {}), "cluster": cluster_label}
             lines.append(_line(name, value, labels or None))
 
-    payload = result.payload
+    if cluster:
+        family(
+            "tpu_node_checker_cluster_info",
+            "gauge",
+            "The resolved cluster identity this checker stamps into every "
+            "payload/snapshot (source: flag | env | context | hostname).",
+            [({"cluster": cluster,
+               "source": str(payload.get("cluster_source") or "")}, 1.0)],
+        )
     # Fleet families render only for aggregator payloads: an emitter-mode
     # scrape (probe-only payload, no LIST ran) must not advertise
     # nodes{state="total"} 0 — "zero nodes" and "this process never counted
@@ -593,7 +644,7 @@ def render_metrics(
             [({}, 1.0 if payload.get("degraded") else 0.0)],
         )
     if breaker is not None:
-        lines.extend(_breaker_lines(breaker))
+        lines.extend(_breaker_lines(breaker, cluster_label))
     family(
         "tpu_node_checker_exit_code",
         "gauge",
@@ -711,7 +762,11 @@ class MetricsServer:
             body = "\n".join(
                 line
                 for line in text.splitlines()
-                if not line.startswith("tpu_node_checker_last_run_timestamp_seconds ")
+                # Both sample shapes: bare and cluster-labeled.
+                if not line.startswith(
+                    ("tpu_node_checker_last_run_timestamp_seconds ",
+                     "tpu_node_checker_last_run_timestamp_seconds{")
+                )
             ).encode() + b"\n"
         self._set_body(body)
 

@@ -16,6 +16,14 @@ another process writes).  Read surface:
 * ``GET /metrics``          — the last round's Prometheus families plus this
   server's own ``tpu_node_checker_api_server_*`` request telemetry.
 
+Federation surface (``tnc --federate``, see
+:mod:`~tpu_node_checker.federation`): ``GET /api/v1/global/{summary,
+clusters,clusters/{name},nodes}`` serve the merged multi-cluster view
+(installed per merge round via :meth:`FleetStateServer.publish_global`),
+``/readyz`` carries per-cluster fetch detail, and the per-cluster round
+endpoints answer a redirecting 404.  On a plain checker the global routes
+answer 404 naming the aggregator.
+
 Write surface (deny-by-default, see :mod:`~tpu_node_checker.server.auth`):
 
 * ``POST /api/v1/nodes/{name}/cordon`` / ``.../uncordon`` — routed through
@@ -73,6 +81,10 @@ _METRICS_STATS_GZIP_LEVEL = 1
 # The read endpoints hot enough to earn prebuilt wire responses in the
 # worker pool's fast table (everything else rides the routed fallback).
 _FAST_PATHS = ("summary", "nodes", "slices")
+
+# The federation aggregator's hot read surface (GlobalSnapshot entity keys
+# → fast-table paths); per-cluster detail rides the routed fallback.
+_GLOBAL_FAST_PATHS = ("global/summary", "global/clusters", "global/nodes")
 
 
 class ServerStats:
@@ -214,8 +226,17 @@ class FleetStateServer:
         workers: int = 1,
         max_connections: int = DEFAULT_MAX_CONNECTIONS,
         write_limiter=None,
+        federation: bool = False,
+        readiness: Optional[Callable] = None,
     ):
         self._snap: Optional[FleetSnapshot] = None
+        # Federation mode (--federate): the merged global view swaps in
+        # through publish_global; the per-cluster round surface answers a
+        # redirecting 404 instead of a forever-503.  ``readiness`` is the
+        # aggregator's /readyz seam: () -> (ok, reason, detail_dict).
+        self._federation = federation
+        self._readiness = readiness
+        self._global = None  # merge.GlobalSnapshot, swapped atomically
         self._seq = 0
         self._breaker: Optional[dict] = None
         default_metrics = b"# tpu-node-checker: no check completed yet\n"
@@ -254,6 +275,16 @@ class FleetStateServer:
         router.add("GET", "/api/v1/trend", self._get_trend)
         router.add("POST", "/api/v1/nodes/{name}/cordon", self._post_control)
         router.add("POST", "/api/v1/nodes/{name}/uncordon", self._post_control)
+        # The federation surface (registered unconditionally so a plain
+        # checker answers a helpful 404 there, not a route miss).
+        router.add("GET", "/api/v1/global/summary",
+                   self._get_global("global/summary"))
+        router.add("GET", "/api/v1/global/clusters",
+                   self._get_global("global/clusters"))
+        router.add("GET", "/api/v1/global/nodes",
+                   self._get_global("global/nodes"))
+        router.add("GET", "/api/v1/global/clusters/{name}",
+                   self._get_global_cluster)
         self.router = router
 
         self._pool = WorkerPool(
@@ -350,6 +381,35 @@ class FleetStateServer:
         self._snap = snap
         return snap
 
+    def publish_global(self, gsnap, metrics_body: Optional[bytes] = None) -> None:
+        """Federation mode: one merge round → the global view, atomically
+        swapped exactly like a round snapshot.
+
+        ``gsnap`` is a :class:`~tpu_node_checker.federation.merge.GlobalSnapshot`;
+        its hot entities earn fast-table wire responses, per-cluster detail
+        rides the routed path.  ``metrics_body`` replaces the round-family
+        scrape prefix (the aggregator runs no check rounds, so the
+        federation families ARE its round surface).
+        """
+        self._seq = max(self._seq + 1, gsnap.seq)
+        if metrics_body is not None:
+            self._metrics = (
+                metrics_body,
+                _gzip.compress(metrics_body, _METRICS_PREFIX_GZIP_LEVEL, mtime=0),
+            )
+        fast = (
+            build_fast_routes(
+                {f"/api/v1/{key}": gsnap.entities[key]
+                 for key in _GLOBAL_FAST_PATHS if key in gsnap.entities}
+            )
+            if self._pre_serialized
+            else {}
+        )
+        # Same swap order discipline as publish(): metrics and the fast
+        # table first, the snapshot (what readiness keys on) last.
+        self.fast_routes = fast
+        self._global = gsnap
+
     def publish_snapshot(self, snap: FleetSnapshot) -> None:
         """Standalone mode: install an externally built (store) snapshot.
 
@@ -412,8 +472,59 @@ class FleetStateServer:
             503, {"error": "no completed check round yet", "ready": False}
         )
 
+    @staticmethod
+    def _not_an_aggregator() -> Response:
+        return json_response(
+            404,
+            {"error": "not a federation aggregator: the /api/v1/global/* "
+                      "surface is served by tnc --federate"},
+        )
+
+    def _redirect_to_global(self) -> Response:
+        return json_response(
+            404,
+            {"error": "this is a federation aggregator: per-cluster rounds "
+                      "are served one tier down — query /api/v1/global/"
+                      "{summary,clusters,nodes} here"},
+        )
+
+    def _get_global(self, key: str):
+        def handler(req: Request) -> Response:
+            gsnap = self._global
+            if gsnap is None:
+                if not self._federation:
+                    return self._not_an_aggregator()
+                return json_response(
+                    503, {"error": "no federation round completed yet",
+                          "ready": False},
+                )
+            return negotiate(gsnap.entity(key), req.headers)
+
+        return handler
+
+    def _get_global_cluster(self, req: Request) -> Response:
+        gsnap = self._global
+        if gsnap is None:
+            if not self._federation:
+                return self._not_an_aggregator()
+            return json_response(
+                503, {"error": "no federation round completed yet",
+                      "ready": False},
+            )
+        entity = gsnap.cluster_entity(req.params["name"])
+        if entity is None:
+            return json_response(
+                404,
+                {"error": f"cluster {req.params['name']!r} is not in the "
+                          f"endpoints file (round {gsnap.seq})",
+                 "round": gsnap.seq},
+            )
+        return negotiate(entity, req.headers)
+
     def _get_collection(self, key: str):
         def handler(req: Request) -> Response:
+            if self._federation:
+                return self._redirect_to_global()
             snap = self._current()
             if snap is None:
                 return self._no_round()
@@ -433,6 +544,8 @@ class FleetStateServer:
         return handler
 
     def _get_node(self, req: Request) -> Response:
+        if self._federation:
+            return self._redirect_to_global()
         snap = self._current()
         if snap is None:
             return self._no_round()
@@ -461,6 +574,14 @@ class FleetStateServer:
         return json_response(200, {"ok": True})
 
     def _get_readyz(self, req: Request) -> Response:
+        if self._readiness is not None:
+            # Federation mode: the aggregator's own rule (≥1 merge round,
+            # not blind), with per-cluster fetch/breaker detail in the body.
+            ok, reason, detail = self._readiness()
+            body = {"ready": ok, "reason": reason, **(detail or {})}
+            if self._global is not None:
+                body["round"] = self._global.seq
+            return json_response(200 if ok else 503, body)
         self._current()  # standalone: readiness reflects the refreshed store
         ok, reason = self.ready()
         body = {"ready": ok, "reason": reason}
@@ -558,7 +679,8 @@ class FleetStateServer:
                 503,
                 {
                     "error": "control plane unavailable: this server runs over "
-                    "a recorded store, not a live check loop"
+                    "a recorded store or a federated view, not a live check "
+                    "loop — cordon through the cluster's own checker"
                 },
             )
         snap = self._current()

@@ -18,9 +18,16 @@ method handling cannot drift between them:
   pre-serialized :class:`~tpu_node_checker.server.snapshot.Entity`.
 
 The router matches on exact segments plus ``{name}``-style captures.
-Captured values are percent-decoded path segments; handlers receive them in
-``Request.params``.  Route PATTERNS (not raw paths) are what request
-metrics label by, so a 5k-node fleet cannot mint 5k label values.
+Percent-decoding is normalized in ONE place (:func:`split_path_segments`):
+the raw path is split on literal ``/`` FIRST, then every segment is decoded
+exactly once — so ``%2F`` inside a segment stays a within-segment slash
+(``/api/v1/nodes/a%2Fb`` captures ``name="a/b"``, the ``cluster/node`` key
+shape federation serves), an encoded static segment still matches its
+route (``/api/v1/%6Eodes`` is ``/api/v1/nodes``), and a literal ``/`` in a
+name can never be confused with a path separator.  Handlers receive the
+decoded captures in ``Request.params``.  Route PATTERNS (not raw paths)
+are what request metrics label by, so a 5k-node fleet cannot mint 5k label
+values.
 """
 
 from __future__ import annotations
@@ -120,6 +127,22 @@ def gunzip(data: bytes) -> bytes:
     return _gzip.decompress(data)
 
 
+def split_path_segments(path: str) -> List[str]:
+    """A raw request path → its percent-DECODED segments, decoding applied
+    exactly once per segment AFTER the split on literal ``/``.
+
+    This is the one normalization point both matching sides share: static
+    route segments compare against decoded text, and ``{name}`` captures
+    are the decoded segment verbatim — so ``a%2Fb`` reaches a handler as
+    ``a/b`` while ``/a/b`` stays two segments.  Before this, static
+    segments compared ENCODED while captures decoded, so
+    ``/api/v1/nodes/a%2Fb`` and ``/api/v1/%6Eodes/x`` resolved by two
+    different rules (the ambiguity the ``cluster/node`` key shape cannot
+    live with).
+    """
+    return [urllib.parse.unquote(s) for s in path.split("/") if s]
+
+
 def route_request(router: "Router", method: str, target: str, headers,
                   body: bytes, remote: str) -> Tuple[Response, str]:
     """The dispatch core both HTTP stacks share → ``(response, pattern)``.
@@ -164,12 +187,15 @@ class Router:
 
     @staticmethod
     def _match(segments: Tuple[str, ...], path_segs: List[str]) -> Optional[Dict[str, str]]:
+        """``path_segs`` arrive already percent-decoded
+        (:func:`split_path_segments`), so static segments and captures are
+        judged by the same text — no second decode here."""
         if len(segments) != len(path_segs):
             return None
         params: Dict[str, str] = {}
         for pat, seg in zip(segments, path_segs):
             if pat.startswith("{") and pat.endswith("}"):
-                params[pat[1:-1]] = urllib.parse.unquote(seg)
+                params[pat[1:-1]] = seg
             elif pat != seg:
                 return None
         return params
@@ -178,7 +204,7 @@ class Router:
         """→ ``(handler, params, pattern)`` | :class:`Response` (404/405)."""
         method = method.upper()
         lookup = "GET" if method == "HEAD" else method
-        path_segs = [s for s in path.split("/") if s]
+        path_segs = split_path_segments(path)
         allowed: set = set()
         for m, segments, pattern, handler in self._routes:
             params = self._match(segments, path_segs)

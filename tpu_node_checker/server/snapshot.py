@@ -116,6 +116,17 @@ def gzip_fragment(frag: bytes) -> bytes:
     return gzip.compress(b", " + frag, _GZIP_LEVEL, mtime=0)
 
 
+def joined_prefix(head: dict, key: str) -> bytes:
+    """The byte prefix ``{<head fields>, "<key>": [`` every joined
+    collection opens with — ONE definition of the head-splice framing
+    (``json.dumps`` default separators, closing brace replaced by the
+    array) shared by this module and the federation merge tier, so the
+    wire format the marker parsers depend on cannot drift per copy."""
+    return (
+        json.dumps(head, ensure_ascii=False)[:-1] + f', "{key}": ['
+    ).encode("utf-8")
+
+
 def build_joined_entity(head: dict, key: str, fragments,
                         gz_fragments=None) -> Entity:
     """``{**head, key: [...]}`` as an Entity, the list byte-joined from
@@ -131,9 +142,7 @@ def build_joined_entity(head: dict, key: str, fragments,
     a multi-member stream whose decompression is byte-identical to the
     plain body, built without re-deflating any unchanged node.
     """
-    prefix = (
-        json.dumps(head, ensure_ascii=False)[:-1] + f', "{key}": ['
-    ).encode("utf-8")
+    prefix = joined_prefix(head, key)
     tail = b"]}\n"
     body = prefix + b", ".join(fragments) + tail
     gz = None
@@ -167,15 +176,31 @@ def build_summary_doc(payload: dict, exit_code: int, seq: int, ts: float) -> dic
         },
         "degraded": bool(payload.get("degraded")),
     }
-    for key in ("probe_summary", "history", "expected_chips",
+    for key in ("cluster", "probe_summary", "history", "expected_chips",
                 "expected_chips_met", "api_transport", "watch_stream"):
         if payload.get(key) is not None:
             summary[key] = payload[key]
     return summary
 
 
+def collection_head(payload: dict, seq: int, ts: float, count: int) -> dict:
+    """The nodes collection's head keys — ONE definition for the full and
+    delta builders, so the byte-joined body and a whole-document encode can
+    never disagree on what precedes the entries.  Carries the round's
+    cluster identity when the payload is stamped (``--cluster-name``): the
+    field a federation aggregator cross-checks against its endpoints
+    file."""
+    head = {"round": seq, "ts": ts, "count": count}
+    if payload.get("cluster") is not None:
+        head["cluster"] = payload["cluster"]
+    return head
+
+
 def build_slices_entity(payload: dict, seq: int, ts: float):
-    slices_doc = {"round": seq, "ts": ts, "slices": payload.get("slices") or []}
+    slices_doc = {"round": seq, "ts": ts}
+    if payload.get("cluster") is not None:
+        slices_doc["cluster"] = payload["cluster"]
+    slices_doc["slices"] = payload.get("slices") or []
     if payload.get("multislices") is not None:
         slices_doc["multislices"] = payload["multislices"]
     return slices_doc, json_entity(slices_doc)
@@ -193,7 +218,8 @@ def build_snapshot(
     snap = FleetSnapshot(seq, ts, exit_code, "round")
     nodes = payload.get("nodes") or []
     summary = build_summary_doc(payload, exit_code, seq, ts)
-    nodes_doc = {"round": seq, "ts": ts, "count": len(nodes), "nodes": nodes}
+    head = collection_head(payload, seq, ts, len(nodes))
+    nodes_doc = {**head, "nodes": nodes}
     slices_doc, slices_entity = build_slices_entity(payload, seq, ts)
     snap.docs = {"summary": summary, "nodes": nodes_doc, "slices": slices_doc}
     snap.entities["summary"] = json_entity(summary)
@@ -210,9 +236,7 @@ def build_snapshot(
         snap.node_entities[name] = json_entity(
             {"round": seq, "ts": ts, "node": n}
         )
-    snap.entities["nodes"] = build_joined_entity(
-        {"round": seq, "ts": ts, "count": len(nodes)}, "nodes", fragments
-    )
+    snap.entities["nodes"] = build_joined_entity(head, "nodes", fragments)
     return snap
 
 
@@ -247,7 +271,8 @@ def build_snapshot_delta(
     snap = FleetSnapshot(seq, ts, exit_code, "round")
     nodes = payload.get("nodes") or []
     summary = build_summary_doc(payload, exit_code, seq, ts)
-    nodes_doc = {"round": seq, "ts": ts, "count": len(nodes), "nodes": nodes}
+    head = collection_head(payload, seq, ts, len(nodes))
+    nodes_doc = {**head, "nodes": nodes}
     slices_doc, slices_entity = build_slices_entity(payload, seq, ts)
     snap.docs = {"summary": summary, "nodes": nodes_doc, "slices": slices_doc}
     snap.entities["summary"] = json_entity(summary)
@@ -282,8 +307,7 @@ def build_snapshot_delta(
                 {"round": seq, "ts": ts, "node": n}
             )
     snap.entities["nodes"] = build_joined_entity(
-        {"round": seq, "ts": ts, "count": len(nodes)}, "nodes", fragments,
-        gz_fragments,
+        head, "nodes", fragments, gz_fragments,
     )
     return snap
 

@@ -513,6 +513,7 @@ class StreamRoundEngine:
                 stats = getattr(self._client, "transport_stats", lambda: {})()
                 if stats:
                     payload["api_transport"] = stats
+            checker.stamp_cluster_identity(payload, self.args, self._client)
             payload["watch_stream"] = self.stats.as_dict()
             payload["exit_code"] = exit_code
         payload["timings_ms"] = timer.as_dict()
