@@ -56,6 +56,16 @@ Watch-stream evidence (the incremental-rounds tentpole):
   relists happen exactly on seed + injected stream loss + injected 410 —
   never on a steady or churn round.
 
+Observability evidence (the tracing tentpole):
+
+* ``nodes5k_watch_steady_traced_p50_ms`` — the same zero-change tick with
+  the obs layer wired the way the watch loop wires it (per-round Tracer,
+  span-recorded phases, completed trace fed into the phase histogram and
+  the debug ring), interleaved tick-for-tick with untraced rounds
+  (``nodes5k_watch_steady_untraced_p50_ms``) so both medians see the same
+  machine conditions; ``watch_traced_tax_pct`` is the measured overhead,
+  ASSERTED ≤ 15% — observability must stay cheap enough to always be on.
+
 Federation evidence (the multi-cluster tentpole):
 
 * ``nodes100k_federated_*`` — 20 fixture clusters × 5k nodes, each a REAL
@@ -707,6 +717,59 @@ def main() -> int:
     )
     assert watch_steady_p50 < nodes5k_p50, (watch_steady_p50, nodes5k_p50)
 
+    # Observability tax (this PR's tentpole, BENCH_r09): the SAME steady
+    # tick driven the way the watch loop drives it with obs wired — a
+    # per-round Tracer minted, the tick's phases recorded as spans, the
+    # completed trace fed into the phase histogram and the debug ring.
+    # Traced and untraced ticks INTERLEAVE so both medians see identical
+    # machine conditions: at ~15µs a round, CPU-frequency drift between
+    # two separately-timed loops exceeds the tax being measured.  The
+    # gate: always-on tracing + histograms cost within 15% of the
+    # untraced steady round.
+    from tpu_node_checker.obs import Observability
+
+    bench_obs = Observability(cluster="bench")
+    for i in range(50):  # warm both paths (recorder registration is cold)
+        engine.tick()
+        warm_tracer = bench_obs.tracer(round_seq=i, mode="watch")
+        engine.tick(tracer=warm_tracer)
+        bench_obs.complete(warm_tracer)
+    steady_untraced, steady_traced = [], []
+    for i in range(201):
+        t0 = time.perf_counter()
+        result, delta = engine.tick()
+        steady_untraced.append((time.perf_counter() - t0) * 1e3)
+        assert delta == frozenset(), "steady tick saw phantom changes"
+        t0 = time.perf_counter()
+        tracer = bench_obs.tracer(round_seq=i, mode="watch")
+        result, delta = engine.tick(tracer=tracer)
+        bench_obs.complete(tracer)
+        steady_traced.append((time.perf_counter() - t0) * 1e3)
+        assert delta == frozenset(), "steady tick saw phantom changes"
+        assert result.payload["trace_id"] == tracer.trace_id
+    watch_steady_traced_p50 = statistics.median(steady_traced)
+    watch_steady_untraced_p50 = statistics.median(steady_untraced)
+    watch_traced_tax_pct = (
+        watch_steady_traced_p50 / watch_steady_untraced_p50 - 1.0
+    ) * 100
+    assert watch_steady_traced_p50 < 10.0, (
+        f"traced steady tick p50 {watch_steady_traced_p50:.3f}ms breaches "
+        "the 10ms budget"
+    )
+    assert watch_steady_traced_p50 <= watch_steady_untraced_p50 * 1.15, (
+        f"tracing tax {watch_traced_tax_pct:.1f}% over the untraced steady "
+        f"round ({watch_steady_traced_p50:.4f}ms vs "
+        f"{watch_steady_untraced_p50:.4f}ms) breaches the 15% "
+        "always-on budget"
+    )
+    # The always-on surface was actually populated: every completed round
+    # fed the phase histogram (fold + total per steady round) and the last
+    # N traces stayed ring-queryable through the churn of pushes.
+    phase_merge = bench_obs.round_phases.merged()
+    assert phase_merge["total"][2] == 251, phase_merge["total"][2]
+    assert phase_merge["fold"][2] == 251, phase_merge["fold"][2]
+    assert len(bench_obs.ring.entries()) == 32  # DEFAULT_RING_SIZE, evicting
+
     # 1% churn: flip ~20 TPU nodes per round via real stream frames (the
     # spin-wait for delivery sits OUTSIDE the timed region).
     churn_nodes = [
@@ -999,6 +1062,13 @@ def main() -> int:
                 ),
                 "nodes5k_paged_internal_p50_ms": round(nodes5k_p50, 2),
                 "nodes5k_watch_steady_p50_ms": round(watch_steady_p50, 3),
+                "nodes5k_watch_steady_traced_p50_ms": round(
+                    watch_steady_traced_p50, 3
+                ),
+                "nodes5k_watch_steady_untraced_p50_ms": round(
+                    watch_steady_untraced_p50, 3
+                ),
+                "watch_traced_tax_pct": round(watch_traced_tax_pct, 1),
                 "nodes5k_watch_churn1pct_p50_ms": round(watch_churn_p50, 2),
                 "nodes5k_fault30_p50_ms": round(nodes5k_fault30_p50, 2),
                 "serve_etag_hit_p50_ms": round(serve_etag_p50, 3),

@@ -1,0 +1,39 @@
+"""Seeds for TNC017: observability discipline — spans close via ``with``
+(a bare ``start_span`` is never closed and corrupts every offset after
+it); ``HistogramFamily`` names end ``_ms`` and declare their buckets."""
+
+BUCKETS_MS = (1.0, 5.0, 25.0)
+
+
+def traced_round(tracer):
+    with tracer.span("fold"):  # near-miss: the sanctioned with-closed span
+        pass
+    with tracer.start_span("grade"):  # near-miss: a with-context still closes
+        pass
+    span = tracer.start_span("merge")  # EXPECT[TNC017]
+    span.end()
+    tracer.restart_span("merge")  # near-miss: suffix match must be exact
+
+
+def histogram_families(HistogramFamily):
+    ok = HistogramFamily(
+        "tpu_node_checker_round_phase_duration_ms",  # near-miss: _ms, buckets
+        "per-phase round cost",
+        BUCKETS_MS,
+        label="phase",
+    )
+    ok_kw = HistogramFamily(
+        "tpu_node_checker_api_wait_ms",  # near-miss: buckets via keyword
+        "request wait",
+        buckets=BUCKETS_MS,
+    )
+    bad_name = HistogramFamily(
+        "tpu_node_checker_fetch_duration_seconds",  # EXPECT[TNC017]
+        "seconds-denominated family",
+        BUCKETS_MS,
+    )
+    bad_buckets = HistogramFamily(  # EXPECT[TNC017]
+        "tpu_node_checker_publish_duration_ms",
+        "no buckets declared",
+    )
+    return ok, ok_kw, bad_name, bad_buckets

@@ -765,7 +765,10 @@ def hammer_fleet_api(port, paths, swaps, clients=16, reconnect=False,
                         conn = dial()
                         continue
                     etag = resp.headers.get("ETag")
-                    if resp.status == 200:
+                    if resp.status == 200 and etag is not None:
+                        # ETag-less surfaces (the debug-rounds endpoints)
+                        # are hammered unconditionally — never send
+                        # 'If-None-Match: None'.
                         last_etag[path] = etag
                     records[slot].append((path, resp.status, etag, body))
         except Exception as exc:  # noqa: BLE001 — surfaced as a failure below

@@ -781,15 +781,24 @@ class TestFetchTierHardening:
             servers["eu-b"].close()
             engine.round()
             err = capsys.readouterr().err
-            assert "cluster 'eu-b' shard DEGRADED" in err
+            # One unified event-log line (obs.events), stamped with the
+            # merge round's trace_id so the edge joins to its round trace.
+            event = json.loads(
+                [l for l in err.splitlines() if '"shard-degraded"' in l][0]
+            )
+            assert event["shard"] == "eu-b"
+            assert event["trace_id"]
             assert "us-a" not in err
             engine.round()  # still down: the edge already logged
-            assert "DEGRADED" not in capsys.readouterr().err
+            assert "shard-degraded" not in capsys.readouterr().err
             servers["eu-b"] = FleetStateServer(port, host="127.0.0.1")
             servers["eu-b"].publish(_Round(_round_payload("eu-b", 2), 0))
             engine.round()
             err = capsys.readouterr().err
-            assert "cluster 'eu-b' shard recovered" in err
+            event = json.loads(
+                [l for l in err.splitlines() if '"shard-recovered"' in l][0]
+            )
+            assert event["shard"] == "eu-b"
             engine.round()
             assert "shard" not in capsys.readouterr().err
         finally:
@@ -821,7 +830,9 @@ class TestFederateCliValidation:
         ["--serve-token", "t"],
         ["--write-rps", "5"],
         ["--json"],
-        ["--trace", "t.json"],
+        ["--debug"],
+        # (--trace is NOT here: federate mode writes the merge round's
+        # two-tier trace — pinned valid in test_obs.py.)
     ])
     def test_round_and_write_flags_rejected(self, extra):
         # Silent-no-op rule: the aggregator runs no rounds and serves no

@@ -288,6 +288,8 @@ class TestFlapScenario:
         for n in on["nodes"]:
             n.pop("health")
         off.pop("timings_ms"), on.pop("timings_ms")
+        # Per-round identity, different by construction between the runs.
+        off.pop("trace_id"), on.pop("trace_id")
         assert json.dumps(on, sort_keys=True) == json.dumps(off, sort_keys=True)
 
     def test_chronic_rides_trend_causes(self, tmp_path, capsys):

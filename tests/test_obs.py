@@ -1,0 +1,908 @@
+"""The observability layer: tracer spans, native histograms, the event
+log, the debug-rounds endpoints, and the two-tier federation trace.
+
+The contracts under test (DESIGN.md §15):
+
+* **bucket math** — ``le`` is ≤ (a value equal to a bound lands in THAT
+  bucket), ``_bucket`` lines are cumulative with ``+Inf`` == ``_count``,
+  and merging per-thread recorders at scrape time loses nothing: the
+  merged family over N concurrent writers is element-wise identical to
+  the same observations recorded serially;
+* **nested spans** — parent/child depth and offsets survive into the
+  Chrome-trace document; spans recorded from other threads land on their
+  own ``tid``; the flat ``PhaseTimer`` surface (``phase``/``as_dict``/
+  ``chrome_trace``) is unchanged;
+* **ring** — the debug ring holds exactly the last N completed traces
+  (newest first), eviction never tears a reader: under a live HTTP hammer
+  against ``/api/v1/debug/rounds`` every 200 parses while the writer
+  pushes;
+* **two tiers, one trace** — a federation round's trace document contains
+  the aggregator's fetch/merge/publish spans AND the upstream cluster
+  round's spans, each tier's ``trace_id`` present, stitched via the
+  ``X-TNC-Trace`` response header.
+
+Wall-clock guard: same policy as tests/test_server.py — nothing here
+sleeps for real.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+from tpu_node_checker.obs import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    EventLog,
+    HistogramFamily,
+    Observability,
+    TraceRing,
+    Tracer,
+)
+from tpu_node_checker.obs.hist import Histogram, _fmt
+from tpu_node_checker.server.app import FleetStateServer
+from tpu_node_checker.utils.timing import PhaseTimer
+
+WALL_CLOCK_BUDGET_S = 20.0
+
+
+@pytest.fixture(autouse=True)
+def _wall_clock_guard():
+    t0 = time.perf_counter()
+    yield
+    elapsed = time.perf_counter() - t0
+    assert elapsed < WALL_CLOCK_BUDGET_S, (
+        f"obs test burned {elapsed:.1f}s of wall-clock — a real sleep or "
+        "a wedged thread leaked in"
+    )
+
+
+def _req(port, path, headers=None, method="GET"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.headers.items()), resp.read()
+    finally:
+        conn.close()
+
+
+class _Round:
+    def __init__(self, payload, exit_code=0):
+        self.payload = payload
+        self.exit_code = exit_code
+
+
+def _round_payload(n=2, trace_id=None, cluster=None):
+    payload = {
+        "total_nodes": n,
+        "ready_nodes": n,
+        "total_chips": n * 4,
+        "ready_chips": n * 4,
+        "nodes": [
+            {"name": f"n-{i}", "ready": True, "accelerators": 4}
+            for i in range(n)
+        ],
+        "slices": [],
+        "exit_code": 0,
+    }
+    if trace_id:
+        payload["trace_id"] = trace_id
+    if cluster:
+        payload["cluster"] = cluster
+        payload["cluster_source"] = "flag"
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# Histogram bucket math
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramBuckets:
+    def test_boundary_value_lands_in_its_own_bucket(self):
+        # Prometheus le is ≤: an observation equal to a bound belongs to
+        # THAT bucket, not the next one.
+        h = Histogram((1.0, 5.0))
+        h.record(1.0)
+        assert h.counts == [1, 0, 0]
+        h.record(5.0)
+        assert h.counts == [1, 1, 0]
+        h.record(0.5)
+        h.record(1.5)
+        h.record(50.0)  # +Inf overflow
+        assert h.counts == [2, 2, 1]
+        assert h.count == 5
+        assert h.total == pytest.approx(1.0 + 5.0 + 0.5 + 1.5 + 50.0)
+
+    def test_bucket_lines_are_cumulative_and_inf_equals_count(self):
+        fam = HistogramFamily(
+            "tpu_node_checker_test_wait_ms", "test", (1.0, 5.0, 10.0)
+        )
+        for value in (0.5, 0.5, 3.0, 7.0, 100.0):
+            fam.record(value)
+        lines = fam.prometheus_lines()
+        assert f"# TYPE tpu_node_checker_test_wait_ms histogram" in lines
+        samples = {
+            line.rsplit(" ", 1)[0]: float(line.rsplit(" ", 1)[1])
+            for line in lines if not line.startswith("#")
+        }
+        assert samples['tpu_node_checker_test_wait_ms_bucket{le="1"}'] == 2.0
+        assert samples['tpu_node_checker_test_wait_ms_bucket{le="5"}'] == 3.0
+        assert samples['tpu_node_checker_test_wait_ms_bucket{le="10"}'] == 4.0
+        assert samples['tpu_node_checker_test_wait_ms_bucket{le="+Inf"}'] == 5.0
+        assert samples["tpu_node_checker_test_wait_ms_count"] == 5.0
+        assert samples["tpu_node_checker_test_wait_ms_sum"] == pytest.approx(
+            111.0
+        )
+
+    def test_le_labels_render_trailing_zero_free(self):
+        # Identical bounds must always render identical le values, or a
+        # scrape's series names would split across restarts.
+        assert [_fmt(b) for b in (0.1, 0.25, 1.0, 5.0, 1000.0, 2500.0)] == [
+            "0.1", "0.25", "1", "5", "1000", "2500"
+        ]
+
+    def test_labeled_family_renders_per_label_series(self):
+        fam = HistogramFamily(
+            "tpu_node_checker_test_phase_ms", "test", (1.0,), label="phase"
+        )
+        fam.record(0.5, "fold")
+        fam.record(2.0, "grade")
+        lines = [l for l in fam.prometheus_lines() if not l.startswith("#")]
+        assert any('le="1",phase="fold"' in l and l.endswith(" 1.0")
+                   for l in lines)
+        assert any('le="1",phase="grade"' in l and l.endswith(" 0.0")
+                   for l in lines)
+        merged = fam.merged()
+        assert set(merged) == {"fold", "grade"}
+
+    def test_default_ladder_covers_the_project_budgets(self):
+        # The asserted perf budgets (serve p99 < 5ms, steady round < 10ms)
+        # need a bound AT the budget for histogram_quantile to answer
+        # "did we blow it" without interpolation across it.
+        assert 5.0 in DEFAULT_LATENCY_BUCKETS_MS
+        assert 10.0 in DEFAULT_LATENCY_BUCKETS_MS
+        assert tuple(sorted(DEFAULT_LATENCY_BUCKETS_MS)) == (
+            DEFAULT_LATENCY_BUCKETS_MS
+        )
+
+
+class TestHistogramConcurrency:
+    def test_multi_worker_record_merge_identity(self):
+        # N threads hammer the same labeled family; the merged result must
+        # be element-wise identical to the same observations recorded
+        # serially — per-thread recorders lose nothing at merge time.
+        fam = HistogramFamily(
+            "tpu_node_checker_test_conc_ms", "test", (1.0, 5.0, 25.0),
+            label="route",
+        )
+        serial = HistogramFamily(
+            "tpu_node_checker_test_serial_ms", "test", (1.0, 5.0, 25.0),
+            label="route",
+        )
+        values = [0.2, 1.0, 3.0, 5.0, 7.0, 30.0, 0.9, 25.0]
+        workers = 8
+        rounds = 50
+        start = threading.Barrier(workers)
+
+        def worker(slot):
+            start.wait(timeout=10)
+            label = f"r{slot % 2}"
+            for _ in range(rounds):
+                for value in values:
+                    fam.record(value, label)
+
+        threads = [
+            threading.Thread(target=worker, args=(slot,),
+                             name=f"tnc-test-hist-{slot}", daemon=True)
+            for slot in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads)
+        for slot in range(workers):
+            label = f"r{slot % 2}"
+            for _ in range(rounds):
+                for value in values:
+                    serial.record(value, label)
+        merged = fam.merged()
+        expected = serial.merged()
+        assert set(merged) == set(expected) == {"r0", "r1"}
+        for label in merged:
+            counts, total, count = merged[label]
+            e_counts, e_total, e_count = expected[label]
+            assert counts == e_counts
+            assert count == e_count
+            assert total == pytest.approx(e_total)
+
+    def test_thread_churn_reuses_recorders_and_keeps_counts(self):
+        # Both major recording surfaces run on SHORT-LIVED threads
+        # (thread-per-connection handlers, per-round federation fetchers):
+        # a dead thread's recorder must return to the family for re-lease —
+        # bounded recorder count under churn — while its accumulated
+        # samples keep scraping (counts are cumulative, never dropped).
+        import gc
+
+        fam = HistogramFamily(
+            "tpu_node_checker_test_churn_ms", "test", (1.0, 5.0),
+            label="route",
+        )
+        for generation in range(40):  # sequential short-lived threads
+            t = threading.Thread(
+                target=lambda: fam.record(0.5, "r"),
+                name=f"tnc-test-churn-{generation}", daemon=True,
+            )
+            t.start()
+            t.join(timeout=10)
+            assert not t.is_alive()
+        gc.collect()  # finalizer timing must not be why this passes
+        counts, total, count = fam.merged()["r"]
+        assert count == 40 and counts[0] == 40
+        assert total == pytest.approx(40 * 0.5)
+        # 40 dead threads leased far fewer than 40 recorders (sequential
+        # churn re-leases the same returned one, give or take finalizer
+        # lag at the margin).
+        assert len(fam._recorders) <= 3, len(fam._recorders)
+
+    def test_dedicated_recorder_feeds_the_same_merge(self):
+        fam = HistogramFamily(
+            "tpu_node_checker_test_dedicated_ms", "test", (1.0,),
+            label="phase",
+        )
+        rec = fam.recorder("fold")
+        rec.record(0.5)
+        fam.record(2.0, "fold")  # thread-local path, same label
+        counts, total, count = fam.merged()["fold"]
+        assert counts == [1, 1] and count == 2
+        assert total == pytest.approx(2.5)
+
+
+# ---------------------------------------------------------------------------
+# Tracer: nested spans, threads, compat surface
+# ---------------------------------------------------------------------------
+
+
+class TestTracerSpans:
+    def test_nested_spans_record_depth_and_offsets(self):
+        tracer = Tracer(round_seq=7)
+        with tracer.span("grade", changed=3):
+            with tracer.span("detect"):
+                pass
+            with tracer.span("fsm"):
+                pass
+        # Children complete before the parent; depth reflects nesting.
+        names = [(s[0], s[3]) for s in tracer.spans]
+        assert names == [("detect", 1), ("fsm", 1), ("grade", 0)]
+        by_name = {s[0]: s for s in tracer.spans}
+        _, g_start, g_dur, _, _, g_args = by_name["grade"]
+        for child in ("detect", "fsm"):
+            _, c_start, c_dur, _, _, _ = by_name[child]
+            assert c_start >= g_start
+            assert c_start + c_dur <= g_start + g_dur + 0.5
+        assert g_args == {"changed": 3}
+        # detect and fsm are siblings in execution order.
+        assert by_name["detect"][1] <= by_name["fsm"][1]
+
+    def test_chrome_trace_carries_identity_depth_and_total(self):
+        tracer = Tracer(round_seq=3, mode="round")
+        with tracer.span("fold"):
+            pass
+        tracer.finish()
+        doc = tracer.chrome_trace()
+        assert doc["otherData"]["trace_id"] == tracer.trace_id
+        assert doc["otherData"]["round_seq"] == 3
+        events = doc["traceEvents"]
+        meta = next(e for e in events if e["name"] == "trace_id")
+        assert meta["args"]["trace_id"] == tracer.trace_id
+        fold = next(e for e in events if e["name"] == "fold")
+        assert fold["ph"] == "X" and fold["args"]["depth"] == 0
+        total = next(e for e in events if e["name"] == "total")
+        assert fold["ts"] + fold["dur"] <= total["dur"] * 1.05
+        # The document round-trips as JSON bytes (the debug endpoint body).
+        assert json.loads(tracer.chrome_trace_bytes())["traceEvents"]
+
+    def test_spans_from_other_threads_get_their_own_tid(self):
+        tracer = Tracer()
+
+        def fetcher():
+            with tracer.span("fetch", cluster="us-a"):
+                pass
+
+        thread = threading.Thread(target=fetcher, name="tnc-test-fetcher",
+                                  daemon=True)
+        with tracer.span("round"):
+            thread.start()
+            thread.join(timeout=10)
+        assert not thread.is_alive()
+        tids = {s[0]: s[4] for s in tracer.spans}
+        assert tids["fetch"] != tids["round"]
+
+    def test_phase_timer_compat_surface(self):
+        # The original PhaseTimer API: phase()/phases/as_dict()/total_ms().
+        timer = PhaseTimer()
+        assert isinstance(timer, Tracer)
+        assert timer.trace_id  # every timer now mints a trace identity
+        with timer.phase("list"):
+            pass
+        with timer.phase("list"):
+            pass  # repeated phases accumulate, as before
+        out = timer.as_dict()
+        assert set(out) == {"list", "total"}
+        assert out["list"] >= 0.0
+        assert timer.phases["list"] == pytest.approx(
+            sum(s[2] for s in timer.spans)
+        )
+
+    def test_finish_freezes_total(self):
+        tracer = Tracer()
+        tracer.finish()
+        frozen = tracer.total_ms()
+        with tracer.span("late"):
+            pass
+        assert tracer.total_ms() == frozen
+
+    def test_error_rides_summary_and_document(self):
+        tracer = Tracer()
+        tracer.set_error("relist failed: HTTP 503")
+        tracer.finish()
+        assert tracer.summary()["error"] == "relist failed: HTTP 503"
+        assert tracer.chrome_trace()["otherData"]["error"] == (
+            "relist failed: HTTP 503"
+        )
+
+    def test_attach_subtrace_rebase_and_label(self):
+        tracer = Tracer()
+        sub_events = [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 1,
+             "args": {"name": "tpu-node-checker"}},
+            {"name": "fold", "ph": "X", "pid": 1, "tid": 1,
+             "ts": 0.0, "dur": 5.0},
+        ]
+        tracer.attach_subtrace("cluster:us-a", sub_events, trace_id="abc123")
+        tracer.finish()
+        events = tracer.chrome_trace()["traceEvents"]
+        track = [e for e in events if e.get("pid") == 2]
+        labels = [e["args"]["name"] for e in track
+                  if e["name"] == "process_name"]
+        # The sub-trace's own process_name metadata must NOT override the
+        # cluster label.
+        assert labels == ["cluster:us-a"]
+        fold = next(e for e in track if e["name"] == "fold")
+        assert fold["pid"] == 2 and fold["dur"] == 5.0
+        assert tracer.summary()["subtraces"] == [
+            {"label": "cluster:us-a", "trace_id": "abc123"}
+        ]
+
+
+# ---------------------------------------------------------------------------
+# The ring
+# ---------------------------------------------------------------------------
+
+
+class TestTraceRing:
+    def _completed(self, seq):
+        tracer = Tracer(round_seq=seq)
+        tracer.finish()
+        return tracer
+
+    def test_eviction_keeps_the_last_n_newest_first(self):
+        ring = TraceRing(4)
+        tracers = [self._completed(i) for i in range(10)]
+        for tracer in tracers:
+            ring.push(tracer)
+        entries = ring.entries()
+        assert [t.round_seq for t in entries] == [9, 8, 7, 6]
+        assert ring.find(tracers[9].trace_id) is tracers[9]
+        assert ring.find(tracers[0].trace_id) is None  # evicted
+
+    def test_partial_ring_returns_only_pushed(self):
+        ring = TraceRing(8)
+        ring.push(self._completed(1))
+        assert [t.round_seq for t in ring.entries()] == [1]
+
+    def test_concurrent_readers_never_see_an_unfinished_trace(self):
+        ring = TraceRing(4)
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                for entry in ring.entries():
+                    if entry._total_ms is None:
+                        errors.append("reader saw an unfinished tracer")
+                        return
+
+        threads = [
+            threading.Thread(target=reader, name=f"tnc-test-ring-{i}",
+                             daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for seq in range(500):
+            ring.push(self._completed(seq))
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads)
+        assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# Event log
+# ---------------------------------------------------------------------------
+
+
+class TestEventLog:
+    def test_emit_writes_stderr_and_file(self, tmp_path, capsys):
+        path = tmp_path / "events.jsonl"
+        log = EventLog(str(path), cluster="us-a")
+        entry = log.emit("breaker-opened", trace_id="t1",
+                         consecutive_failures=3, absent=None)
+        assert entry["cluster"] == "us-a" and entry["trace_id"] == "t1"
+        assert "absent" not in entry  # None fields never serialize
+        line = capsys.readouterr().err.strip()
+        assert json.loads(line) == entry
+        events, skipped = EventLog.load(str(path))
+        assert skipped == 0 and events == [entry]
+
+    def test_load_is_torn_line_tolerant(self, tmp_path, capsys):
+        path = tmp_path / "events.jsonl"
+        log = EventLog(str(path))
+        log.emit("fsm-transition", node="n-1")
+        log.emit("fsm-transition", node="n-2")
+        with open(path, "a") as f:
+            f.write('{"event": "torn')  # crash mid-write
+        events, skipped = EventLog.load(str(path))
+        assert [e["node"] for e in events] == ["n-1", "n-2"]
+        assert skipped == 1
+
+    def test_unwritable_path_degrades_to_stderr_only(self, tmp_path, capsys):
+        log = EventLog(str(tmp_path / "no" / "dir" / "e.jsonl"))
+        entry = log.emit("shard-degraded", shard="us-a")
+        err = capsys.readouterr().err
+        assert json.dumps(entry, ensure_ascii=False) in err
+        assert "unwritable" in err
+        log.emit("shard-degraded", shard="eu-b")
+        # One outage note, not one per event.
+        assert "unwritable" not in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Debug endpoints + identity headers
+# ---------------------------------------------------------------------------
+
+
+class TestDebugEndpoints:
+    def _server_with_round(self, obs):
+        srv = FleetStateServer(0, host="127.0.0.1", obs=obs)
+        tracer = obs.tracer(1)
+        with tracer.span("fold"):
+            pass
+        with tracer.span("publish"):
+            srv.publish(
+                _Round(_round_payload(trace_id=tracer.trace_id)),
+                tracer=tracer,
+            )
+        obs.complete(tracer)
+        return srv, tracer
+
+    def test_rounds_list_and_detail(self):
+        obs = Observability()
+        srv, tracer = self._server_with_round(obs)
+        try:
+            status, _, body = _req(srv.port, "/api/v1/debug/rounds")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["count"] == 1 and doc["ring_size"] == obs.ring.size
+            (entry,) = doc["rounds"]
+            assert entry["trace_id"] == tracer.trace_id
+            assert entry["round_seq"] == 1
+            status, headers, body = _req(
+                srv.port, f"/api/v1/debug/rounds/{tracer.trace_id}"
+            )
+            assert status == 200
+            assert "application/json" in headers["Content-Type"]
+            trace_doc = json.loads(body)
+            names = {e["name"] for e in trace_doc["traceEvents"]}
+            assert {"fold", "publish", "total"} <= names
+        finally:
+            srv.close()
+
+    def test_unknown_trace_and_no_obs_answer_404(self):
+        obs = Observability()
+        srv, _ = self._server_with_round(obs)
+        try:
+            status, _, body = _req(srv.port, "/api/v1/debug/rounds/deadbeef")
+            assert status == 404
+            assert "not among" in json.loads(body)["error"]
+        finally:
+            srv.close()
+        bare = FleetStateServer(0, host="127.0.0.1")
+        try:
+            for path in ("/api/v1/debug/rounds",
+                         "/api/v1/debug/rounds/deadbeef"):
+                status, _, body = _req(bare.port, path)
+                assert status == 404
+                assert "tracing not enabled" in json.loads(body)["error"]
+        finally:
+            bare.close()
+
+    def test_snapshot_reads_carry_round_and_trace_headers(self):
+        obs = Observability()
+        srv, tracer = self._server_with_round(obs)
+        try:
+            # Fast path (exact request line) and routed path (per-node)
+            # must agree on the identity headers.
+            for path in ("/api/v1/nodes", "/api/v1/nodes/n-0"):
+                status, headers, _ = _req(srv.port, path)
+                assert status == 200, path
+                assert headers["X-TNC-Round"] == "1", path
+                assert headers["X-TNC-Trace"] == tracer.trace_id, path
+        finally:
+            srv.close()
+
+    def test_ring_eviction_under_live_hammer(self):
+        # Readers poll the debug surface while the round driver pushes
+        # completed traces through a small ring: every response parses,
+        # eviction never tears a document.
+        obs = Observability(ring_size=4)
+        srv, _ = self._server_with_round(obs)
+        try:
+            def swaps():
+                for seq in range(2, 30):
+                    tracer = obs.tracer(seq)
+                    with tracer.span("fold"):
+                        pass
+                    srv.publish(
+                        _Round(_round_payload(trace_id=tracer.trace_id)),
+                        tracer=tracer,
+                    )
+                    obs.complete(tracer)
+
+            flat = fx.hammer_fleet_api(
+                srv.port,
+                ["/api/v1/debug/rounds", "/api/v1/summary"],
+                swaps,
+                clients=8,
+            )
+            fx.assert_poll_contract(flat, bijection=False)
+            debug_bodies = [
+                body for path, status, _, body in flat
+                if path == "/api/v1/debug/rounds" and status == 200
+            ]
+            assert debug_bodies
+            for body in debug_bodies:
+                doc = json.loads(body)  # raises on a torn document
+                assert len(doc["rounds"]) <= 4
+            # After the storm the ring holds exactly the last 4 rounds.
+            status, _, body = _req(srv.port, "/api/v1/debug/rounds")
+            assert [r["round_seq"] for r in json.loads(body)["rounds"]] == [
+                29, 28, 27, 26
+            ]
+        finally:
+            srv.close()
+
+    def test_metrics_expose_bucket_families(self):
+        obs = Observability()
+        srv, _ = self._server_with_round(obs)
+        try:
+            _req(srv.port, "/api/v1/nodes/n-0")  # a routed-path sample
+            status, _, body = _req(srv.port, "/metrics")
+            assert status == 200
+            text = body.decode()
+            for family in (
+                "tpu_node_checker_round_phase_duration_ms",
+                "tpu_node_checker_api_server_request_duration_ms",
+            ):
+                assert f"# TYPE {family} histogram" in text
+                assert f'{family}_bucket{{le="+Inf"' in text or (
+                    f'{family}_bucket{{' in text
+                )
+                assert f"{family}_count" in text
+            # phase="total" is the whole-round series the bench asserts.
+            assert 'phase="total"' in text
+            # The deprecated alias is DERIVED from the merged histogram.
+            assert ("tpu_node_checker_api_server_request_latency_ms_count"
+                    in text)
+            assert "DEPRECATED" in text
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# run_check + watch wiring
+# ---------------------------------------------------------------------------
+
+
+class TestRoundTraceWiring:
+    def test_run_check_stamps_trace_id(self):
+        args = cli.parse_args(["--json"])
+        result = checker.run_check(args, nodes=fx.tpu_v5e_single_host())
+        assert result.payload["trace_id"]
+        assert "timings_ms" in result.payload
+
+    def test_caller_owned_tracer_is_reused(self):
+        args = cli.parse_args(["--json"])
+        obs = Observability()
+        tracer = obs.tracer(11)
+        result = checker.run_check(
+            args, nodes=fx.tpu_v5e_single_host(), tracer=tracer
+        )
+        assert result.payload["trace_id"] == tracer.trace_id
+        assert "detect" in tracer.phases
+        obs.complete(tracer)
+        assert obs.ring.find(tracer.trace_id) is tracer
+        # The phase histogram saw every phase plus the round total.
+        merged = obs.round_phases.merged()
+        assert "detect" in merged and "total" in merged
+
+    def test_observability_from_args_reads_cluster_and_event_log(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.delenv("TNC_CLUSTER_NAME", raising=False)
+        args = cli.parse_args([
+            "--watch", "60", "--cluster-name", "us-a",
+            "--event-log", str(tmp_path / "e.jsonl"),
+        ])
+        obs = Observability.from_args(args)
+        assert obs.cluster == "us-a"
+        assert obs.events.path == str(tmp_path / "e.jsonl")
+        assert obs.events.cluster == "us-a"
+
+    def test_write_audit_line_carries_trace_id(self, capsys):
+        obs = Observability()
+        srv = FleetStateServer(0, host="127.0.0.1", obs=obs)
+        try:
+            tracer = obs.tracer(1)
+            srv.publish(
+                _Round(_round_payload(trace_id=tracer.trace_id)),
+                tracer=tracer,
+            )
+            obs.complete(tracer)
+            capsys.readouterr()
+            # No token configured → 403 final — and one audit event.
+            status, _, _ = _req(srv.port, "/api/v1/nodes/n-0/cordon",
+                                method="POST")
+            assert status == 403
+            lines = [
+                json.loads(l)
+                for l in capsys.readouterr().err.splitlines()
+                if l.startswith("{")
+            ]
+            (audit,) = [l for l in lines if l["event"] == "fleet-api-write"]
+            assert audit["trace_id"] == tracer.trace_id
+            assert audit["action"] == "cordon" and audit["node"] == "n-0"
+        finally:
+            srv.close()
+
+    def test_slack_message_carries_trace_id(self):
+        from tpu_node_checker import notify
+
+        posts = []
+
+        def fake_post(url, json=None, timeout=None):
+            posts.append(json)
+
+            class R:
+                status_code = 200
+
+            return R()
+
+        ok = notify.send_slack_message(
+            "https://hooks.example/x", "fleet degraded",
+            post=fake_post, trace_id="abc123",
+        )
+        assert ok
+        assert "`trace: abc123`" in posts[0]["text"]
+
+
+# ---------------------------------------------------------------------------
+# CLI validation
+# ---------------------------------------------------------------------------
+
+
+class TestObsCliValidation:
+    def test_event_log_requires_a_daemon_mode(self, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--event-log", "/tmp/e.jsonl"])
+        assert "--event-log" in capsys.readouterr().err
+
+    def test_event_log_rejected_with_emit_probe(self, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args([
+                "--emit-probe", "/tmp/out", "--watch", "60",
+                "--event-log", "/tmp/e.jsonl",
+            ])
+        assert "--event-log" in capsys.readouterr().err
+
+    def test_trace_now_valid_with_federate(self, tmp_path):
+        endpoints = tmp_path / "endpoints.json"
+        endpoints.write_text(json.dumps({
+            "clusters": [{"name": "us-a", "url": "http://127.0.0.1:1"}]
+        }))
+        args = cli.parse_args([
+            "--federate", str(endpoints), "--serve", "0",
+            "--trace", str(tmp_path / "t.json"),
+            "--event-log", str(tmp_path / "e.jsonl"),
+        ])
+        assert args.trace and args.event_log
+
+    def test_trace_still_rejected_standalone_serve(self, tmp_path, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args([
+                "--serve", "0", "--log-jsonl", str(tmp_path / "r.jsonl"),
+                "--trace", str(tmp_path / "t.json"),
+            ])
+        assert "--trace" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Federation: one trace spans both tiers
+# ---------------------------------------------------------------------------
+
+
+class TestFederationTwoTierTrace:
+    def _upstream(self, cluster="us-a", n=2):
+        obs = Observability(cluster=cluster)
+        srv = FleetStateServer(0, host="127.0.0.1", obs=obs)
+        tracer = obs.tracer(1)
+        with tracer.span("fold"):
+            pass
+        with tracer.span("grade"):
+            with tracer.span("detect"):
+                pass
+        payload = _round_payload(n=n, trace_id=tracer.trace_id,
+                                 cluster=cluster)
+        with tracer.span("publish"):
+            srv.publish(_Round(payload), tracer=tracer)
+        obs.complete(tracer)
+        return obs, srv, tracer
+
+    def _aggregate(self, tmp_path, servers, extra=()):
+        from tpu_node_checker.federation.aggregator import FederationEngine
+
+        endpoints = tmp_path / "endpoints.json"
+        endpoints.write_text(json.dumps({
+            "clusters": [
+                {"name": name, "url": f"http://127.0.0.1:{srv.port}"}
+                for name, srv in servers
+            ]
+        }))
+        args = cli.parse_args([
+            "--federate", str(endpoints), "--serve", "0",
+            "--retry-budget", "0", *extra,
+        ])
+        obs = Observability()
+        agg = FleetStateServer(0, host="127.0.0.1", federation=True, obs=obs)
+        engine = FederationEngine(args, obs=obs)
+        return obs, agg, engine
+
+    def test_one_trace_id_spans_both_tiers(self, tmp_path):
+        up_obs, up_srv, up_tracer = self._upstream()
+        obs, agg, engine = self._aggregate(tmp_path, [("us-a", up_srv)])
+        try:
+            engine.round(agg)
+            status, headers, body = _req(agg.port, "/api/v1/global/summary")
+            assert status == 200
+            trace_id = json.loads(body)["trace_id"]
+            assert headers["X-TNC-Trace"] == trace_id
+            status, _, body = _req(
+                agg.port, f"/api/v1/debug/rounds/{trace_id}"
+            )
+            assert status == 200
+            doc = json.loads(body)
+            events = doc["traceEvents"]
+            # Tier 1 (the aggregator's own round, pid 1).
+            agg_names = {e["name"] for e in events if e.get("pid") == 1}
+            assert {"fetch", "merge", "publish", "total"} <= agg_names
+            fetch = next(e for e in events
+                         if e["name"] == "fetch" and e.get("pid") == 1)
+            assert fetch["args"]["cluster"] == "us-a"
+            # Tier 2 (the upstream cluster's round, stitched as pid 2).
+            track_labels = [
+                e["args"]["name"] for e in events
+                if e["name"] == "process_name" and e.get("pid") == 2
+            ]
+            assert track_labels == ["cluster:us-a"]
+            up_names = {e["name"] for e in events if e.get("pid") == 2}
+            assert {"fold", "grade", "detect", "publish"} <= up_names
+            # BOTH trace ids are present in the one document.
+            ids = {
+                e["args"]["trace_id"] for e in events
+                if e["name"] == "trace_id"
+            }
+            assert ids == {trace_id, up_tracer.trace_id}
+            # The list view names the stitched sub-trace too.
+            status, _, body = _req(agg.port, "/api/v1/debug/rounds")
+            (entry,) = [
+                r for r in json.loads(body)["rounds"]
+                if r["trace_id"] == trace_id
+            ]
+            assert entry["subtraces"] == [
+                {"label": "cluster:us-a", "trace_id": up_tracer.trace_id}
+            ]
+        finally:
+            up_srv.close()
+            agg.close()
+            engine.close()
+
+    def test_304_round_reattaches_cached_upstream_trace(self, tmp_path):
+        up_obs, up_srv, up_tracer = self._upstream()
+        obs, agg, engine = self._aggregate(tmp_path, [("us-a", up_srv)])
+        try:
+            engine.round(agg)
+            engine.round(agg)  # steady: one 304 per endpoint, no re-fetch
+            view = engine.views["us-a"]
+            assert view.upstream_trace == up_tracer.trace_id
+            second = obs.ring.entries()[0]
+            assert second.round_seq == 2
+            assert second.summary()["subtraces"] == [
+                {"label": "cluster:us-a", "trace_id": up_tracer.trace_id}
+            ]
+        finally:
+            up_srv.close()
+            agg.close()
+            engine.close()
+
+    def test_fetch_histogram_reaches_the_scrape_surface(self, tmp_path):
+        up_obs, up_srv, _ = self._upstream()
+        obs, agg, engine = self._aggregate(tmp_path, [("us-a", up_srv)])
+        try:
+            engine.round(agg)
+            status, _, body = _req(agg.port, "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert ("# TYPE tpu_node_checker_federation_fetch_duration_ms "
+                    "histogram") in text
+            assert 'cluster="us-a"' in text
+        finally:
+            up_srv.close()
+            agg.close()
+            engine.close()
+
+    def test_shard_transition_events_carry_trace_id(self, tmp_path, capsys):
+        up_obs, up_srv, _ = self._upstream()
+        obs, agg, engine = self._aggregate(tmp_path, [("us-a", up_srv)])
+        try:
+            engine.round(agg)
+            up_srv.close()  # the cluster goes dark
+            capsys.readouterr()
+            engine.round(agg)
+            lines = [
+                json.loads(l)
+                for l in capsys.readouterr().err.splitlines()
+                if l.startswith("{")
+            ]
+            (event,) = [l for l in lines if l["event"] == "shard-degraded"]
+            assert event["shard"] == "us-a"
+            assert event["trace_id"] == engine.last_tracer.trace_id
+        finally:
+            agg.close()
+            engine.close()
+
+    def test_failed_round_trace_is_ring_visible_with_error(self, tmp_path):
+        up_obs, up_srv, _ = self._upstream()
+        obs, agg, engine = self._aggregate(tmp_path, [("us-a", up_srv)])
+        try:
+            engine.round(agg)
+
+            def boom(*a, **k):
+                raise RuntimeError("merge bug")
+
+            engine._maybe_reload = boom
+            with pytest.raises(RuntimeError):
+                engine.round(agg)
+            failed = obs.ring.entries()[0]
+            assert failed.error == "merge bug"
+            assert failed.round_seq == 2
+        finally:
+            up_srv.close()
+            agg.close()
+            engine.close()

@@ -1092,6 +1092,8 @@ class TestNoServeByteIdentical:
         code_b, b = run(args_bare)
         assert code_a == code_b
         a.pop("timings_ms"), b.pop("timings_ms")
+        # Per-round identity, different by construction between the runs.
+        a.pop("trace_id"), b.pop("trace_id")
         assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
 
         def strip_volatile(text):

@@ -528,12 +528,12 @@ class TestWatchLoopIntegration:
         # pushed event landed.
         orig_tick = StreamRoundEngine.tick
 
-        def synced_tick(self):
+        def synced_tick(self, tracer=None):
             if len(ticks) >= 2:
                 deadline = time.perf_counter() + 5.0
                 while time.perf_counter() < deadline and self.cache.pending() == 0:
                     time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded poll for a REAL watch socket to deliver the pushed frame before the next loop round)
-            return orig_tick(self)
+            return orig_tick(self, tracer=tracer)
 
         monkeypatch.setattr(StreamRoundEngine, "tick", synced_tick)
         rc = checker.watch(args)

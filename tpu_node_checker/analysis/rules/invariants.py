@@ -322,6 +322,60 @@ class ExitCode(Rule):
                 )
 
 
+class ObsDiscipline(Rule):
+    slug = "obs-discipline"
+    code = "TNC017"
+    doc = ("spans close via ``with`` — a bare ``start_span()`` call outside "
+           "a with-context is never closed and silently corrupts every span "
+           "offset after it — and ``HistogramFamily`` names end ``_ms`` with "
+           "their buckets declared at the instantiation (an implicit default "
+           "would mis-bucket the next family measured in seconds)")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package():
+            return
+        with_calls: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        with_calls.add(id(item.context_expr))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            if ((name == "start_span" or name.endswith(".start_span"))
+                    and id(node) not in with_calls):
+                yield self.finding(
+                    ctx.path, node,
+                    "bare start_span() outside a 'with' — an unclosed span "
+                    "corrupts every offset recorded after it; use "
+                    "'with tracer.span(...)'",
+                )
+            if name == "HistogramFamily" or name.endswith(".HistogramFamily"):
+                lit = const_str(node.args[0]) if node.args else None
+                if lit is not None and not lit.endswith("_ms"):
+                    yield self.finding(
+                        ctx.path, node.args[0],
+                        f"histogram family {lit!r} does not end '_ms' — "
+                        "every latency family in this tree is "
+                        "milliseconds-denominated; a mixed unit poisons "
+                        "histogram_quantile() across families",
+                    )
+                if (len(node.args) < 3
+                        and not any(kw.arg == "buckets"
+                                    for kw in node.keywords)):
+                    yield self.finding(
+                        ctx.path, node,
+                        "HistogramFamily without declared buckets — an "
+                        "implicit default silently mis-buckets the next "
+                        "family measured on a different scale; pass the "
+                        "bucket tuple explicitly",
+                    )
+
+
 class TestWallClock(Rule):
     slug = "test-wall-clock"
     code = "TNC016"
@@ -360,5 +414,6 @@ RULES: List[Rule] = [
     MutableDefault(),
     MetricName(),
     ExitCode(),
+    ObsDiscipline(),
     TestWallClock(),
 ]

@@ -1000,10 +1000,18 @@ def stamp_expected_chips(payload: dict, expected_key, expected_n, have_chips) ->
     payload["expected_chips_met"] = have_chips >= expected_n
 
 
-def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
+def run_check(args, nodes: Optional[List[dict]] = None,
+              tracer=None) -> CheckResult:
     """Pure-ish core of the run: everything except printing and Slack I/O
-    gating decisions is computed here so tests can drive it directly."""
-    timer = PhaseTimer()
+    gating decisions is computed here so tests can drive it directly.
+
+    ``tracer`` (watch mode) is the round's :class:`~tpu_node_checker.obs.
+    trace.Tracer` — the check's phases become spans on the SAME trace the
+    caller's publish span and debug ring share; without one, a fresh
+    tracer is minted (one-shot mode), and either way the payload carries
+    the round's ``trace_id``.
+    """
+    timer = tracer if tracer is not None else PhaseTimer()
     kube_client = None
     _ROUND_CLIENT["client"] = None  # telemetry tracks THIS round's traffic
     _ROUND_POLICY["policy"] = _build_retry_policy(args)
@@ -1164,23 +1172,34 @@ def run_check(args, nodes: Optional[List[dict]] = None) -> CheckResult:
             if stats:
                 payload["api_transport"] = stats
         stamp_cluster_identity(payload, args, live_client)
+        payload["trace_id"] = timer.trace_id
         payload["exit_code"] = result.exit_code
     payload["timings_ms"] = timer.as_dict()
     result.payload = payload
-    trace_path = getattr(args, "trace", None)
-    if trace_path:
-        try:
-            # tmp + rename, like emit_probe: a watch-mode round rewrites the
-            # file every interval and a reader must never see torn JSON.
-            tmp = f"{trace_path}.tmp"
-            with open(tmp, "w") as f:
-                json.dump(timer.chrome_trace(), f)
-            os.replace(tmp, trace_path)
-            if getattr(args, "watch", None) is None:
-                print(f"Trace written to {trace_path}.", file=sys.stderr)
-        except OSError as exc:
-            print(f"Cannot write trace {trace_path}: {exc}", file=sys.stderr)
+    if tracer is None and getattr(args, "trace", None):
+        # One-shot (caller-owned tracers are written by the round loop,
+        # AFTER the publish span lands on the same trace).
+        _write_trace_file(
+            args.trace, timer, announce=getattr(args, "watch", None) is None
+        )
     return result
+
+
+def _write_trace_file(trace_path: str, timer, announce: bool = False) -> None:
+    """``--trace FILE``: one Chrome-trace document per round, written
+    atomically (tmp + rename, like emit_probe) — watch/federate rounds
+    rewrite the file every interval and a reader must never see torn JSON.
+    Shared by ``run_check`` (one-shot / poll rounds), the watch-stream
+    tick path and the federation round loop."""
+    try:
+        tmp = f"{trace_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(timer.chrome_trace(), f)
+        os.replace(tmp, trace_path)
+        if announce:
+            print(f"Trace written to {trace_path}.", file=sys.stderr)
+    except OSError as exc:
+        print(f"Cannot write trace {trace_path}: {exc}", file=sys.stderr)
 
 
 # Major version of the emitter→aggregator report contract.  Emitter pods and
@@ -2031,12 +2050,18 @@ def serve_store(args) -> int:
                 holder["server"].publish_snapshot(snap)
             state["sig"] = sig
 
+    from tpu_node_checker.obs import Observability
+
+    # Standalone serving runs no rounds (the debug ring stays empty) but
+    # the event log still carries the write-path audit lines.
+    obs = Observability.from_args(args)
     server = FleetStateServer(
         args.serve,
         token=resolve_serve_token(getattr(args, "serve_token", None)),
         control=None,  # no live round → no evidence → writes answer 503
         trend_path=trend_path,
         refresh=refresh,
+        obs=obs,
         **_serve_pool_kwargs(args),
     )
     holder["server"] = server
@@ -2093,14 +2118,20 @@ def watch(args) -> int:
     """
     import threading
 
+    from tpu_node_checker.obs import Observability
+
     interval = args.watch
     on_change = getattr(args, "slack_on_change", False)
     webhook = notify.get_slack_webhook_url(getattr(args, "slack_webhook", None))
+    # The observability layer: per-round traces (debug ring + --trace),
+    # round-phase histograms on every scrape surface, and the unified
+    # event log (--event-log) breaker/FSM/audit lines ride through.
+    obs = Observability.from_args(args)
     metrics_server = None
     if getattr(args, "metrics_port", None) is not None:
         from tpu_node_checker.metrics import MetricsServer
 
-        metrics_server = MetricsServer(args.metrics_port)
+        metrics_server = MetricsServer(args.metrics_port, obs=obs)
         print(f"Serving /metrics on port {metrics_server.port}", file=sys.stderr)
     last_code: Optional[int] = None
     # The previous round's sick-node set (None = unknown: first round,
@@ -2150,6 +2181,7 @@ def watch(args) -> int:
             token=resolve_serve_token(getattr(args, "serve_token", None)),
             control=_make_serve_control(args),
             trend_path=getattr(args, "log_jsonl", None),
+            obs=obs,
             **_serve_pool_kwargs(args),
         )
         requested_workers = getattr(args, "serve_workers", None) or 1
@@ -2183,22 +2215,30 @@ def watch(args) -> int:
                 ),
                 username=username,
             )
+    round_seq = 0
     try:
         while True:
             round_start = time.monotonic()
+            round_seq += 1
+            # One trace per round: the check's phases, the publish, and the
+            # round's events all share its trace_id; completed traces land
+            # in the debug ring (/api/v1/debug/rounds) and, under --trace,
+            # in the Chrome-trace file.
+            tracer = obs.tracer(round_seq)
             # The try covers ONLY the check itself: a failure here means "the
             # monitor is down" — a state of its own (EXIT_ERROR) so that
             # recovery also registers as a transition.  Render/notify problems
             # afterwards are reported but do not reclassify a successful round.
             try:
                 if engine is not None:
-                    result, delta = engine.tick()
+                    result, delta = engine.tick(tracer=tracer)
                 else:
-                    result, delta = run_check(args), None
+                    result, delta = run_check(args, tracer=tracer), None
             except KeyboardInterrupt:
                 raise
             except Exception as exc:  # tnc: allow-broad-except(a bad round must not kill the daemon)
                 code = EXIT_ERROR
+                tracer.set_error(str(exc))
                 print(f"Check round failed: {exc}", file=sys.stderr)
                 # The cached keep-alive client just failed a round: drop it so
                 # the next round redials (and re-resolves credentials) instead
@@ -2217,6 +2257,13 @@ def watch(args) -> int:
                     # UNKNOWN, not gone); an OPEN breaker flips /readyz.
                     fleet_server.mark_error(breaker.as_dict())
                 _append_state_log(args, None, error=str(exc))
+                if transition == "opened":
+                    obs.events.emit(
+                        "breaker-opened",
+                        trace_id=tracer.trace_id,
+                        consecutive_failures=breaker.consecutive_failures,
+                        error=str(exc),
+                    )
                 sick = None  # an error round observed no nodes
                 changed = last_code is None or code != last_code
                 if webhook:
@@ -2245,6 +2292,22 @@ def watch(args) -> int:
             else:
                 code = result.exit_code
                 transition = breaker.record_success()
+                if transition == "closed":
+                    obs.events.emit(
+                        "breaker-closed", trace_id=tracer.trace_id
+                    )
+                for t in (result.payload.get("history") or {}).get(
+                    "transitions", []
+                ):
+                    if t.get("actionable"):
+                        # The quarantine lifecycle, joinable to its round:
+                        # →FAILED / →CHRONIC / a re-earned HEALTHY.
+                        obs.events.emit(
+                            "fsm-transition",
+                            trace_id=tracer.trace_id,
+                            node=t.get("node"),
+                            transition=t,
+                        )
                 if metrics_server is not None:
                     metrics_server.set_breaker(breaker.as_dict())
                     metrics_server.update(result)
@@ -2259,14 +2322,16 @@ def watch(args) -> int:
                     # 304 hit — the served round advances when the fleet
                     # changes, while the scrape surface (timestamp and
                     # stream-age gauges) keeps moving every tick.
-                    if delta is None or delta:
-                        fleet_server.publish(
-                            result, breaker=breaker.as_dict(), changed=delta
-                        )
-                    else:
-                        fleet_server.refresh_metrics(
-                            result, breaker=breaker.as_dict()
-                        )
+                    with tracer.span("publish"):
+                        if delta is None or delta:
+                            fleet_server.publish(
+                                result, breaker=breaker.as_dict(),
+                                changed=delta, tracer=tracer,
+                            )
+                        else:
+                            fleet_server.refresh_metrics(
+                                result, breaker=breaker.as_dict()
+                            )
                 sick = _round_sick_set(result)
                 # Change fingerprint = exit code + sick-node set: a node
                 # swap inside an unchanged code is still a transition.  The
@@ -2308,6 +2373,12 @@ def watch(args) -> int:
                     render_and_notify(args, result, notify_enabled=(not on_change) or changed)
                 except Exception as exc:  # tnc: allow-broad-except(e.g. stdout pipe gone)
                     print(f"Render/notify failed (check itself OK): {exc}", file=sys.stderr)
+            # The round's trace is done (publish span included): freeze it,
+            # feed the phase histograms, make it queryable in the debug
+            # ring — failed rounds too, labeled with their error.
+            obs.complete(tracer)
+            if getattr(args, "trace", None):
+                _write_trace_file(args.trace, tracer)
             if last_code is not None and code != last_code:
                 print(f"State change: exit {last_code} → {code}", file=sys.stderr)
             elif last_sick is not None and sick is not None and sick != last_sick:
@@ -3073,6 +3144,9 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
             username=getattr(args, "slack_username", notify.DEFAULT_USERNAME),
             max_retries=getattr(args, "slack_retry_count", notify.DEFAULT_MAX_RETRIES),
             retry_delay=getattr(args, "slack_retry_delay", notify.DEFAULT_RETRY_DELAY_S),
+            # The alert→trace join key: paste into
+            # /api/v1/debug/rounds/{trace_id} (or grep the --event-log).
+            trace_id=result.payload.get("trace_id"),
         )
         if not getattr(args, "json", False):
             # Console confirmation suppressed in JSON mode (check-gpu-node.py:268-271).

@@ -122,7 +122,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug", action="store_true", help="print phase timings")
     p.add_argument("--trace", metavar="FILE",
                    help="write a Chrome-trace-format timeline of the check's "
-                   "phases to FILE (open in Perfetto / chrome://tracing)")
+                   "phases to FILE (open in Perfetto / chrome://tracing); "
+                   "with --watch or --federate the file is atomically "
+                   "rewritten every round with that round's trace — the "
+                   "same documents GET /api/v1/debug/rounds serves")
+    p.add_argument("--event-log", metavar="FILE",
+                   help="append the unified structured event stream (fleet-"
+                   "API write audits, shard degraded/recovered, breaker "
+                   "open/close, FSM actionable transitions — one JSON line "
+                   "each, stamped with trace_id and cluster) to FILE; events "
+                   "always also go to stderr (requires --watch, --serve or "
+                   "--federate: one-shot runs emit no events)")
     p.add_argument("--watch", type=float, metavar="SECONDS",
                    help="daemon mode: repeat the check every SECONDS until interrupted")
     p.add_argument("--watch-stream", dest="watch_stream", action="store_true",
@@ -480,7 +490,6 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             ("--write-rps", args.write_rps is not None),
             ("--json", args.json),
             ("--debug", args.debug),
-            ("--trace", args.trace),
         ):
             if on:
                 p.error(
@@ -496,6 +505,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                 p.error(f"{flag} requires --federate")
     if args.slack_on_change and args.watch is None:
         p.error("--slack-on-change requires --watch")
+    if getattr(args, "event_log", None) and (
+        args.watch is None
+        and args.serve is None
+        and args.federate is None
+    ):
+        # One-shot runs emit no events (breaker/FSM/audit lines are all
+        # daemon-mode surfaces) — the silent-no-op rule again.
+        p.error(
+            "--event-log records daemon-mode events: it requires --watch, "
+            "--serve or --federate"
+        )
     if args.probe_results_required and not args.probe_results:
         p.error("--probe-results-required requires --probe-results DIR")
     if args.trend and (
@@ -698,6 +718,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             ("--slack-webhook", args.slack_webhook),
             ("--slack-only-on-error", args.slack_only_on_error),
             ("--slack-on-change", args.slack_on_change),
+            # The emitter loop runs no round engine: no breaker/FSM/audit
+            # events exist to log — accepting the flag would record nothing.
+            ("--event-log", getattr(args, "event_log", None)),
         ):
             if on:
                 # Emitters never notify — Slack is the aggregator's job
@@ -768,9 +791,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                 "--serve without --watch serves a recorded store: add "
                 "--history FILE and/or --log-jsonl FILE (or run with --watch)"
             )
-        if args.watch is None:
+        if args.watch is None and args.federate is None:
             # Standalone serving runs NO check rounds: any flag that only
             # means something during a round would silently do nothing
+            # (--federate passed its own stricter list above, and --trace
+            # IS meaningful there: the merge round's two-tier trace)
             # while the operator assumes coverage — the same silent-no-op
             # rule --trend/--report-fresh/--selftest enforce.
             for flag, on in (

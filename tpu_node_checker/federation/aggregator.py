@@ -93,11 +93,22 @@ class FederationEngine:
     threads and reads one atomically-swapped tuple — never the live views.
     """
 
-    def __init__(self, args):
+    def __init__(self, args, obs=None):
         self.args = args
         self.path = args.federate
         self.interval = getattr(args, "federate_interval", None) or DEFAULT_INTERVAL_S
         self.workers = getattr(args, "federate_workers", None) or DEFAULT_WORKERS
+        # Observability (obs.Observability): per-round merge traces with
+        # per-cluster fetch spans, the federation fetch-duration histogram,
+        # and shard-transition events.  None (unit tests) still traces each
+        # round on a private tracer — nothing is recorded beyond it.
+        self._obs = obs
+        # Without an Observability, transitions still emit the same JSON
+        # event lines to stderr (pod logs stay the primary surface).
+        from tpu_node_checker.obs.events import EventLog
+
+        self._events = obs.events if obs is not None else EventLog()
+        self.last_tracer = None
         self.seq = 0
         self.views: Dict[str, ClusterView] = {}
         self._tokens: Dict[str, Optional[str]] = {}
@@ -171,47 +182,61 @@ class FederationEngine:
             self._sessions[slot] = session
         return session
 
-    def _fetch_cluster(self, session, view: ClusterView) -> None:
+    def _fetch_cluster(self, session, view: ClusterView,
+                       tracer=None) -> None:
+        if tracer is None:
+            # Driven outside a round (tests): the fetch still spans itself
+            # on a private tracer nothing records beyond.
+            from tpu_node_checker.obs.trace import Tracer
+
+            tracer = Tracer()
         base_headers = {}
         token = self._tokens.get(view.name)
         if token:
             base_headers["Authorization"] = f"Bearer {token}"
+        t0 = time.monotonic()
         try:
-            resp, etag = _fetch_entity(
-                session, view, base_headers, "/api/v1/summary",
-                view.summary_etag,
-            )
-            if resp is not None:
-                doc = resp.json()
-                if not isinstance(doc, dict):
-                    raise FetchError("/api/v1/summary: not a JSON object")
-                view.summary_doc = doc
-            # The ETag lands only AFTER the body validated: a mangled 200
-            # must not leave the view holding the NEW validator with the
-            # OLD data — the next round's 304 would launder stale state
-            # as fresh indefinitely.
-            view.summary_etag = etag
-            resp, etag = _fetch_entity(
-                session, view, base_headers, "/api/v1/nodes", view.nodes_etag
-            )
-            if resp is not None:
-                entries, head = extract_node_entries(resp.content)
-                view.nodes_entries = entries
-                # Merge-cache identity for these bytes.  An upstream behind
-                # a validator-stripping proxy sends no ETag — every round
-                # is a fresh 200, and without a content key the merge
-                # would keep serving its first-cached block forever.
-                view.nodes_fp = etag or (
-                    "sha256:" + hashlib.sha256(entries).hexdigest()
+            with tracer.span("fetch", cluster=view.name):
+                resp, etag = _fetch_entity(
+                    session, view, base_headers, "/api/v1/summary",
+                    view.summary_etag,
                 )
-                count = head.get("count")
-                view.nodes_count = count if isinstance(count, int) else 0
-                view.nodes_round = head.get("round")
-                reported = head.get("cluster")
-                view.reported_cluster = (
-                    reported if isinstance(reported, str) else None
+                if resp is not None:
+                    doc = resp.json()
+                    if not isinstance(doc, dict):
+                        raise FetchError("/api/v1/summary: not a JSON object")
+                    view.summary_doc = doc
+                # The ETag lands only AFTER the body validated: a mangled
+                # 200 must not leave the view holding the NEW validator
+                # with the OLD data — the next round's 304 would launder
+                # stale state as fresh indefinitely.
+                view.summary_etag = etag
+                resp, etag = _fetch_entity(
+                    session, view, base_headers, "/api/v1/nodes",
+                    view.nodes_etag,
                 )
-            view.nodes_etag = etag
+                if resp is not None:
+                    entries, head = extract_node_entries(resp.content)
+                    view.nodes_entries = entries
+                    # Merge-cache identity for these bytes.  An upstream
+                    # behind a validator-stripping proxy sends no ETag —
+                    # every round is a fresh 200, and without a content key
+                    # the merge would keep serving its first-cached block
+                    # forever.
+                    view.nodes_fp = etag or (
+                        "sha256:" + hashlib.sha256(entries).hexdigest()
+                    )
+                    count = head.get("count")
+                    view.nodes_count = count if isinstance(count, int) else 0
+                    view.nodes_round = head.get("round")
+                    reported = head.get("cluster")
+                    view.reported_cluster = (
+                        reported if isinstance(reported, str) else None
+                    )
+                    self._stitch_upstream_trace(
+                        session, view, base_headers, resp
+                    )
+                view.nodes_etag = etag
         except Exception as exc:  # tnc: allow-broad-except(any fetch failure — refused dial, timeout, bad body, HTTP error — is the ONE shard-degraded outcome; the shard is labeled stale and the fleet keeps serving)
             view.record_failure(f"{type(exc).__name__}: {exc}")
             view.fetch_errors += 1
@@ -220,10 +245,47 @@ class FederationEngine:
                     2 ** (view.consecutive_failures - BREAKER_THRESHOLD + 1),
                     BREAKER_MAX_EVERY,
                 ) - 1
+            if self._obs is not None:
+                self._obs.federation_fetch.record(
+                    (time.monotonic() - t0) * 1e3, view.name
+                )
             return
         view.record_success()
+        if self._obs is not None:
+            # Per-cluster fetch latency histogram — 304 rounds included;
+            # they ARE the steady state the p99 should describe.
+            self._obs.federation_fetch.record(
+                (time.monotonic() - t0) * 1e3, view.name
+            )
 
-    def _fetch_shard(self, slot: int, names: List[str]) -> None:
+    def _stitch_upstream_trace(self, session, view: ClusterView,
+                               base_headers: dict, resp) -> None:
+        """Two-tier tracing: the nodes response named its round's trace
+        (``X-TNC-Trace``); fetch that trace's Chrome-trace document from
+        the upstream's debug ring ONCE per new upstream round, so the
+        aggregator's own round trace can attach the upstream spans.
+        Best-effort by design — an upstream without a debug ring (older
+        build, ring already evicted) costs one 404 and stitches nothing.
+        """
+        upstream_trace = resp.headers.get("x-tnc-trace")
+        if not upstream_trace or upstream_trace == view.upstream_trace:
+            return
+        try:
+            doc_resp = session.get(
+                view.url + f"/api/v1/debug/rounds/{upstream_trace}",
+                headers=dict(base_headers), timeout=FETCH_TIMEOUT_S,
+            )
+            if doc_resp.status_code != 200:
+                return
+            doc = doc_resp.json()
+            events = doc.get("traceEvents") if isinstance(doc, dict) else None
+            if isinstance(events, list):
+                view.upstream_trace = upstream_trace
+                view.upstream_trace_events = events
+        except Exception:  # tnc: allow-broad-except(trace stitching is best-effort telemetry; a failed debug fetch must never degrade the shard that just fetched fine)
+            return
+
+    def _fetch_shard(self, slot: int, names: List[str], tracer) -> None:
         session = self._session(slot)
         for name in names:
             view = self.views.get(name)
@@ -235,7 +297,7 @@ class FederationEngine:
                 view.backoff_skip -= 1
                 view.rounds_behind += 1
                 continue
-            self._fetch_cluster(session, view)
+            self._fetch_cluster(session, view, tracer)
 
     # -- the round -------------------------------------------------------------
 
@@ -247,9 +309,37 @@ class FederationEngine:
         mark shards; only a bug in the merge itself would, and the mode
         loop reports it and keeps the last snapshot serving.
         """
-        from tpu_node_checker import checker
+        from tpu_node_checker.obs.trace import Tracer
 
         t0 = time.monotonic()
+        self.seq += 1
+        # One trace per merge round: per-cluster fetch spans (on the
+        # fetcher threads, args carry the cluster), then merge and publish
+        # on the round thread, then each upstream round's own spans
+        # stitched in as separate process tracks — ONE document that spans
+        # both tiers.
+        tracer = (
+            self._obs.tracer(self.seq, mode="federation")
+            if self._obs is not None
+            else Tracer(round_seq=self.seq, mode="federation")
+        )
+        self.last_tracer = tracer
+        try:
+            return self._round_inner(tracer, server, t0)
+        except Exception as exc:
+            # A failed merge round still completes its trace — labeled —
+            # so the debug ring shows WHAT blew up, not a missing round.
+            tracer.set_error(str(exc))
+            raise
+        finally:
+            if self._obs is not None:
+                self._obs.complete(tracer)
+            else:
+                tracer.finish()
+
+    def _round_inner(self, tracer, server, t0: float) -> GlobalSnapshot:
+        from tpu_node_checker import checker
+
         self._maybe_reload()
         # Captured BEFORE the fetches run — record_failure/record_success
         # move view.stale, and the transition log diffs against the state
@@ -272,7 +362,7 @@ class FederationEngine:
             )
             thread = threading.Thread(
                 target=self._fetch_shard,
-                args=(slot, shard),
+                args=(slot, shard, tracer),
                 name=f"tnc-federate-{slot}",
                 daemon=True,
             )
@@ -280,34 +370,48 @@ class FederationEngine:
             thread.start()
         for thread in threads:
             thread.join()
-        self.seq += 1
         views = list(self.views.values())
-        snap = build_global_snapshot(
-            views, self.seq, round(time.time(), 3), prev=self._prev
-        )
+        with tracer.span("merge", clusters=len(views)):
+            snap = build_global_snapshot(
+                views, self.seq, round(time.time(), 3), prev=self._prev,
+                trace_id=tracer.trace_id,
+            )
+        for view in views:
+            if view.upstream_trace_events is not None:
+                tracer.attach_subtrace(
+                    f"cluster:{view.name}",
+                    view.upstream_trace_events,
+                    trace_id=view.upstream_trace,
+                )
         self._prev = snap
-        self.last_round_ms = (time.monotonic() - t0) * 1e3
         self._ready = self._compute_readiness(views)
         if server is not None:
-            server.publish_global(
-                snap, metrics_body=self.render_metrics().encode("utf-8")
-            )
-        self._log_transitions(before_degraded)
+            with tracer.span("publish"):
+                server.publish_global(
+                    snap, metrics_body=self.render_metrics().encode("utf-8")
+                )
+        self.last_round_ms = (time.monotonic() - t0) * 1e3
+        self._log_transitions(before_degraded, tracer.trace_id)
         return snap
 
-    def _log_transitions(self, before_degraded: set) -> None:
+    def _log_transitions(self, before_degraded: set,
+                         trace_id: Optional[str] = None) -> None:
+        """Shard degraded/recovered transitions → the unified event log,
+        stamped with the merge round's trace_id (without an Observability
+        the EventLog still prints the same JSON line to stderr)."""
         after = {name for name, view in self.views.items() if view.stale}
+        events = self._events
         for name in sorted(after - before_degraded):
             view = self.views[name]
-            print(
-                f"federation: cluster {name!r} shard DEGRADED "
-                f"({view.last_error}) — last-known data keeps serving, "
-                "staleness labeled.",
-                file=sys.stderr,
+            events.emit(
+                "shard-degraded",
+                trace_id=trace_id,
+                shard=name,
+                error=view.last_error,
+                detail="last-known data keeps serving, staleness labeled",
             )
         for name in sorted(before_degraded - after):
-            print(f"federation: cluster {name!r} shard recovered.",
-                  file=sys.stderr)
+            events.emit("shard-recovered", trace_id=trace_id, shard=name)
 
     def _compute_readiness(self, views: List[ClusterView]) -> tuple:
         detail = {
@@ -449,13 +553,20 @@ def federate(args) -> int:
     checker.  Runs until SIGTERM (exit 143).
     """
     from tpu_node_checker import checker
+    from tpu_node_checker.obs import Observability
     from tpu_node_checker.server.app import FleetStateServer
 
-    engine = FederationEngine(args)
+    # One observability bundle for the whole tier: merge-round traces in
+    # the debug ring (/api/v1/debug/rounds — with each upstream cluster's
+    # round stitched in), fetch/phase histograms on /metrics, shard
+    # transition events through the unified log (--event-log).
+    obs = Observability.from_args(args)
+    engine = FederationEngine(args, obs=obs)
     server = FleetStateServer(
         args.serve,
         federation=True,
         readiness=engine.readiness,
+        obs=obs,
         **checker._serve_pool_kwargs(args),
     )
     requested_workers = getattr(args, "serve_workers", None) or 1
@@ -483,7 +594,13 @@ def federate(args) -> int:
             try:
                 engine.round(server)
             except Exception as exc:  # tnc: allow-broad-except(a merge bug must not kill the serving tier; the last global snapshot keeps serving and the next round retries)
+                # round() already labeled (set_error) and completed the
+                # failed round's trace before re-raising.
                 print(f"Federation round failed: {exc}", file=sys.stderr)
+            if getattr(args, "trace", None) and engine.last_tracer is not None:
+                # --trace in federate mode: the last merge round's two-tier
+                # Chrome-trace document, rewritten atomically per round.
+                checker._write_trace_file(args.trace, engine.last_tracer)
             if checker._wait_for_next_round(
                 stop,
                 max(0.0, engine.interval - (time.monotonic() - round_start)),

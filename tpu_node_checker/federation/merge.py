@@ -82,6 +82,7 @@ class ClusterView:
         "nodes_entries", "nodes_etag", "nodes_fp", "nodes_count",
         "nodes_round",
         "reported_cluster",
+        "upstream_trace", "upstream_trace_events",
         "consecutive_failures", "rounds_behind", "last_success_wall",
         "last_error", "backoff_skip",
         "fetch_fresh", "fetch_not_modified", "fetch_errors",
@@ -102,6 +103,12 @@ class ClusterView:
         self.nodes_count = 0
         self.nodes_round = None
         self.reported_cluster: Optional[str] = None
+        # Two-tier trace stitching: the upstream round's trace_id (from the
+        # X-TNC-Trace response header) and that trace's Chrome-trace events
+        # (fetched from the upstream's debug endpoint once per NEW upstream
+        # round — 304 rounds re-attach the cached events by reference).
+        self.upstream_trace: Optional[str] = None
+        self.upstream_trace_events: Optional[list] = None
         self.consecutive_failures = 0
         self.rounds_behind = 0
         self.last_success_wall: Optional[float] = None
@@ -204,11 +211,15 @@ class GlobalSnapshot:
     dict lookup, no locks (TNC011's scan set for this module).
     """
 
-    __slots__ = ("seq", "ts", "entities", "cluster_entities", "nodes_sig")
+    __slots__ = ("seq", "ts", "trace_id", "entities", "cluster_entities",
+                 "nodes_sig")
 
     def __init__(self, seq: int, ts: float):
         self.seq = seq
         self.ts = ts
+        # The merge round's trace (X-TNC-Trace on every global read; the
+        # /api/v1/debug/rounds join key).
+        self.trace_id: Optional[str] = None
         self.entities: Dict[str, Entity] = {}
         self.cluster_entities: Dict[str, Entity] = {}
         self.nodes_sig: tuple = ()
@@ -250,7 +261,8 @@ def build_cluster_entry(view: ClusterView, now_wall: float) -> dict:
     return entry
 
 
-def build_global_summary(views: List[ClusterView], seq: int, ts: float) -> dict:
+def build_global_summary(views: List[ClusterView], seq: int, ts: float,
+                         trace_id: Optional[str] = None) -> dict:
     """The global roll-up.  ``healthy`` is judged over FRESH clusters only;
     a degraded shard is LISTED (``degraded`` / ``degraded_clusters``) but
     can never sink the fleet verdict — the invariant federation inherits
@@ -269,6 +281,7 @@ def build_global_summary(views: List[ClusterView], seq: int, ts: float) -> dict:
         "round": seq,
         "ts": ts,
         "source": "federation",
+        **({"trace_id": trace_id} if trace_id else {}),
         "clusters": {
             "total": len(views),
             "with_data": len(with_data),
@@ -304,6 +317,7 @@ def build_global_snapshot(
     seq: int,
     ts: float,
     prev: Optional[GlobalSnapshot] = None,
+    trace_id: Optional[str] = None,
 ) -> GlobalSnapshot:
     """One merge round → the immutable global snapshot.
 
@@ -316,7 +330,8 @@ def build_global_snapshot(
     """
     views = sorted(views, key=lambda v: v.name)
     snap = GlobalSnapshot(seq, ts)
-    summary = build_global_summary(views, seq, ts)
+    snap.trace_id = trace_id
+    summary = build_global_summary(views, seq, ts, trace_id=trace_id)
     snap.entities["global/summary"] = json_entity(summary)
 
     now_wall = time.time()

@@ -88,7 +88,19 @@ Exported metric families:
   merged global view (stale shards' last-known numbers included);
 * ``tpu_node_checker_federation_round_duration_ms`` /
   ``tpu_node_checker_federation_workers`` — fetch+merge round wall-clock
-  and the consistent-hash fetcher pool size.
+  and the consistent-hash fetcher pool size;
+* ``tpu_node_checker_round_phase_duration_ms{phase}`` — NATIVE histogram
+  (``_bucket``/``_sum``/``_count``) of per-phase round cost;
+  ``phase="total"`` is the whole round, so
+  ``histogram_quantile(0.99, ...)`` is the production-side counterpart of
+  the bench's steady-round assertions;
+* ``tpu_node_checker_federation_fetch_duration_ms{cluster}`` — histogram
+  of per-cluster upstream fetch cost in the ``--federate`` aggregator;
+* ``tpu_node_checker_api_server_request_duration_ms{route}`` — histogram
+  of routed-path fleet-API request latency (replaces the
+  ``tpu_node_checker_api_server_request_latency_ms`` pseudo-summary,
+  which remains one release as a deprecated alias derived from the merged
+  histogram).
 
 This docstring is the package's metric index: tnc-lint's
 ``drift-readme-metrics`` rule (TNC202) fails CI when a family is emitted
@@ -686,10 +698,13 @@ class MetricsServer:
     round it has already seen.
     """
 
-    def __init__(self, port: int, host: str = "0.0.0.0"):
+    def __init__(self, port: int, host: str = "0.0.0.0", obs=None):
         self._body = b"# tpu-node-checker: no check completed yet\n"
         self._entity = Entity(self._body, METRICS_CONTENT_TYPE)
         self._lock = threading.Lock()
+        # Observability layer (obs.Observability): its histogram families
+        # (round phases) are appended to every per-round body rebuild.
+        self._obs = obs
 
         router = Router()
         router.add("GET", "/metrics", self._get_metrics)
@@ -733,8 +748,12 @@ class MetricsServer:
         self._breaker = state
 
     def update(self, result) -> None:
-        body = render_metrics(result, breaker=getattr(self, "_breaker", None)).encode()
-        self._set_body(body)
+        text = render_metrics(result, breaker=getattr(self, "_breaker", None))
+        if self._obs is not None:
+            lines = self._obs.prometheus_lines()
+            if lines:
+                text += "\n".join(lines) + "\n"
+        self._set_body(text.encode())
         self._last_result = result
 
     def mark_error(self, exit_code: int = 1) -> None:

@@ -83,11 +83,17 @@ def send_slack_message(
     timeout: float = DEFAULT_TIMEOUT_S,
     sleep: Callable[[float], None] = time.sleep,
     post: Optional[Callable] = None,
+    trace_id: Optional[str] = None,
 ) -> bool:
     """Deliver one message; returns True on HTTP 200.
 
     ``sleep`` and ``post`` are injectable so tests can drive the retry state
     machine without wall-clock delays or a live webhook.
+
+    ``trace_id`` (watch/one-shot rounds) stamps the round's trace onto the
+    message, so an alert joins straight to its timeline:
+    ``GET /api/v1/debug/rounds/{trace_id}`` on the fleet API, the
+    ``--trace`` file, or a ``trace_id`` grep over the ``--event-log``.
 
     ``requests`` is imported lazily: the happy path of a check with no
     webhook configured never pays its ~120 ms import cost (the <2 s budget
@@ -96,6 +102,8 @@ def send_slack_message(
     import requests
 
     post = post or requests.post
+    if trace_id:
+        message = f"{message}\n`trace: {trace_id}`"
     payload = {"text": message, "username": username, "icon_emoji": DEFAULT_ICON}
     attempts = max_retries + 1
     for attempt in range(1, attempts + 1):

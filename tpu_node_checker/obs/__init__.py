@@ -1,0 +1,148 @@
+"""Observability layer: hierarchical round traces, native latency
+histograms, and one structured event log — dependency-free, cheap enough
+to always be on.
+
+Three pieces, one bundle (:class:`Observability`):
+
+* :mod:`~tpu_node_checker.obs.trace` — :class:`~tpu_node_checker.obs.trace.Tracer`
+  generalizes the flat ``PhaseTimer`` to NESTED spans carrying a per-round
+  ``trace_id``/``round_seq``; completed round traces land in a lock-free
+  :class:`~tpu_node_checker.obs.trace.TraceRing` served at
+  ``GET /api/v1/debug/rounds[/{trace_id}]`` as Chrome-trace JSON
+  (loadable in Perfetto / ``chrome://tracing``);
+* :mod:`~tpu_node_checker.obs.hist` — fixed-bucket Prometheus
+  :class:`~tpu_node_checker.obs.hist.HistogramFamily`: the hot-path record
+  is one bisect + one list-index increment on a per-thread recorder, with
+  recorders merged only at scrape time (no locks on the serve read path —
+  TNC011's contract extends here);
+* :mod:`~tpu_node_checker.obs.events` — one JSONL
+  :class:`~tpu_node_checker.obs.events.EventLog` for everything that used
+  to be an ad-hoc stderr print: fleet-API write audits, federation shard
+  degraded/recovered transitions, watch-breaker open/close, FSM actionable
+  transitions — every line stamped with ``trace_id`` and ``cluster`` so an
+  alert joins to the round trace that produced it.
+
+The histogram families this layer owns:
+
+* ``tpu_node_checker_round_phase_duration_ms{phase}`` — per-phase round
+  cost (``phase="total"`` is the whole round: the production-side
+  counterpart of BENCH_r06/r09's steady-round assertions);
+* ``tpu_node_checker_federation_fetch_duration_ms{cluster}`` — per-cluster
+  upstream fetch cost in the aggregator tier.
+
+(The fleet API's ``tpu_node_checker_api_server_request_duration_ms{route}``
+family lives in ``server/app.ServerStats`` — always on, obs or not.)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from tpu_node_checker.obs.events import EventLog
+from tpu_node_checker.obs.hist import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    HistogramFamily,
+)
+from tpu_node_checker.obs.trace import Tracer, TraceRing
+
+# Completed round traces kept queryable; a debugging session needs the last
+# few minutes of rounds, not an archive (the --trace file is the archive).
+DEFAULT_RING_SIZE = 32
+
+
+class Observability:
+    """One process's observability state: trace ring, histograms, events.
+
+    Created once per mode entry (``--watch``, ``--federate``, standalone
+    ``--serve``) and threaded to the round driver and the serving layer —
+    never a module global, so tests and embedded uses get isolated state.
+    """
+
+    def __init__(
+        self,
+        cluster: Optional[str] = None,
+        event_log: Optional[str] = None,
+        ring_size: int = DEFAULT_RING_SIZE,
+    ):
+        self.cluster = cluster
+        self.ring = TraceRing(ring_size)
+        self.events = EventLog(event_log, cluster=cluster)
+        self.round_phases = HistogramFamily(
+            "tpu_node_checker_round_phase_duration_ms",
+            "Round phase cost distribution (phase='total' = the whole "
+            "round) — histogram_quantile-able tail latency per phase.",
+            DEFAULT_LATENCY_BUCKETS_MS,
+            label="phase",
+        )
+        self.federation_fetch = HistogramFamily(
+            "tpu_node_checker_federation_fetch_duration_ms",
+            "Per-cluster upstream fleet-API fetch cost in the federation "
+            "aggregator (304 rounds included — they are the steady state).",
+            DEFAULT_LATENCY_BUCKETS_MS,
+            label="cluster",
+        )
+        self._families = [self.round_phases, self.federation_fetch]
+        # phase name -> dedicated Histogram recorder.  complete() runs on
+        # the ONE round-driver thread, so it can skip record()'s
+        # thread-local hop entirely — the steady watch round is ~15µs all
+        # in, and the BENCH_r09 gate caps the whole tracing tax at 15%.
+        self._phase_recorders: dict = {}
+
+    @classmethod
+    def from_args(cls, args) -> "Observability":
+        """The CLI seam.  The cluster stamp follows the metrics-label
+        policy: only EXPLICIT identity (``--cluster-name`` / env) rides on
+        event lines — an inferred hostname would churn per pod restart."""
+        cluster = (
+            getattr(args, "cluster_name", None)
+            or os.environ.get("TNC_CLUSTER_NAME")
+            or None
+        )
+        return cls(
+            cluster=cluster, event_log=getattr(args, "event_log", None)
+        )
+
+    def tracer(self, round_seq: Optional[int] = None,
+               mode: str = "round") -> Tracer:
+        return Tracer(round_seq=round_seq, mode=mode)
+
+    def complete(self, tracer: Tracer) -> Tracer:
+        """Finish one round's trace: freeze the clock, feed every phase
+        total (plus the round total) into the phase histogram, and push
+        the trace into the debug ring.  Called from the round driver's
+        thread — readers of the ring only ever see finished traces."""
+        total_ms = tracer.finish()
+        recorders = self._phase_recorders
+        for name, ms in tracer.phases.items():
+            recorder = recorders.get(name)
+            if recorder is None:
+                recorder = recorders[name] = self.round_phases.recorder(name)
+            recorder.record(ms)
+        recorder = recorders.get("total")
+        if recorder is None:
+            recorder = recorders["total"] = self.round_phases.recorder("total")
+        recorder.record(total_ms)
+        self.ring.push(tracer)
+        return tracer
+
+    def prometheus_lines(self) -> List[str]:
+        """Scrape-time render of every family with data.  Merging reads
+        the recorder lists without locks (TNC011: this runs on the serve
+        read path)."""
+        lines: List[str] = []
+        for family in self._families:
+            if family.count:
+                lines.extend(family.prometheus_lines())
+        return lines
+
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS_MS",
+    "DEFAULT_RING_SIZE",
+    "EventLog",
+    "HistogramFamily",
+    "Observability",
+    "TraceRing",
+    "Tracer",
+]

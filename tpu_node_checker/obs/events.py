@@ -1,0 +1,94 @@
+"""The unified structured event log: one JSONL writer for everything
+operationally interesting that is not a metric sample.
+
+Before this module each subsystem printed its own ad-hoc stderr line: the
+fleet-API write audit, federation shard degraded/recovered transitions,
+watch-breaker open/close, FSM actionable transitions.  Grep-ability
+suffered (four shapes) and none carried the round identity.  Now every
+event is ONE JSON line::
+
+    {"event": "fleet-api-write", "ts": 1754206000.123,
+     "cluster": "us-central2-a", "trace_id": "9f2c01ab00000007", ...}
+
+* ``cluster`` rides on every line when the checker has an EXPLICIT
+  identity (``--cluster-name`` / ``$TNC_CLUSTER_NAME`` — same policy as
+  the metrics label);
+* ``trace_id`` joins the event to the round trace that produced it
+  (``GET /api/v1/debug/rounds/{trace_id}``, or the ``--trace`` file);
+* lines go to stderr always (pod logs stay the primary surface) and,
+  under ``--event-log FILE``, are appended to a JSONL file read back by
+  the same torn-line-tolerant loader ``--trend`` uses — a crash mid-write
+  costs one line, never the file.
+
+Writes are never fatal: a full disk degrades the event log to
+stderr-only, it does not take the round down (the history store's rule).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import List, Optional, Tuple
+
+
+class EventLog:
+    """Thread-safe JSONL event writer; see the module docstring.
+
+    ``stream=None`` resolves ``sys.stderr`` per emit so pytest's capture
+    (and stream redirection generally) is honored.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 cluster: Optional[str] = None, stream=None):
+        self.path = path
+        self.cluster = cluster
+        self._stream = stream
+        self._lock = threading.Lock()
+        self._write_failed = False
+
+    def emit(self, event: str, trace_id: Optional[str] = None,
+             **fields) -> dict:
+        """One event → one JSON line (returned for callers that embed it).
+
+        ``None``-valued fields are dropped so absent context (no trace on
+        a standalone server, say) never serializes as ``null`` noise.
+        """
+        entry = {"event": event, "ts": round(time.time(), 3)}
+        if self.cluster:
+            entry["cluster"] = self.cluster
+        if trace_id:
+            entry["trace_id"] = trace_id
+        for key, value in fields.items():
+            if value is not None:
+                entry[key] = value
+        line = json.dumps(entry, ensure_ascii=False)
+        print(line, file=self._stream or sys.stderr)
+        if self.path:
+            try:
+                # Append-per-emit (events are rare): survives rotation,
+                # keeps lines whole under the OS's O_APPEND atomicity for
+                # small writes; the lock serializes emitting threads.
+                with self._lock:
+                    with open(self.path, "a", encoding="utf-8") as f:
+                        f.write(line + "\n")
+                self._write_failed = False
+            except OSError as exc:
+                if not self._write_failed:  # one note per outage, not per event
+                    print(
+                        f"event log {self.path} unwritable ({exc}) — "
+                        "events continue on stderr only.",
+                        file=sys.stderr,
+                    )
+                self._write_failed = True
+        return entry
+
+    @staticmethod
+    def load(path: str) -> Tuple[List[dict], int]:
+        """Read an event-log file back: ``(events, skipped_lines)`` via the
+        SAME torn-line-tolerant loader the ``--trend`` log uses — one
+        parser for every JSONL surface in the tree."""
+        from tpu_node_checker.history.store import read_jsonl_tolerant
+
+        return read_jsonl_tolerant(path)

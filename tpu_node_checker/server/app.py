@@ -14,7 +14,14 @@ another process writes).  Read surface:
 * ``GET /readyz``           — 200 only once a round has completed AND the
   watch circuit breaker is not open — "the data is fresh enough to act on";
 * ``GET /metrics``          — the last round's Prometheus families plus this
-  server's own ``tpu_node_checker_api_server_*`` request telemetry.
+  server's own ``tpu_node_checker_api_server_*`` request telemetry;
+* ``GET /api/v1/debug/rounds`` / ``.../rounds/{trace_id}`` — the last N
+  completed round traces (summaries, then one Chrome-trace JSON document
+  per trace, loadable in Perfetto) when an observability layer is wired
+  (:mod:`~tpu_node_checker.obs`); every snapshot read answers with
+  ``X-TNC-Round`` / ``X-TNC-Trace`` headers naming the served round and
+  its trace — the join key a federation aggregator stitches two-tier
+  traces with.
 
 Federation surface (``tnc --federate``, see
 :mod:`~tpu_node_checker.federation`): ``GET /api/v1/global/{summary,
@@ -44,8 +51,14 @@ import json
 import sys
 import threading
 import time
+from contextlib import nullcontext as _nullcontext
 from typing import Callable, Dict, Optional, Tuple
 
+from tpu_node_checker.obs.events import EventLog
+from tpu_node_checker.obs.hist import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    HistogramFamily,
+)
 from tpu_node_checker.server.auth import check_write_auth
 from tpu_node_checker.server.ratelimit import retry_after_header
 from tpu_node_checker.server.router import (
@@ -86,6 +99,9 @@ _FAST_PATHS = ("summary", "nodes", "slices")
 # → fast-table paths); per-cluster detail rides the routed fallback.
 _GLOBAL_FAST_PATHS = ("global/summary", "global/clusters", "global/nodes")
 
+# Reusable no-op context for publish paths running without a tracer.
+_NULL_SPAN = _nullcontext()
+
 
 class ServerStats:
     """Thread-safe request telemetry → ``tpu_node_checker_api_server_*``.
@@ -97,7 +113,18 @@ class ServerStats:
     def __init__(self):
         self._lock = threading.Lock()
         self.requests: Dict[Tuple[str, str, int], int] = {}
-        self.latency: Dict[str, list] = {}  # route -> [sum_ms, count]
+        # Native latency histogram, per route pattern: records are
+        # lock-free per-thread index increments, merged only at scrape
+        # time — histogram_quantile can finally answer "what is the p99"
+        # (the old hand-built _sum/_count summary could not).
+        self.durations = HistogramFamily(
+            "tpu_node_checker_api_server_request_duration_ms",
+            "Routed-path request latency by route pattern (fast-path "
+            "requests are answered from prebuilt bytes inside a batch "
+            "and carry no per-request sample).",
+            DEFAULT_LATENCY_BUCKETS_MS,
+            label="route",
+        )
         self.in_flight = 0
         self.auth_failures = 0
         self.rate_limited = 0
@@ -110,9 +137,9 @@ class ServerStats:
         with self._lock:
             key = (method, route, status)
             self.requests[key] = self.requests.get(key, 0) + 1
-            bucket = self.latency.setdefault(route, [0.0, 0])
-            bucket[0] += elapsed_ms
-            bucket[1] += 1
+        # Outside the lock: the histogram's own record path is per-thread
+        # and lock-free by design.
+        self.durations.record(elapsed_ms, route)
 
     def merge_fast(self, counts: Dict[Tuple[str, int], int]) -> None:
         """Batched fast-path GET counts (one lock round per flush, not per
@@ -139,7 +166,6 @@ class ServerStats:
 
         with self._lock:
             requests = dict(self.requests)
-            latency = {k: list(v) for k, v in self.latency.items()}
             in_flight = self.in_flight
             auth_failures = self.auth_failures
             rate_limited = self.rate_limited
@@ -156,12 +182,20 @@ class ServerStats:
                     {"method": method, "route": route, "status": str(status)},
                 )
             )
+        # The native histogram (merged across every recording thread at
+        # scrape time), then ONE release of the deprecated pseudo-summary
+        # it replaces: the old family's _sum/_count are now DERIVED from
+        # the merged histogram, so the two can never disagree while the
+        # alias lives.
+        merged = self.durations.merged()
+        lines += self.durations.prometheus_lines(merged)
         lines += [
-            "# HELP tpu_node_checker_api_server_request_latency_ms Summed "
-            "request latency per route (pair with _count for the mean).",
+            "# HELP tpu_node_checker_api_server_request_latency_ms "
+            "DEPRECATED alias of ..._request_duration_ms (_sum/_count "
+            "derived from the merged histogram); removed next release.",
             "# TYPE tpu_node_checker_api_server_request_latency_ms summary",
         ]
-        for route, (total_ms, count) in sorted(latency.items()):
+        for route, (_counts, total_ms, count) in sorted(merged.items()):
             lines.append(
                 _line(
                     "tpu_node_checker_api_server_request_latency_ms_sum",
@@ -228,8 +262,17 @@ class FleetStateServer:
         write_limiter=None,
         federation: bool = False,
         readiness: Optional[Callable] = None,
+        obs=None,
     ):
         self._snap: Optional[FleetSnapshot] = None
+        # The observability layer (obs.Observability): owns the debug ring
+        # the /api/v1/debug/rounds endpoints serve and the histogram
+        # families appended to every /metrics scrape.  None = no tracing
+        # surface (the debug endpoints answer 404 naming the reason).
+        self._obs = obs
+        # Every write-path decision goes through the unified event log —
+        # a server wired without an Observability still audits (to stderr).
+        self._events = obs.events if obs is not None else EventLog()
         # Federation mode (--federate): the merged global view swaps in
         # through publish_global; the per-cluster round surface answers a
         # redirecting 404 instead of a forever-503.  ``readiness`` is the
@@ -273,6 +316,9 @@ class FleetStateServer:
         router.add("GET", "/api/v1/slices", self._get_collection("slices"))
         router.add("GET", "/api/v1/nodes/{name}", self._get_node)
         router.add("GET", "/api/v1/trend", self._get_trend)
+        router.add("GET", "/api/v1/debug/rounds", self._get_debug_rounds)
+        router.add("GET", "/api/v1/debug/rounds/{trace_id}",
+                   self._get_debug_round)
         router.add("POST", "/api/v1/nodes/{name}/cordon", self._post_control)
         router.add("POST", "/api/v1/nodes/{name}/uncordon", self._post_control)
         # The federation surface (registered unconditionally so a plain
@@ -331,8 +377,20 @@ class FleetStateServer:
 
     # -- publication (the check loop's side) ---------------------------------
 
+    @staticmethod
+    def _identity_headers(seq: int, trace_id: Optional[str]) -> Dict[str, str]:
+        """The round/trace identity every snapshot read carries — baked
+        into fast-path wire bytes at publish time, added to routed
+        responses per request.  The federation fetch tier reads these to
+        stitch one global trace across both tiers."""
+        headers = {"X-TNC-Round": str(seq)}
+        if trace_id:
+            headers["X-TNC-Trace"] = trace_id
+        return headers
+
     def publish(
-        self, result, breaker: Optional[dict] = None, changed=None
+        self, result, breaker: Optional[dict] = None, changed=None,
+        tracer=None,
     ) -> FleetSnapshot:
         """One completed round → one immutable snapshot, atomically swapped.
 
@@ -356,10 +414,16 @@ class FleetStateServer:
             and prev is not None
             and prev.source == "round"
         ):
-            snap = build_snapshot_delta(
-                prev, result.payload, result.exit_code, self._seq,
-                round(time.time(), 3), changed,
+            span = (
+                tracer.span("delta-build", changed=len(changed))
+                if tracer is not None
+                else _NULL_SPAN
             )
+            with span:
+                snap = build_snapshot_delta(
+                    prev, result.payload, result.exit_code, self._seq,
+                    round(time.time(), 3), changed,
+                )
         else:
             snap = build_snapshot(
                 result.payload, result.exit_code, self._seq, round(time.time(), 3)
@@ -367,7 +431,8 @@ class FleetStateServer:
         metrics = self._render_fleet_metrics(result, breaker)
         fast = (
             build_fast_routes(
-                {f"/api/v1/{key}": snap.entities[key] for key in _FAST_PATHS}
+                {f"/api/v1/{key}": snap.entities[key] for key in _FAST_PATHS},
+                extra_headers=self._identity_headers(snap.seq, snap.trace_id),
             )
             if self._pre_serialized and self._refresh is None
             else {}
@@ -400,7 +465,10 @@ class FleetStateServer:
         fast = (
             build_fast_routes(
                 {f"/api/v1/{key}": gsnap.entities[key]
-                 for key in _GLOBAL_FAST_PATHS if key in gsnap.entities}
+                 for key in _GLOBAL_FAST_PATHS if key in gsnap.entities},
+                extra_headers=self._identity_headers(
+                    gsnap.seq, getattr(gsnap, "trace_id", None)
+                ),
             )
             if self._pre_serialized
             else {}
@@ -488,6 +556,15 @@ class FleetStateServer:
                       "{summary,clusters,nodes} here"},
         )
 
+    @staticmethod
+    def _stamp_round(resp: Response, seq, trace_id) -> Response:
+        """Round/trace identity headers on a routed snapshot read (the
+        fast path bakes the same pair in at publish time)."""
+        resp.headers["X-TNC-Round"] = str(seq)
+        if trace_id:
+            resp.headers["X-TNC-Trace"] = trace_id
+        return resp
+
     def _get_global(self, key: str):
         def handler(req: Request) -> Response:
             gsnap = self._global
@@ -498,7 +575,10 @@ class FleetStateServer:
                     503, {"error": "no federation round completed yet",
                           "ready": False},
                 )
-            return negotiate(gsnap.entity(key), req.headers)
+            return self._stamp_round(
+                negotiate(gsnap.entity(key), req.headers),
+                gsnap.seq, getattr(gsnap, "trace_id", None),
+            )
 
         return handler
 
@@ -519,7 +599,10 @@ class FleetStateServer:
                           f"endpoints file (round {gsnap.seq})",
                  "round": gsnap.seq},
             )
-        return negotiate(entity, req.headers)
+        return self._stamp_round(
+            negotiate(entity, req.headers),
+            gsnap.seq, getattr(gsnap, "trace_id", None),
+        )
 
     def _get_collection(self, key: str):
         def handler(req: Request) -> Response:
@@ -539,7 +622,10 @@ class FleetStateServer:
                     200, raw,
                     {"Content-Type": "application/json; charset=utf-8"},
                 )
-            return negotiate(snap.entities[key], req.headers)
+            return self._stamp_round(
+                negotiate(snap.entities[key], req.headers),
+                snap.seq, snap.trace_id,
+            )
 
         return handler
 
@@ -558,7 +644,9 @@ class FleetStateServer:
                     "round": snap.seq,
                 },
             )
-        return negotiate(entity, req.headers)
+        return self._stamp_round(
+            negotiate(entity, req.headers), snap.seq, snap.trace_id
+        )
 
     def _get_trend(self, req: Request) -> Response:
         if self._trend is None:
@@ -572,6 +660,42 @@ class FleetStateServer:
 
     def _get_healthz(self, req: Request) -> Response:
         return json_response(200, {"ok": True})
+
+    # -- debug: round traces (lock-free reads over finished tracers) ----------
+
+    def _get_debug_rounds(self, req: Request) -> Response:
+        obs = self._obs
+        if obs is None:
+            return json_response(
+                404,
+                {"error": "tracing not enabled: this server was started "
+                          "without an observability layer"},
+            )
+        rounds = [t.summary() for t in obs.ring.entries()]
+        return json_response(
+            200, {"count": len(rounds), "ring_size": obs.ring.size,
+                  "rounds": rounds},
+        )
+
+    def _get_debug_round(self, req: Request) -> Response:
+        obs = self._obs
+        if obs is None:
+            return json_response(
+                404,
+                {"error": "tracing not enabled: this server was started "
+                          "without an observability layer"},
+            )
+        tracer = obs.ring.find(req.params["trace_id"])
+        if tracer is None:
+            return json_response(
+                404,
+                {"error": f"trace {req.params['trace_id']!r} is not among "
+                          f"the last {obs.ring.size} completed rounds"},
+            )
+        return Response(
+            200, tracer.chrome_trace_bytes(),
+            {"Content-Type": "application/json; charset=utf-8"},
+        )
 
     def _get_readyz(self, req: Request) -> Response:
         if self._readiness is not None:
@@ -624,6 +748,10 @@ class FleetStateServer:
                 float(self._trend.stale_served if self._trend else 0),
             ),
         ]
+        if self._obs is not None:
+            # Round-phase / federation-fetch histograms: merged across
+            # their per-thread recorders at scrape time, lock-free.
+            lines += self._obs.prometheus_lines()
         stats_block = ("\n".join(lines) + "\n").encode("utf-8")
         headers = {"Content-Type": METRICS_CONTENT_TYPE, "Vary": "Accept-Encoding"}
         if "gzip" in (req.headers.get("Accept-Encoding") or "").lower():
@@ -720,23 +848,24 @@ class FleetStateServer:
 
     # -- audit + events -------------------------------------------------------
 
-    @staticmethod
-    def _audit(name, action, status, applied, reason, remote, dry_run=False):
-        """One JSON line per write-path decision — grantable or refused —
-        so "who cordoned what, when, and why" is grep-able from pod logs."""
-        entry = {
-            "audit": "fleet-api-write",
-            "ts": round(time.time(), 3),
-            "action": action,
-            "node": name,
-            "status": status,
-            "applied": applied,
-            "dry_run": dry_run,
-            "remote": remote,
-        }
-        if reason:
-            entry["reason"] = reason
-        print(json.dumps(entry, ensure_ascii=False), file=sys.stderr)
+    def _audit(self, name, action, status, applied, reason, remote,
+               dry_run=False):
+        """One event-log line per write-path decision — grantable or
+        refused — so "who cordoned what, when, and why" is grep-able from
+        pod logs AND joinable (via ``trace_id``) to the round trace whose
+        evidence gated the decision."""
+        snap = self._snap
+        self._events.emit(
+            "fleet-api-write",
+            trace_id=snap.trace_id if snap is not None else None,
+            action=action,
+            node=name,
+            status=status,
+            applied=applied,
+            dry_run=dry_run,
+            remote=remote,
+            reason=reason or None,
+        )
 
     def _auth_event(self, detail: str) -> None:
         if self.on_event is None:

@@ -79,7 +79,7 @@ class FleetSnapshot:
     byte-joins the rest.
     """
 
-    __slots__ = ("seq", "ts", "exit_code", "source", "entities",
+    __slots__ = ("seq", "ts", "exit_code", "source", "trace_id", "entities",
                  "node_entities", "node_docs", "docs", "node_fragments",
                  "node_gz_fragments")
 
@@ -88,6 +88,10 @@ class FleetSnapshot:
         self.ts = ts
         self.exit_code = exit_code
         self.source = source
+        # The round trace that built this snapshot (payload-stamped): rides
+        # every read response as X-TNC-Trace, the join key the federation
+        # tier stitches global traces with.  None for store snapshots.
+        self.trace_id: Optional[str] = None
         self.entities: Dict[str, Entity] = {}
         self.node_entities: Dict[str, Entity] = {}
         self.node_docs: Dict[str, dict] = {}
@@ -176,8 +180,9 @@ def build_summary_doc(payload: dict, exit_code: int, seq: int, ts: float) -> dic
         },
         "degraded": bool(payload.get("degraded")),
     }
-    for key in ("cluster", "probe_summary", "history", "expected_chips",
-                "expected_chips_met", "api_transport", "watch_stream"):
+    for key in ("cluster", "trace_id", "probe_summary", "history",
+                "expected_chips", "expected_chips_met", "api_transport",
+                "watch_stream"):
         if payload.get(key) is not None:
             summary[key] = payload[key]
     return summary
@@ -216,6 +221,7 @@ def build_snapshot(
     API must never re-derive (and drift from) what the round computed.
     """
     snap = FleetSnapshot(seq, ts, exit_code, "round")
+    snap.trace_id = payload.get("trace_id")
     nodes = payload.get("nodes") or []
     summary = build_summary_doc(payload, exit_code, seq, ts)
     head = collection_head(payload, seq, ts, len(nodes))
@@ -269,6 +275,7 @@ def build_snapshot_delta(
     stale entry.
     """
     snap = FleetSnapshot(seq, ts, exit_code, "round")
+    snap.trace_id = payload.get("trace_id")
     nodes = payload.get("nodes") or []
     summary = build_summary_doc(payload, exit_code, seq, ts)
     head = collection_head(payload, seq, ts, len(nodes))

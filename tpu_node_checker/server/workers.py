@@ -95,7 +95,8 @@ class FastRoute:
     __slots__ = ("pattern", "etag", "etag_str", "resp_304", "head_200",
                  "body", "head_gz", "body_gz")
 
-    def __init__(self, pattern: str, entity):
+    def __init__(self, pattern: str, entity,
+                 extra_headers: Optional[Dict[str, str]] = None):
         self.pattern = pattern
         self.etag_str = entity.etag
         self.etag = entity.etag.encode("latin-1")
@@ -105,6 +106,11 @@ class FastRoute:
             "Cache-Control: no-cache\r\n"
             f"Content-Type: {entity.content_type}\r\n"
         )
+        # Publish-time constants (the round/trace identity headers): baked
+        # into every variant, 304 included, so the fast path matches the
+        # routed path's headers byte-for-semantics.
+        for key, value in (extra_headers or {}).items():
+            base += f"{key}: {value}\r\n"
         self.resp_304 = (
             "HTTP/1.1 304 Not Modified\r\n" + base + "Content-Length: 0\r\n\r\n"
         ).encode("latin-1")
@@ -125,17 +131,21 @@ class FastRoute:
             self.body_gz = None
 
 
-def build_fast_routes(entities: Dict[str, object]) -> Dict[bytes, FastRoute]:
+def build_fast_routes(
+    entities: Dict[str, object],
+    extra_headers: Optional[Dict[str, str]] = None,
+) -> Dict[bytes, FastRoute]:
     """``{path: Entity}`` → the request-line-keyed fast table.
 
     Only plain HTTP/1.1 GETs with no query string can match (the key is the
     exact request line); every other shape falls through to the routed
-    stack, so the fast table can stay this simple.
+    stack, so the fast table can stay this simple.  ``extra_headers``
+    (round/trace identity) are baked into every prebuilt response.
     """
     table: Dict[bytes, FastRoute] = {}
     for path, entity in entities.items():
         table[b"GET " + path.encode("latin-1") + b" HTTP/1.1"] = FastRoute(
-            path, entity
+            path, entity, extra_headers
         )
     return table
 

@@ -1,6 +1,6 @@
 """Shared utilities: k8s quantity parsing, timing, environment helpers."""
 
 from tpu_node_checker.utils.quantity import parse_quantity
-from tpu_node_checker.utils.timing import Phase, PhaseTimer
+from tpu_node_checker.utils.timing import PhaseTimer, Tracer
 
-__all__ = ["parse_quantity", "Phase", "PhaseTimer"]
+__all__ = ["parse_quantity", "PhaseTimer", "Tracer"]

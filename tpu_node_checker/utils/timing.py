@@ -1,84 +1,31 @@
 """Phase timing for the <2 s latency budget.
 
-The reference has no timing at all (SURVEY §5.1); the build target demands the
-checker exit in <2 s on a v5e-256 slice, so the orchestrator times its phases
-(k8s LIST, detection, probe, notify, render) and surfaces them under
+The reference has no timing at all (SURVEY §5.1); the build target demands
+the checker exit in <2 s on a v5e-256 slice, so the orchestrator times its
+phases (k8s LIST, detection, probe, notify, render) and surfaces them under
 ``--debug``, in the ``--json`` payload's ``timings_ms`` field, and — via
 ``--trace FILE`` — as a Chrome-trace-format timeline loadable in Perfetto /
 ``chrome://tracing``.
+
+The flat per-phase timer this module originally defined grew into
+:class:`tpu_node_checker.obs.trace.Tracer` — nested spans, per-round
+``trace_id``/``round_seq``, multi-thread recording, sub-trace stitching —
+with the original ``phase()`` / ``as_dict()`` / ``chrome_trace()`` surface
+intact.  ``PhaseTimer`` remains as the compatibility name so existing
+callers (and their tests) keep working verbatim.
 """
 
 from __future__ import annotations
 
-import time
-from contextlib import contextmanager
-from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Tuple
+from tpu_node_checker.obs.trace import Tracer
 
 
-@dataclass
-class Phase:
-    name: str
-    elapsed_ms: float
+class PhaseTimer(Tracer):
+    """Collects named phase durations; cheap enough to always be on.
+
+    A plain alias of :class:`~tpu_node_checker.obs.trace.Tracer` — every
+    PhaseTimer now mints a ``trace_id`` and supports nested spans for free.
+    """
 
 
-@dataclass
-class PhaseTimer:
-    """Collects named phase durations; cheap enough to always be on."""
-
-    phases: Dict[str, float] = field(default_factory=dict)
-    # (name, start_offset_ms, dur_ms) in execution order — the trace surface.
-    spans: List[Tuple[str, float, float]] = field(default_factory=list)
-    _start: float = field(default_factory=time.perf_counter)
-
-    @contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            t1 = time.perf_counter()
-            self.phases[name] = self.phases.get(name, 0.0) + (t1 - t0) * 1e3
-            self.spans.append((name, (t0 - self._start) * 1e3, (t1 - t0) * 1e3))
-
-    def total_ms(self) -> float:
-        return (time.perf_counter() - self._start) * 1e3
-
-    def as_dict(self) -> Dict[str, float]:
-        out = {k: round(v, 2) for k, v in self.phases.items()}
-        out["total"] = round(self.total_ms(), 2)
-        return out
-
-    def chrome_trace(self, process_name: str = "tpu-node-checker") -> dict:
-        """Trace-event-format document (one complete 'X' event per span)."""
-        events = [
-            {
-                "name": "process_name",
-                "ph": "M",
-                "pid": 1,
-                "tid": 1,
-                "args": {"name": process_name},
-            }
-        ]
-        for name, start_ms, dur_ms in self.spans:
-            events.append(
-                {
-                    "name": name,
-                    "ph": "X",
-                    "pid": 1,
-                    "tid": 1,
-                    "ts": round(start_ms * 1e3, 1),  # microseconds
-                    "dur": round(dur_ms * 1e3, 1),
-                }
-            )
-        events.append(
-            {
-                "name": "total",
-                "ph": "X",
-                "pid": 1,
-                "tid": 1,
-                "ts": 0.0,
-                "dur": round(self.total_ms() * 1e3, 1),
-            }
-        )
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+__all__ = ["PhaseTimer", "Tracer"]

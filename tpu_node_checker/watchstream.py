@@ -43,6 +43,14 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 EVENT_TYPES = ("ADDED", "MODIFIED", "DELETED", "BOOKMARK", "ERROR")
 
 
+def _private_timer():
+    """A tick driven without the watch loop's tracer (tests, bench) still
+    times itself — same Tracer, just not on anyone's debug ring."""
+    from tpu_node_checker.obs.trace import Tracer
+
+    return Tracer()
+
+
 def grading_view(node: dict) -> tuple:
     """The grading-relevant projection of one raw node object.
 
@@ -393,7 +401,7 @@ class StreamRoundEngine:
 
     # -- the round -----------------------------------------------------------
 
-    def tick(self):
+    def tick(self, tracer=None):
         """One watch-stream round → ``(CheckResult, changed_names)``.
 
         ``changed_names`` is the frozenset the snapshot delta-patcher
@@ -401,14 +409,18 @@ class StreamRoundEngine:
         skip publishing entirely.  Raises (exactly like ``run_check``)
         when the stream is down and the relist fails — the watch loop's
         breaker/backoff path handles it.
-        """
-        from tpu_node_checker import checker
-        from tpu_node_checker.utils.timing import PhaseTimer
 
-        timer = PhaseTimer()
+        ``tracer`` (the watch loop's per-round trace) turns the tick's
+        phases into spans on the round trace — ``fold`` (drain the event
+        cache), ``grade`` (re-extract changed nodes, with ``detect`` /
+        ``fsm`` / ``render`` children) — alongside the caller's
+        ``publish`` / ``delta-build`` spans; without one a private tracer
+        keeps ``timings_ms`` working identically.
+        """
+        timer = tracer if tracer is not None else _private_timer()
         if not self.stream_alive():
             self._connect(timer)
-        with timer.phase("drain"):
+        with timer.span("fold"):
             changed_raw, removed = self.cache.drain()
         if not changed_raw and not removed and self._last_result is not None:
             return self._steady_result(timer), frozenset()
@@ -419,11 +431,21 @@ class StreamRoundEngine:
 
     def _grade(self, changed_raw, removed, timer) -> FrozenSet[str]:
         """Re-extract ONLY the changed nodes; returns the set of payload
-        node names whose entries must be re-encoded downstream."""
+        node names whose entries must be re-encoded downstream.  The whole
+        pass is one ``grade`` span with ``detect``/``fsm``/``render``
+        children — the hierarchy a slow churn round is debugged by."""
         from tpu_node_checker import checker
         from tpu_node_checker.detect import extract_node_info
         from tpu_node_checker.report import _node_entry
 
+        with timer.span("grade", changed=len(changed_raw), removed=len(removed)):
+            return self._grade_inner(
+                changed_raw, removed, timer, extract_node_info, _node_entry,
+                checker,
+            )
+
+    def _grade_inner(self, changed_raw, removed, timer, extract_node_info,
+                     _node_entry, checker) -> FrozenSet[str]:
         changed_names: Set[str] = set()
         with timer.phase("detect"):
             for name in removed:
@@ -444,7 +466,7 @@ class StreamRoundEngine:
             self._accel_names = sorted(self._infos)
         history = checker._build_history(self.args)
         if history is not None:
-            with timer.phase("history"):
+            with timer.span("fsm"):
                 evidence = [
                     self._infos[n]
                     for n in self._accel_names
@@ -515,6 +537,7 @@ class StreamRoundEngine:
                     payload["api_transport"] = stats
             checker.stamp_cluster_identity(payload, self.args, self._client)
             payload["watch_stream"] = self.stats.as_dict()
+            payload["trace_id"] = timer.trace_id
             payload["exit_code"] = exit_code
         payload["timings_ms"] = timer.as_dict()
         result = checker.CheckResult(
@@ -542,6 +565,9 @@ class StreamRoundEngine:
         if payload.get("history") is not None:
             payload["history"] = {**payload["history"], "transitions": []}
         payload["watch_stream"] = self.stats.as_dict()
+        # The steady tick is its own round: fresh trace identity, fresh
+        # timings — only the heavy sub-objects are shared by reference.
+        payload["trace_id"] = timer.trace_id
         payload["timings_ms"] = timer.as_dict()
         return checker.CheckResult(
             exit_code=last.exit_code,
