@@ -1,0 +1,21 @@
+"""TNC018 corpus: full-body decodes on the LIST hot path vs off it."""
+
+import json
+
+
+def _paged_list(session, url, params):
+    resp = session.get(url, params=params)
+    doc = resp.json()  # EXPECT[TNC018]
+    return doc.get("items") or []
+
+
+def list_nodes(session, url):
+    body = session.get(url).content
+    return json.loads(body)  # EXPECT[TNC018]
+
+
+def dump_debug_state(path, state):
+    # Near miss: a json.loads in cluster.py OUTSIDE the LIST walk (a debug
+    # helper, config parsing, identity probing) is not hot-path work.
+    with open(path) as f:
+        return json.loads(f.read())

@@ -1,0 +1,1 @@
+"""TNC018 corpus twin of the fastpath package."""

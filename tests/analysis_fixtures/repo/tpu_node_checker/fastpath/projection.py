@@ -1,0 +1,14 @@
+"""TNC018 corpus: the sanctioned oracle vs a sneaky second decode site."""
+
+import json
+
+
+def oracle_decode_page(resp):
+    # Near miss: THE sanctioned full-body decode — the one site the rule
+    # exempts by name.
+    doc = json.loads(resp.content)
+    return doc.get("items") or [], doc.get("metadata") or {}
+
+
+def decode_page_quickly(resp):
+    return json.loads(resp.content)  # EXPECT[TNC018]

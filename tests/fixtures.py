@@ -358,6 +358,7 @@ def _paged_nodelist_body(
     path: str,
     requests_seen: Optional[list],
     resource_version: Optional[str] = None,
+    page_cache: Optional[dict] = None,
 ) -> bytes:
     """The fake apiserver's ``limit``/``continue`` paging protocol — ONE
     definition shared by :func:`paged_nodelist_handler`,
@@ -365,7 +366,14 @@ def _paged_nodelist_body(
     the fault-injection/bench/watch paths can never drift onto a different
     protocol than the pagination tests pin.  ``requests_seen`` (optional
     list) records each request's start offset; ``resource_version`` rides
-    the list metadata (what a subsequent watch resumes from)."""
+    the list metadata (what a subsequent watch resumes from).
+
+    ``page_cache`` (optional, caller-owned) memoizes serialized page bytes
+    by ``(start, limit)``: bench latency runs keep the fixture server's
+    per-request ``json.dumps`` of an unchanged 5k-node fleet OUT of the
+    measured region (a real apiserver's serialization cost is not the
+    checker's).  The caller owns invalidation — pop the affected keys (or
+    clear) after mutating ``nodes``."""
     import json as _json
     from urllib.parse import parse_qs, urlparse
 
@@ -374,6 +382,10 @@ def _paged_nodelist_body(
     start = int(q.get("continue", ["0"])[0])
     if requests_seen is not None:
         requests_seen.append(start)
+    if page_cache is not None:
+        cached = page_cache.get((start, limit))
+        if cached is not None:
+            return cached
     doc = {"kind": "NodeList", "items": nodes[start:start + limit]}
     meta = {}
     if start + limit < len(nodes):
@@ -382,7 +394,10 @@ def _paged_nodelist_body(
         meta["resourceVersion"] = str(resource_version)
     if meta:
         doc["metadata"] = meta
-    return _json.dumps(doc).encode()
+    body = _json.dumps(doc).encode()
+    if page_cache is not None:
+        page_cache[(start, limit)] = body
+    return body
 
 
 def fault_scheduled_handler(
@@ -583,6 +598,7 @@ def watch_nodelist_handler(
     script: WatchScript,
     resource_version: str = "1000",
     list_requests: Optional[list] = None,
+    page_cache: Optional[dict] = None,
 ):
     """Fake apiserver speaking BOTH halves of the watch-stream protocol.
 
@@ -660,7 +676,8 @@ def watch_nodelist_handler(
                 self._serve_watch()
                 return
             body = _paged_nodelist_body(
-                nodes, self.path, list_requests, resource_version=resource_version
+                nodes, self.path, list_requests,
+                resource_version=resource_version, page_cache=page_cache,
             )
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
@@ -674,12 +691,15 @@ def watch_nodelist_handler(
     return Handler
 
 
-def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = None):
+def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = None,
+                           page_cache: Optional[dict] = None):
     """Handler class serving ``nodes`` as a NodeList with ``limit``/
     ``continue`` pagination — the paging semantics live in
     :func:`_paged_nodelist_body` (shared with the fault-injecting handler),
     used by the pagination tests and ``bench.py``'s 5k-node run.
-    ``requests_seen`` (optional list) records each request's start offset."""
+    ``requests_seen`` (optional list) records each request's start offset;
+    ``page_cache`` (caller-owned, see :func:`_paged_nodelist_body`) keeps
+    the fixture's per-request serialization out of bench-measured walks."""
     from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
@@ -689,7 +709,8 @@ def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = No
         protocol_version = "HTTP/1.1"
 
         def do_GET(self):
-            body = _paged_nodelist_body(nodes, self.path, requests_seen)
+            body = _paged_nodelist_body(nodes, self.path, requests_seen,
+                                        page_cache=page_cache)
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -343,6 +343,196 @@ class TestPaginatedList:
             server.shutdown()
 
 
+class ContentPagingSession:
+    """Paging double whose responses carry raw bytes — what engages the
+    fetch/decode pipeline (a body-less double never prefetches)."""
+
+    def __init__(self, nodes, page_size, fail_410_at=None, fake_last_token=None):
+        self.nodes = nodes
+        self.page_size = page_size
+        self.fail_410_at = fail_410_at  # start offset whose FIRST fetch 410s
+        self.fake_last_token = fake_last_token  # plant '"continue":' bait
+        self.calls = []
+        self.headers = {}
+        self.verify = None
+        self.cert = None
+        self.auth = None
+
+    def get(self, url, params=None, timeout=None):
+        params = dict(params or {})
+        self.calls.append(params)
+        try:
+            start = int(params.get("continue") or 0)
+        except ValueError:
+            start = len(self.nodes)  # a mispeeked token: serve an empty tail
+        if self.fail_410_at is not None and start == self.fail_410_at:
+            self.fail_410_at = None  # expire once, then recover
+            raise cluster.ClusterAPIError(
+                "HTTP 410 from /nodes: continue token expired", status_code=410
+            )
+        page = list(self.nodes[start:start + self.page_size])
+        last = start + self.page_size >= len(self.nodes)
+        if last and self.fake_last_token is not None:
+            # An item whose own key is literally "continue" — byte-level
+            # bait for peek_continue on a page whose metadata has none.
+            page.append({"metadata": {"name": "bait"},
+                         "continue": self.fake_last_token})
+        doc = {"kind": "NodeList", "items": page}
+        if not last:
+            doc["metadata"] = {"continue": str(start + self.page_size)}
+        body = json.dumps(doc).encode()
+
+        class R:
+            status_code = 200
+            content = body
+
+            def raise_for_status(inner):
+                pass
+
+            def json(inner):
+                return json.loads(body)
+
+        return R()
+
+
+class TestPipelinedWalk:
+    """cluster._paged_list's single-slot fetch/decode pipeline: page N+1 is
+    in flight while page N decodes, with the serial walk's exact request
+    set, restart semantics, and result.  The pipeline is decode-cost
+    adaptive (tier-0 page reuse decodes too fast to be worth a worker
+    handoff), so these tests pin it ON through the test seam."""
+
+    @pytest.fixture(autouse=True)
+    def _always_pipeline(self, monkeypatch):
+        monkeypatch.setattr(cluster, "_PREFETCH_MIN_DECODE_S", 0.0)
+
+    def _client(self, session):
+        cfg = cluster.ClusterConfig(server="https://api:6443")
+        return cluster.KubeClient(cfg, session=session)
+
+    def test_pipelined_walk_sends_exactly_one_request_per_page(self):
+        nodes = fx.tpu_v5e_256_slice()
+        session = ContentPagingSession(nodes, page_size=20)
+        got = self._client(session).list_nodes(page_limit=20)
+        assert [n["metadata"]["name"] for n in got] == [
+            n["metadata"]["name"] for n in nodes
+        ]
+        # ceil(64/20) = 4 pages, no speculative extras: every prefetch was
+        # for a token the decode then confirmed.
+        assert len(session.calls) == 4
+        assert [c.get("continue") for c in session.calls] == [
+            None, "20", "40", "60"
+        ]
+
+    def test_mispeeked_token_wastes_at_most_one_request_never_the_result(self):
+        nodes = fx.tpu_v5e_single_host()
+        session = ContentPagingSession(nodes, page_size=10,
+                                       fake_last_token="999")
+        got = self._client(session).list_nodes(page_limit=10)
+        # The bait item is a real (garbage) item of the last page; the walk
+        # terminates on the authoritative metadata (no continue) and the
+        # speculative fetch — if it won the race — is discarded unread.
+        assert [n["metadata"].get("name") for n in got] == [
+            n["metadata"]["name"] for n in nodes
+        ] + ["bait"]
+        real = [c for c in session.calls if c.get("continue") != "999"]
+        assert len(real) == 1
+        assert len(session.calls) <= 2
+
+    def test_410_in_prefetched_page_restarts_once_cleanly(self):
+        nodes = fx.tpu_v5e_256_slice()
+        session = ContentPagingSession(nodes, page_size=40, fail_410_at=40)
+        got = self._client(session).list_nodes(page_limit=40)
+        assert len(got) == 64
+        assert len({n["metadata"]["name"] for n in got}) == 64
+        # p1, p2-prefetch (410s on the worker, re-raised on the caller),
+        # then the clean restart: p1 again, p2.
+        assert len(session.calls) == 4
+
+    def test_projected_walk_same_fleet_as_raw_walk(self):
+        nodes = fx.tpu_v5e_256_slice(not_ready=2)  # 64 hosts → 3 pages of 30
+        raw = self._client(
+            ContentPagingSession(nodes, page_size=30)
+        ).list_nodes(page_limit=30)
+        client = self._client(ContentPagingSession(nodes, page_size=30))
+        fleet = client.list_nodes_projected(page_limit=30)
+        from tpu_node_checker import fastpath
+
+        assert fleet.docs() == [fastpath.project_node_doc(n) for n in raw]
+        assert [p.name for p in fleet] == [
+            n["metadata"]["name"] for n in raw
+        ]
+        # The projector lives on the client: a second identical walk is
+        # pure page reuse.
+        before = dict(client.projector_stats)
+        fleet2 = client.list_nodes_projected(page_limit=30)
+        stats = client.projector_stats
+        assert stats["pages_unchanged"] - before["pages_unchanged"] == 3
+        assert stats["items_decoded"] == before["items_decoded"]
+        assert [a is b for a, b in zip(fleet, fleet2)] == [True] * len(fleet)
+
+
+class TestListTruncation:
+    """No-silent-caps: a page-budget-exhausted walk is counted and returned
+    as an explicit verdict, never silently dropped."""
+
+    class EndlessEventsSession:
+        """Always hands back another continue token: the walk can only end
+        on its page budget."""
+
+        headers: dict = {}
+        verify = cert = auth = None
+
+        def __init__(self):
+            self.calls = 0
+
+        def get(self, url, params=None, timeout=None):
+            self.calls += 1
+            token = int(dict(params or {}).get("continue") or 0) + 1
+            body = json.dumps({
+                "items": [{"type": "Warning", "reason": f"R{token}",
+                           "message": "m"}],
+                "metadata": {"continue": str(token)},
+            }).encode()
+
+            class R:
+                status_code = 200
+                content = body
+
+                def raise_for_status(inner):
+                    pass
+
+                def json(inner):
+                    return json.loads(body)
+
+            return R()
+
+    def test_events_walk_truncation_is_counted_and_reported(self, capsys):
+        cfg = cluster.ClusterConfig(server="https://api:6443")
+        session = self.EndlessEventsSession()
+        client = cluster.KubeClient(cfg, session=session)
+        items, truncated = client.list_node_events_paged("node-1")
+        assert truncated is True
+        assert len(items) == cluster.KubeClient.EVENTS_MAX_PAGES
+        assert "newest events may be missing" in capsys.readouterr().err
+        assert client.transport_stats()["list_truncated"] == {"events": 1}
+        # The legacy single-value accessor still walks and warns, and the
+        # counter keeps counting.
+        client.list_node_events("node-2")
+        assert client.transport_stats()["list_truncated"] == {"events": 2}
+
+    def test_healthy_walks_leave_no_truncation_key(self):
+        nodes = fx.tpu_v5e_single_host()
+        cfg = cluster.ClusterConfig(server="https://api:6443")
+        client = cluster.KubeClient(
+            cfg, session=ContentPagingSession(nodes, page_size=10)
+        )
+        client.list_nodes(page_limit=10)
+        # Healthy payloads must stay byte-identical to the pre-truncation
+        # surface: the key is absent, not zero.
+        assert "list_truncated" not in client.transport_stats()
+
+
 class TestStdlibSession:
     """The default stdlib transport (requests is off the happy path)."""
 

@@ -1,0 +1,607 @@
+"""Relist fast path: projection decoding, fetch/decode pipelining, and
+content-addressed node reuse (DESIGN §16).
+
+The contract under test, in one line: every decode strategy — byte-level
+projection, affix reuse, oracle fallback — must produce the SAME projected
+fleet the ``json.loads`` oracle would, and reuse must be provably
+by-reference (object identity, extraction counters), never semantic
+guesswork.  Fuzzing is stdlib-only (seeded ``random``): tier-1 must run
+without hypothesis.
+"""
+
+import json
+import random
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import fastpath
+from tpu_node_checker.detect import extract_node_info
+from tpu_node_checker.fastpath.projection import _decode_page_text
+from tpu_node_checker.report import _node_entry
+
+
+class _Resp:
+    """requests-shaped response double carrying raw bytes."""
+
+    def __init__(self, body, status=200):
+        self.content = body if isinstance(body, bytes) else body.encode()
+        self.status_code = status
+
+    def raise_for_status(self):
+        pass
+
+    def json(self):
+        return json.loads(self.content)
+
+
+def _page_body(items, meta=None) -> bytes:
+    doc = {"kind": "NodeList", "apiVersion": "v1", "items": items}
+    if meta:
+        doc["metadata"] = meta
+    return json.dumps(doc).encode()
+
+
+def _noisy_node(i: int, ready: bool = True) -> dict:
+    """A node with the wire noise the projection exists to skip."""
+    node = fx.make_node(
+        f"gke-tpu-fast-{i:03d}", ready=ready,
+        allocatable={"google.com/tpu": "4"},
+    )
+    node["metadata"]["managedFields"] = [
+        {"manager": "kubelet", "operation": "Update",
+         "fieldsV1": {"f:status": {f"f:field{j}": {}} for j in range(20)}}
+    ]
+    node["status"]["images"] = [
+        {"names": [f"gcr.io/proj/img{j}@sha256:{'ab' * 16}"], "sizeBytes": 1 << 30}
+        for j in range(10)
+    ]
+    node["status"]["conditions"].append(
+        {"type": "DiskPressure", "status": "False",
+         "lastHeartbeatTime": f"2026-08-03T10:{i % 60:02d}:00Z",
+         "lastTransitionTime": "2026-08-01T00:00:00Z"}
+    )
+    return node
+
+
+class TestProjectionGrammar:
+    def test_noise_dropped_grading_fields_kept(self):
+        node = _noisy_node(0)
+        doc = fastpath.project_node_doc(node)
+        assert set(doc) == {"metadata", "spec", "status"}
+        assert "managedFields" not in doc["metadata"]
+        assert "images" not in doc["status"]
+        assert doc["metadata"]["name"] == node["metadata"]["name"]
+        # Kept values are shared by reference, not copied.
+        assert doc["metadata"]["labels"] is node["metadata"]["labels"]
+        assert doc["status"]["allocatable"] is node["status"]["allocatable"]
+
+    def test_condition_heartbeats_excluded(self):
+        node = _noisy_node(1)
+        doc = fastpath.project_node_doc(node)
+        for cond in doc["status"]["conditions"]:
+            assert "lastHeartbeatTime" not in cond
+            assert "lastTransitionTime" not in cond
+        # A heartbeat-only change must hash identically (the O(changes)
+        # property at relist).
+        before = fastpath.grading_digest(fastpath.project_node_doc(node))
+        for cond in node["status"]["conditions"]:
+            if "lastHeartbeatTime" in cond:
+                cond["lastHeartbeatTime"] = "2026-08-03T23:59:59Z"
+        after = fastpath.grading_digest(fastpath.project_node_doc(node))
+        assert before == after
+
+    def test_grading_change_changes_digest(self):
+        node = _noisy_node(2)
+        before = fastpath.grading_digest(fastpath.project_node_doc(node))
+        for cond in node["status"]["conditions"]:
+            if cond.get("type") == "Ready":
+                cond["status"] = "False"
+        after = fastpath.grading_digest(fastpath.project_node_doc(node))
+        assert before != after
+
+    def test_extract_parity_across_fixture_fleets(self):
+        # The acceptance contract: a node graded through its projection is
+        # byte-identical (entry-wise) to the same node graded whole.
+        fleets = [
+            fx.tpu_v5e_256_slice(),
+            fx.tpu_v5p_64_slice(not_ready=3),
+            fx.big_mixed_cluster(),
+            [_noisy_node(i, ready=i % 3 > 0) for i in range(8)],
+        ]
+        for fleet in fleets:
+            for node in fleet:
+                full = extract_node_info(node)
+                projected = extract_node_info(fastpath.project_node_doc(node))
+                assert _node_entry(full) == _node_entry(projected), (
+                    node.get("metadata", {}).get("name")
+                )
+
+    def test_garbage_shapes_tolerated(self):
+        for garbage in (None, [], "x", 7, {"metadata": "nope"},
+                        {"spec": None, "status": []}):
+            doc = fastpath.project_node_doc(garbage)
+            assert isinstance(doc, dict)
+            # And grades like the raw shape does.
+            assert _node_entry(extract_node_info(garbage)) == _node_entry(
+                extract_node_info(doc)
+            )
+
+
+# --------------------------------------------------------------------------- #
+# The scanner vs the json.loads oracle
+# --------------------------------------------------------------------------- #
+
+
+def _fuzz_string(rng: random.Random) -> str:
+    """Strings built to confuse a byte-level walker: escaped quotes,
+    backslashes, unicode escapes, braces/brackets/commas INSIDE strings."""
+    pieces = []
+    for _ in range(rng.randrange(0, 12)):
+        pieces.append(rng.choice([
+            '"', "\\", "{", "}", "[", "]", ",", ":", "x" * rng.randrange(1, 40),
+            "é", "☃", "\n", "\t", '"continue":', "}{][",
+            "\\u0041", "末端", " ",
+        ]))
+    return "".join(pieces)
+
+
+def _fuzz_value(rng: random.Random, depth: int = 0):
+    kinds = ["str", "int", "float", "bool", "null"]
+    if depth < 3:
+        kinds += ["obj", "arr"]
+    kind = rng.choice(kinds)
+    if kind == "str":
+        return _fuzz_string(rng)
+    if kind == "int":
+        return rng.randrange(-(10 ** 9), 10 ** 9)
+    if kind == "float":
+        return rng.randrange(-(10 ** 6), 10 ** 6) / 7.0
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "null":
+        return None
+    if kind == "obj":
+        return {
+            _fuzz_string(rng) or "k": _fuzz_value(rng, depth + 1)
+            for _ in range(rng.randrange(0, 5))
+        }
+    return [_fuzz_value(rng, depth + 1) for _ in range(rng.randrange(0, 5))]
+
+
+def _fuzz_page_text(rng: random.Random) -> str:
+    """One LIST-page JSON document: items (sometimes huge, sometimes null),
+    metadata, extra top-level keys, duplicate keys, odd whitespace."""
+    items = [_fuzz_value(rng, 1) for _ in range(rng.randrange(0, 6))]
+    if rng.random() < 0.3:
+        # A huge skipped run: managedFields-sized noise inside one item.
+        items.append({"metadata": {"name": "big"},
+                      "noise": ["pad" * 50] * rng.randrange(50, 200)})
+    parts = ['"kind": "NodeList"']
+    if rng.random() < 0.15:
+        parts.append('"items": null')
+    else:
+        parts.append(f'"items": {json.dumps(items, ensure_ascii=False)}')
+    if rng.random() < 0.8:
+        meta = {"resourceVersion": str(rng.randrange(10 ** 6))}
+        if rng.random() < 0.5:
+            meta["continue"] = f"tok{rng.randrange(100)}"
+        parts.append(f'"metadata": {json.dumps(meta)}')
+    if rng.random() < 0.3:
+        parts.append(f'"extra": {json.dumps(_fuzz_value(rng, 1), ensure_ascii=False)}')
+    if rng.random() < 0.2:
+        # Duplicate top-level key: JSON semantics are last-wins, both ways.
+        parts.append(f'"items": {json.dumps([_fuzz_value(rng, 2)], ensure_ascii=False)}')
+    rng.shuffle(parts)
+    ws = rng.choice(["", " ", "\n", "\t \n"])
+    return "{" + ws + ("," + ws).join(parts) + ws + "}"
+
+
+class TestScannerOracleEquivalence:
+    def test_fuzz_pages_match_json_loads(self):
+        rng = random.Random(0xFA57)
+        for case in range(300):
+            text = _fuzz_page_text(rng)
+            doc = json.loads(text)
+            items, spans, meta = _decode_page_text(text)
+            want_items = doc.get("items") or []
+            if not isinstance(want_items, list):
+                want_items = []
+            want_meta = doc.get("metadata") or {}
+            assert items == want_items, (case, text[:200])
+            assert meta == (want_meta if isinstance(want_meta, dict) else {}), case
+            assert len(spans) == len(items)
+
+    def test_fuzz_projector_end_to_end_matches_oracle(self):
+        rng = random.Random(0xBEEF)
+        projector = fastpath.ListProjector()
+        for case in range(100):
+            text = _fuzz_page_text(rng)
+            body = text.encode()
+            nodes, meta = projector.decode_page(_Resp(body), 0)
+            oracle_items, oracle_meta = fastpath.oracle_decode_page(_Resp(body))
+            assert [p.doc for p in nodes] == [
+                fastpath.project_node_doc(it) for it in oracle_items
+            ], case
+            assert meta == oracle_meta, case
+
+    def test_malformed_pages_fall_back_to_oracle_errors(self):
+        projector = fastpath.ListProjector()
+        # Truly broken bodies: the scanner must not "succeed" differently
+        # from the oracle — both paths surface a decode error.
+        for bad in (b"[1, 2", b'{"items": [}', b"", b'{"items": [1,]}'):
+            with pytest.raises(ValueError):
+                projector.decode_page(_Resp(bad), 0)
+        # Non-UTF-8: the oracle tolerates latin-1-ish bytes via loads(bytes)
+        # only when they are valid JSON encodings; a broken encoding errors.
+        with pytest.raises(ValueError):
+            projector.decode_page(_Resp(b'{"items": ["\xff\xfe"]}'), 0)
+
+    def test_non_object_page_shapes(self):
+        projector = fastpath.ListProjector()
+        # A top-level array (not a k8s LIST shape): the oracle returns it
+        # as the item list; the scanner falls back and must agree.
+        nodes, meta = projector.decode_page(_Resp(b"[{}, {}]"), 0)
+        assert [p.doc for p in nodes] == [{}, {}]
+        assert meta == {}
+        assert projector.stats["pages_fallback"] >= 1
+
+
+class TestPeekContinue:
+    def test_token_found(self):
+        body = _page_body([{"a": 1}], meta={"continue": "500", "resourceVersion": "9"})
+        assert fastpath.peek_continue(body) == "500"
+
+    def test_absent_token_is_none(self):
+        assert fastpath.peek_continue(_page_body([{"a": 1}])) is None
+        assert fastpath.peek_continue(None) is None
+        assert fastpath.peek_continue(b"") is None
+
+    def test_escaped_or_non_ascii_tokens_refused(self):
+        # Escapes inside the token cannot be resolved bytewise: no peek.
+        assert fastpath.peek_continue(b'{"metadata": {"continue": "a\\"b"}}') is None
+        assert fastpath.peek_continue(
+            '{"metadata": {"continue": "toké"}}'.encode()
+        ) is None
+        assert fastpath.peek_continue(b'{"metadata": {"continue": ""}}') is None
+        assert fastpath.peek_continue(b'{"metadata": {"continue": 7}}') is None
+
+    def test_rfind_takes_the_last_occurrence(self):
+        # An annotation mentioning "continue" earlier in the body must not
+        # shadow the real metadata token at the end.
+        body = (b'{"items": [{"metadata": {"annotations": '
+                b'{"note": "\\"continue\\": \\"FAKE\\""}}}], '
+                b'"metadata": {"continue": "real"}}')
+        assert fastpath.peek_continue(body) == "real"
+
+
+# --------------------------------------------------------------------------- #
+# Reuse tiers: whole-page equality, affix byte-runs
+# --------------------------------------------------------------------------- #
+
+
+class TestListProjectorReuse:
+    def _decode(self, projector, items, meta=None, index=0):
+        return projector.decode_page(_Resp(_page_body(items, meta)), index)
+
+    def test_tier0_identical_body_reuses_everything(self):
+        items = [_noisy_node(i) for i in range(10)]
+        projector = fastpath.ListProjector()
+        nodes1, _ = self._decode(projector, items)
+        nodes2, _ = self._decode(projector, items)
+        assert projector.stats["pages_unchanged"] == 1
+        assert nodes1 is nodes2  # the page's node list itself, by reference
+
+    def test_affix_reuse_one_changed_node_mid_page(self):
+        items = [_noisy_node(i) for i in range(20)]
+        projector = fastpath.ListProjector()
+        nodes1, _ = self._decode(projector, items)
+        for cond in items[10]["status"]["conditions"]:
+            if cond.get("type") == "Ready":
+                cond["status"] = "False"
+        nodes2, _ = self._decode(projector, items)
+        assert projector.stats["items_reused"] == 19
+        assert projector.stats["items_decoded"] == 20 + 1
+        # Reused nodes are the SAME ProjectedNode objects.
+        for i, (a, b) in enumerate(zip(nodes1, nodes2)):
+            if i == 10:
+                assert a is not b and a.digest != b.digest
+            else:
+                assert a is b
+        # And the projected fleet still equals the oracle's view.
+        assert [p.doc for p in nodes2] == [
+            fastpath.project_node_doc(it) for it in items
+        ]
+
+    def test_affix_reuse_survives_insert_and_delete(self):
+        items = [_noisy_node(i) for i in range(12)]
+        projector = fastpath.ListProjector()
+        self._decode(projector, items)
+        # Insert near the front: the suffix run shifts but still maps.
+        grown = items[:2] + [_noisy_node(99)] + items[2:]
+        nodes, _ = self._decode(projector, grown)
+        assert [p.doc for p in nodes] == [
+            fastpath.project_node_doc(it) for it in grown
+        ]
+        assert projector.stats["items_reused"] > 0
+        # Delete from the middle: prefix + shifted suffix again.
+        shrunk = grown[:5] + grown[7:]
+        nodes, _ = self._decode(projector, shrunk)
+        assert [p.doc for p in nodes] == [
+            fastpath.project_node_doc(it) for it in shrunk
+        ]
+
+    def test_fallback_page_recovers_to_fast_path(self):
+        items = [_noisy_node(i) for i in range(4)]
+        projector = fastpath.ListProjector()
+        with pytest.raises(ValueError):
+            projector.decode_page(_Resp(b'{"items": ['), 0)
+        # A clean walk after the error decodes normally...
+        nodes1, _ = self._decode(projector, items)
+        decoded_before = projector.stats["pages_decoded"]
+        # ...and the next identical walk rides tier-0 again.
+        nodes2, _ = self._decode(projector, items)
+        assert nodes1 is nodes2
+        assert projector.stats["pages_decoded"] == decoded_before
+
+    def test_kill_switch_forces_oracle(self, monkeypatch):
+        monkeypatch.setenv("TNC_PROJECTION", "off")
+        items = [_noisy_node(i) for i in range(3)]
+        projector = fastpath.ListProjector()
+        nodes, meta = self._decode(projector, items)
+        assert projector.stats["pages_fallback"] == 1
+        assert projector.stats["pages_decoded"] == 0
+        # The fallback produces the same ProjectedNode contract.
+        assert [p.doc for p in nodes] == [
+            fastpath.project_node_doc(it) for it in items
+        ]
+
+    def test_doubles_without_content_use_oracle(self):
+        class _NoContent:
+            def json(self):
+                return {"items": [{"metadata": {"name": "n1"}}], "metadata": {}}
+
+        projector = fastpath.ListProjector()
+        nodes, _ = projector.decode_page(_NoContent(), 0)
+        assert nodes[0].name == "n1"
+        assert projector.stats["pages_fallback"] == 1
+
+
+class TestNodeReuseCache:
+    def _fleet(self, items):
+        projector = fastpath.ListProjector()
+        nodes, _ = projector.decode_page(_Resp(_page_body(items)), 0)
+        return fastpath.ProjectedFleet(nodes, "1", projector.reuse)
+
+    def test_unchanged_digest_reuses_info_and_entry_by_reference(self):
+        items = [_noisy_node(i) for i in range(6)]
+        fleet = self._fleet(items)
+        accel1, ready1, entries1, changed1 = fleet.reuse.select(fleet, None)
+        assert changed1 == frozenset(p.name for p in fleet)
+        assert fleet.reuse.extracts == 6
+        accel2, ready2, entries2, changed2 = fleet.reuse.select(fleet, None)
+        assert changed2 == frozenset()
+        assert fleet.reuse.extracts == 6  # zero re-extraction
+        for a, b in zip(accel1, accel2):
+            assert a is b
+        for a, b in zip(entries1, entries2):
+            assert a is b
+
+    def test_changed_and_removed_names_reported(self):
+        # One projector across walks — the shape list_nodes_projected
+        # drives: the SAME reuse cache sees both fleets.
+        items = [_noisy_node(i) for i in range(6)]
+        projector = fastpath.ListProjector()
+        nodes, _ = projector.decode_page(_Resp(_page_body(items)), 0)
+        fleet = fastpath.ProjectedFleet(nodes, "1", projector.reuse)
+        fleet.reuse.select(fleet, None)
+        extracts = fleet.reuse.extracts
+        for cond in items[2]["status"]["conditions"]:
+            if cond.get("type") == "Ready":
+                cond["status"] = "False"
+        smaller = items[:5]  # node 5 removed
+        nodes2, _ = projector.decode_page(_Resp(_page_body(smaller)), 0)
+        fleet2 = fastpath.ProjectedFleet(nodes2, "2", projector.reuse)
+        accel, ready, entries, changed = fleet2.reuse.select(fleet2, None)
+        assert changed == {items[2]["metadata"]["name"],
+                           items[5]["metadata"]["name"]}
+        assert fleet2.reuse.extracts == extracts + 1  # only the flipped node
+        assert len(accel) == 5
+        assert sum(1 for n in accel if n.ready) == 4
+
+    def test_registry_change_invalidates_everything(self):
+        from tpu_node_checker.resources import default_registry
+
+        items = [_noisy_node(i) for i in range(3)]
+        fleet = self._fleet(items)
+        reg = default_registry()
+        fleet.reuse.select(fleet, reg)
+        assert fleet.reuse.extracts == 3
+        fleet.reuse.select(fleet, reg.with_extra_keys(["corp.example/npu"]))
+        assert fleet.reuse.extracts == 6  # full re-extract under the new key
+
+
+class TestReuseAllowed:
+    def test_plain_args_allow_gated_flags_refuse(self):
+        from tpu_node_checker import cli
+
+        assert fastpath.reuse_allowed(cli.parse_args(["--json"]))
+        for flag in (["--probe"], ["--node-events"],
+                     ["--probe-results", "/tmp/x"],
+                     ["--history", "/tmp/h.jsonl"],
+                     ["--cordon-failed", "--probe"]):
+            args = cli.parse_args(flag + ["--json"])
+            assert not fastpath.reuse_allowed(args), flag
+
+
+# --------------------------------------------------------------------------- #
+# run_check end-to-end: the payload contract is byte-identical across paths
+# --------------------------------------------------------------------------- #
+
+
+def _kubeconfig_for(tmp_path, port) -> str:
+    kc = tmp_path / "kubeconfig"
+    kc.write_text(
+        "apiVersion: v1\ncurrent-context: c\n"
+        "contexts:\n- name: c\n  context:\n    cluster: cl\n    user: u\n"
+        "clusters:\n- name: cl\n  cluster:\n"
+        f"    server: http://127.0.0.1:{port}\n"
+        "users:\n- name: u\n  user:\n    token: tok\n"
+    )
+    return str(kc)
+
+
+def _normalized(payload: dict) -> str:
+    """The payload minus its per-round volatiles (trace identity, clocks,
+    transport counters, resolved cluster identity) — everything else is
+    the pinned byte-identical contract."""
+    p = dict(payload)
+    for key in ("trace_id", "timings_ms", "api_transport", "cluster",
+                "cluster_source"):
+        p.pop(key, None)
+    return json.dumps(p, ensure_ascii=False, indent=2)
+
+
+class TestRunCheckParity:
+    def test_projection_oracle_and_offline_payloads_identical(
+        self, tmp_path, monkeypatch
+    ):
+        from tpu_node_checker import checker, cli
+
+        nodes = fx.tpu_v5e_256_slice(not_ready=3)
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes, []))
+        try:
+            kc = _kubeconfig_for(tmp_path, server.server_address[1])
+            args = cli.parse_args(["--kubeconfig", kc, "--json"])
+            checker.reset_client_cache()
+            cold = checker.run_check(args)   # cold projected walk
+            warm = checker.run_check(args)   # warm: tier-0 pages + reuse
+            checker.reset_client_cache()
+            monkeypatch.setenv("TNC_PROJECTION", "off")
+            oracle = checker.run_check(args)  # every page through the oracle
+            monkeypatch.delenv("TNC_PROJECTION")
+            checker.reset_client_cache()
+            # The pre-PR-shaped path: raw dicts through
+            # select_accelerator_nodes (run_check's injected-nodes branch).
+            offline = checker.run_check(args, nodes=nodes)
+            assert (cold.exit_code == warm.exit_code == oracle.exit_code
+                    == offline.exit_code)
+            assert (_normalized(cold.payload) == _normalized(warm.payload)
+                    == _normalized(oracle.payload)
+                    == _normalized(offline.payload))
+        finally:
+            checker.reset_client_cache()
+            server.shutdown()
+
+    def test_warm_round_reuses_entries_by_reference(self, tmp_path):
+        from tpu_node_checker import checker, cli
+
+        nodes = fx.tpu_v5e_256_slice()
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes, []))
+        try:
+            kc = _kubeconfig_for(tmp_path, server.server_address[1])
+            args = cli.parse_args(["--kubeconfig", kc, "--json"])
+            checker.reset_client_cache()
+            r1 = checker.run_check(args)
+            r2 = checker.run_check(args)
+            # Same entry dicts, same NodeInfo objects: the whole per-node
+            # pipeline was reused by reference, not rebuilt equal.
+            assert all(
+                a is b for a, b in zip(r1.payload["nodes"], r2.payload["nodes"])
+            )
+            assert all(a is b for a, b in zip(r1.accel, r2.accel))
+        finally:
+            checker.reset_client_cache()
+            server.shutdown()
+
+    def test_attachment_flags_disable_reuse_not_projection(self, tmp_path):
+        from tpu_node_checker import checker, cli
+
+        nodes = fx.tpu_v5e_256_slice()
+        server = fx.serve_http(fx.paged_nodelist_handler(nodes, []))
+        try:
+            kc = _kubeconfig_for(tmp_path, server.server_address[1])
+            args = cli.parse_args(
+                ["--kubeconfig", kc, "--history", str(tmp_path / "h.jsonl"),
+                 "--json"]
+            )
+            checker.reset_client_cache()
+            r1 = checker.run_check(args)
+            r2 = checker.run_check(args)
+            # NodeInfo carries per-round history state: entries must be
+            # rebuilt fresh every round...
+            assert all(
+                a is not b
+                for a, b in zip(r1.payload["nodes"], r2.payload["nodes"])
+            )
+            # ...but the page-level projection reuse still engages.
+            client = checker._ROUND_CLIENT["client"]
+            assert client.projector_stats["pages_unchanged"] > 0
+        finally:
+            checker.reset_client_cache()
+            server.shutdown()
+
+
+class TestEventsTruncationDegradation:
+    def test_truncated_events_walk_stamps_degradation(self, tmp_path, capsys):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler
+        from urllib.parse import parse_qs, urlparse
+
+        from tpu_node_checker import checker, cli
+
+        nodes = fx.tpu_v5p_64_slice(not_ready=1)
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                q = parse_qs(parsed.query)
+                if parsed.path == "/api/v1/nodes":
+                    body = _json.dumps(fx.node_list(nodes)).encode()
+                else:
+                    # Events: ALWAYS another page — the walk can only end
+                    # on its page budget.
+                    token = int((q.get("continue") or ["0"])[0]) + 1
+                    body = _json.dumps({
+                        "items": [{"type": "Warning", "reason": f"R{token}",
+                                   "message": "m",
+                                   "lastTimestamp": "2026-08-03T10:00:00Z"}],
+                        "metadata": {"continue": str(token)},
+                    }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        server = fx.serve_http(Handler)
+        try:
+            kc = _kubeconfig_for(tmp_path, server.server_address[1])
+            checker.reset_client_cache()
+            result = checker.run_check(
+                cli.parse_args(["--kubeconfig", kc, "--node-events", "--json"])
+            )
+            sick_name = next(
+                n["name"] for n in result.payload["nodes"] if not n["ready"]
+            )
+            assert result.payload["degraded"] is True
+            assert result.payload["degradation"]["events_truncated"] == [
+                sick_name
+            ]
+            assert result.payload["api_transport"]["list_truncated"] == {
+                "events": 1
+            }
+            assert "newest events may be missing" in capsys.readouterr().err
+            # The truncated walk still attached what it got.
+            sick = next(
+                n for n in result.payload["nodes"] if n["name"] == sick_name
+            )
+            assert sick["events"]
+        finally:
+            checker.reset_client_cache()
+            server.shutdown()

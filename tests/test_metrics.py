@@ -65,6 +65,23 @@ class TestRenderMetrics:
         assert 'tpu_node_checker_api_retries_total{reason="none"} 0' in text
         assert "tpu_node_checker_round_degraded 0.0" in text
 
+    def test_list_truncation_counter_rendered_by_resource(self):
+        result = self._result(fx.tpu_v5e_256_slice())
+        result.payload["api_transport"] = {
+            "connections_opened": 1,
+            "requests_sent": 25,
+            "requests_reused": 24,
+            "list_truncated": {"events": 3, "nodes": 1},
+        }
+        text = render_metrics(result)
+        assert "# TYPE tpu_node_checker_api_list_truncated_total counter" in text
+        assert 'tpu_node_checker_api_list_truncated_total{resource="events"} 3' in text
+        assert 'tpu_node_checker_api_list_truncated_total{resource="nodes"} 1' in text
+        # Healthy sessions omit the key and the family: absence IS the
+        # pre-truncation-stat payload surface, byte for byte.
+        del result.payload["api_transport"]["list_truncated"]
+        assert "list_truncated" not in render_metrics(result)
+
     def test_breaker_gauges_rendered_when_state_supplied(self):
         result = self._result(fx.tpu_v5e_256_slice())
         text = render_metrics(

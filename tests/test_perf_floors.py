@@ -562,3 +562,80 @@ class TestFloorsCliAndMetrics:
             "timings_ms": {"total": 1.0},
         }
         assert "perf_floor" not in render_metrics(result)
+
+
+class TestRelistWorkFloor:
+    """The relist fast path's cost floor, pinned as DETERMINISTIC work
+    counters rather than wall clock (a loaded CI box must not flake a
+    perf contract): a relist at N-node churn decodes and re-extracts
+    exactly N nodes — the O(changes) property BENCH_r10's
+    nodes5k_relist_churn1pct_p50_ms gate measures in milliseconds."""
+
+    def _pages(self, nodes, page_size=500):
+        import json as _json
+
+        bodies = []
+        for start in range(0, len(nodes), page_size):
+            bodies.append(_json.dumps(
+                {"kind": "NodeList", "items": nodes[start:start + page_size]}
+            ).encode())
+        return bodies
+
+    def _walk(self, projector, bodies):
+        from tpu_node_checker import fastpath
+
+        class _Resp:
+            def __init__(self, body):
+                self.content = body
+
+        items = []
+        for i, body in enumerate(bodies):
+            nodes, _ = projector.decode_page(_Resp(body), i)
+            items.extend(nodes)
+        return fastpath.ProjectedFleet(items, "1", projector.reuse)
+
+    def test_zero_churn_relist_decodes_and_extracts_nothing(self):
+        from tests import fixtures as fx
+        from tpu_node_checker import fastpath
+
+        nodes = [
+            fx.make_node(f"floor-{i:04d}", allocatable={"google.com/tpu": "4"})
+            for i in range(1000)
+        ]
+        projector = fastpath.ListProjector()
+        fleet = self._walk(projector, self._pages(nodes))
+        fleet.reuse.select(fleet, None)
+        base = dict(projector.stats)
+        extracts = fleet.reuse.extracts
+        fleet2 = self._walk(projector, self._pages(nodes))
+        fleet2.reuse.select(fleet2, None)
+        assert projector.stats["items_decoded"] == base["items_decoded"]
+        assert projector.stats["pages_unchanged"] - base["pages_unchanged"] == 2
+        assert fleet2.reuse.extracts == extracts  # zero re-extraction
+
+    def test_one_percent_churn_costs_exactly_the_churn(self):
+        from tests import fixtures as fx
+        from tpu_node_checker import fastpath
+
+        nodes = [
+            fx.make_node(f"floor-{i:04d}", allocatable={"google.com/tpu": "4"})
+            for i in range(1000)
+        ]
+        projector = fastpath.ListProjector()
+        fleet = self._walk(projector, self._pages(nodes))
+        fleet.reuse.select(fleet, None)
+        base = dict(projector.stats)
+        extracts = fleet.reuse.extracts
+        # A contiguous 10-node block flips Ready (one byte window: the
+        # floor is exact; scattered churn only widens the decoded window,
+        # never the re-extraction set).
+        for n in nodes[100:110]:
+            for cond in n["status"]["conditions"]:
+                if cond["type"] == "Ready":
+                    cond["status"] = "False"
+        fleet2 = self._walk(projector, self._pages(nodes))
+        changed = fleet2.reuse.select(fleet2, None)[3]
+        assert projector.stats["items_decoded"] - base["items_decoded"] == 10
+        assert projector.stats["items_reused"] - base["items_reused"] == 490
+        assert fleet2.reuse.extracts - extracts == 10
+        assert len(changed) == 10

@@ -206,6 +206,59 @@ class TestNodeCache:
         changed, removed = cache.drain()
         assert set(changed) == {"a"} and not removed
 
+    def _projected(self, nodes, rv):
+        import json
+
+        from tpu_node_checker import fastpath
+
+        class _Resp:
+            content = json.dumps({"items": nodes}).encode()
+
+        projector = fastpath.ListProjector()
+        items, _meta = projector.decode_page(_Resp(), 0)
+        return fastpath.ProjectedFleet(items, rv, projector.reuse)
+
+    def test_projected_seed_diffs_like_a_raw_seed(self):
+        cache = NodeCache()
+        cache.seed(self._projected([_tpu_node("a"), _tpu_node("b")], "1"), "1")
+        changed, removed = cache.drain()
+        assert set(changed) == {"a", "b"} and not removed
+        cache.seed(
+            self._projected([_tpu_node("a", ready=False), _tpu_node("c")], "2"),
+            "2",
+        )
+        changed, removed = cache.drain()
+        assert set(changed) == {"a", "c"}
+        assert removed == frozenset({"b"})
+        # The cached docs are the PRUNED grading views, and they grade
+        # exactly like the raw objects they project (extract parity).
+        assert "managedFields" not in changed["a"].get("metadata", {})
+
+    def test_digests_agree_across_raw_seed_event_and_projected_relist(self):
+        # The cross-type invariant the relist fast path rests on: a raw
+        # LIST seed, a raw watch event, and a projected relist of the SAME
+        # grading state all hash to the same content address — so a
+        # post-loss relist dirties nothing a quiet stream didn't change.
+        cache = NodeCache()
+        cache.seed([_tpu_node("a"), _tpu_node("b")], "1")
+        cache.drain()
+        # Heartbeat-only MODIFIED event: cache updated, nothing dirty.
+        hb = _tpu_node("a")
+        hb["status"]["conditions"][1]["lastHeartbeatTime"] = "t2"
+        hb["spec"]["podCIDR"] = "10.0.0.0/24"  # non-grading spec churn
+        cache.apply("MODIFIED", hb)
+        assert cache.pending() == 0
+        # Projected relist of the unchanged fleet: still nothing dirty.
+        cache.seed(self._projected([_tpu_node("a"), _tpu_node("b")], "3"), "3")
+        assert cache.pending() == 0
+        # And a real grading change via relist IS seen.
+        cache.seed(
+            self._projected([_tpu_node("a", ready=False), _tpu_node("b")], "4"),
+            "4",
+        )
+        changed, removed = cache.drain()
+        assert set(changed) == {"a"} and not removed
+
 
 class TestWatchTransport:
     def test_watch_nodes_decodes_frames(self, stream_world):
@@ -430,6 +483,87 @@ class TestStreamEngine:
             lambda: (engine.stats.as_dict()["events_total"].get("MODIFIED", 0)) >= 2,
             what="dripped frames",
         )
+
+
+class TestIncrementalSlices:
+    """The engine's slice cache must be indistinguishable from a
+    from-scratch ``group_slices`` — same SliceInfo payload, same order —
+    while provably reusing untouched groups by reference."""
+
+    def _engine_with(self, raw_nodes):
+        from tpu_node_checker.detect import extract_node_info
+
+        engine = StreamRoundEngine(
+            cli.parse_args(["--watch", "5", "--watch-stream", "--json"])
+        )
+        engine._infos = {
+            i.name: i for i in (extract_node_info(n) for n in raw_nodes)
+        }
+        engine._accel_names = sorted(engine._infos)
+        return engine
+
+    def _full(self, engine):
+        from tpu_node_checker.detect import group_slices
+
+        return group_slices([engine._infos[n] for n in engine._accel_names])
+
+    def test_flip_remove_and_label_move_match_full_rebuild(self):
+        raw = [
+            n for n in fx.big_mixed_cluster()
+            if "google.com/tpu" in (n["status"]["allocatable"] or {})
+        ][:192]  # three 64-host pools
+        engine = self._engine_with(raw)
+        first = engine._slices_incremental(frozenset(engine._infos))
+        assert [s.to_dict() for s in first] == [
+            s.to_dict() for s in self._full(engine)
+        ]
+        dicts_before = dict(engine._slice_dicts)
+        engine._slice_payload(first)  # populate the payload cache
+
+        from tpu_node_checker.detect import extract_node_info
+
+        # Readiness flips inside ONE pool.
+        changed = set()
+        for n in raw[10:15]:
+            for cond in n["status"]["conditions"]:
+                if cond["type"] == "Ready":
+                    cond["status"] = "False"
+            info = extract_node_info(n)
+            engine._infos[info.name] = info
+            changed.add(info.name)
+        inc = engine._slices_incremental(frozenset(changed))
+        full = self._full(engine)
+        assert engine._slice_payload(inc) == [s.to_dict() for s in full]
+        # Untouched groups kept their SliceInfo objects (and therefore
+        # their payload dicts) by reference.
+        from tpu_node_checker.detect import slice_group_key
+
+        touched = {slice_group_key(engine._infos[n]) for n in changed}
+        for key, d in dicts_before.items():
+            if key not in touched:
+                assert engine._slice_dicts[key] is d
+
+        # A node vanishes entirely.
+        victim = raw[100]["metadata"]["name"]
+        del engine._infos[victim]
+        engine._accel_names = sorted(engine._infos)
+        inc = engine._slices_incremental(frozenset({victim}))
+        assert engine._slice_payload(inc) == [
+            s.to_dict() for s in self._full(engine)
+        ]
+
+        # A label move migrates a node between groups (old AND new group
+        # rebuilt).
+        mover = raw[150]
+        mover["metadata"]["labels"]["cloud.google.com/gke-nodepool"] = (
+            raw[0]["metadata"]["labels"]["cloud.google.com/gke-nodepool"]
+        )
+        info = extract_node_info(mover)
+        engine._infos[info.name] = info
+        inc = engine._slices_incremental(frozenset({info.name}))
+        assert engine._slice_payload(inc) == [
+            s.to_dict() for s in self._full(engine)
+        ]
 
 
 class TestEvidenceSemantics:

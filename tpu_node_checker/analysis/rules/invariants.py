@@ -376,6 +376,61 @@ class ObsDiscipline(Rule):
                     )
 
 
+class ListHotPathDecode(Rule):
+    slug = "list-hotpath-decode"
+    code = "TNC018"
+    doc = ("no full-body JSON decode on the paginated LIST hot path — "
+           "cluster.py's walk/list functions and everything in "
+           "tpu_node_checker/fastpath/ decode pages through "
+           "``fastpath.oracle_decode_page`` (the one sanctioned "
+           "``json.loads`` site) or the projection scanner; a stray "
+           "``loads``/``resp.json()`` there re-materializes managedFields "
+           "for 5k nodes per round and silently undoes the relist fast "
+           "path")
+
+    # The LIST walk and every list method riding it: the functions whose
+    # per-page cost model the fast path owns.  _Response.json() and the
+    # kubeconfig/identity paths are deliberately out of scope — they are
+    # not per-page work.
+    _CLUSTER_FUNCS = (
+        "_paged_list", "_oracle_page_decoder", "list_nodes",
+        "list_nodes_with_rv", "list_nodes_projected", "list_node_events",
+        "list_node_events_paged",
+    )
+    # The one sanctioned full-body decode (fastpath/projection.py).
+    _SANCTIONED = "oracle_decode_page"
+
+    def _scanned_functions(self, ctx: FileContext):
+        if ctx.path == "tpu_node_checker/cluster.py":
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name in self._CLUSTER_FUNCS):
+                    yield node
+        elif ctx.path.startswith("tpu_node_checker/fastpath/"):
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name != self._SANCTIONED):
+                    yield node
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for func in self._scanned_functions(ctx):
+            for node in walk_skipping_nested_functions(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                if (name in ("json.loads", "loads")
+                        or name.endswith(".json")):
+                    yield self.finding(
+                        ctx.path, node,
+                        f"full-body decode {name}() in {func.name}() on "
+                        "the LIST hot path — route the page through "
+                        "fastpath.oracle_decode_page (the sanctioned "
+                        "fallback) or the projection scanner",
+                    )
+
+
 class TestWallClock(Rule):
     slug = "test-wall-clock"
     code = "TNC016"
@@ -415,5 +470,6 @@ RULES: List[Rule] = [
     MetricName(),
     ExitCode(),
     ObsDiscipline(),
+    ListHotPathDecode(),
     TestWallClock(),
 ]

@@ -31,7 +31,7 @@ import re
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from tpu_node_checker import notify, report
 
@@ -183,6 +183,10 @@ def _fetch_nodes(args, timer: PhaseTimer):
 
     Returns ``(nodes, client)``; ``client`` is ``None`` in offline mode and
     otherwise reused by ``--cordon-failed`` instead of re-resolving config.
+    Live LISTs ride the relist fast path (``list_nodes_projected``):
+    ``nodes`` is then a :class:`~tpu_node_checker.fastpath.ProjectedFleet`
+    whose unchanged pages/byte-runs were reused by reference from the
+    cached client's previous walk.
     """
     nodes_json = getattr(args, "nodes_json", None)
     if nodes_json:
@@ -199,7 +203,7 @@ def _fetch_nodes(args, timer: PhaseTimer):
         )
     with timer.phase("list"):
         client = _cached_client(cfg)
-        return client.list_nodes(
+        return client.list_nodes_projected(
             label_selector=getattr(args, "label_selector", None)
         ), client
 
@@ -454,7 +458,9 @@ def _summarize_events(raw: Sequence) -> list:
     return evs[:_EVENTS_PER_NODE]
 
 
-def _attach_node_events(args, accel: List[NodeInfo], client) -> List[str]:
+def _attach_node_events(
+    args, accel: List[NodeInfo], client
+) -> Tuple[List[str], List[str]]:
     """``--node-events``: recent k8s Events for SICK nodes.
 
     The ``kubectl describe node`` triage block, pushed instead of dug for:
@@ -470,14 +476,19 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> List[str]:
     connection), so 8 sick nodes cost ~max(one walk), not the sum — the
     exact round where latency matters most is the degraded one.
 
-    Returns the failure notes (empty when every fetch landed): events are a
-    non-essential phase, so a transient failure here marks the round
-    ``degraded`` in the payload instead of sinking it to exit 1.
+    Returns ``(failure_notes, truncated_names)`` — both empty when every
+    fetch landed whole: events are a non-essential phase, so a transient
+    failure here marks the round ``degraded`` in the payload instead of
+    sinking it to exit 1, and a walk that exhausted its page budget with
+    the continue token still set is stamped into the degradation detail
+    (no-silent-caps: the NEWEST events may be missing from that node's
+    triage) alongside the transport's ``list_truncated`` counter.
     """
     errors: List[str] = []
+    truncated: List[str] = []
     sick = [n for n in accel if not n.effectively_ready]
     if not sick:
-        return errors
+        return errors, truncated
     # Unplanned faults outrank maintenance drains for the fetch budget: a
     # rolling drain of 8+ cordoned nodes must not starve the one genuinely
     # faulted node of the triage this flag exists for (stable sort keeps
@@ -488,18 +499,28 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> List[str]:
     except Exception as exc:  # tnc: allow-broad-except(triage extra, never fatal)
         print(f"Cannot fetch node events: {exc}", file=sys.stderr)
         errors.append(f"no cluster client: {exc}")
-        return errors
+        return errors, truncated
     from tpu_node_checker.utils.fanout import bounded_map
 
+    paged_fetch = getattr(client, "list_node_events_paged", None)
+
+    def _fetch(n):
+        # Drop-in clients without the truncation-aware walk still attach
+        # events; they just cannot report a capped walk.
+        if paged_fetch is not None:
+            return paged_fetch(n.name)
+        return client.list_node_events(n.name), False
+
     targets = sick[:_EVENTS_NODE_CAP]
-    outcomes = bounded_map(
-        lambda n: client.list_node_events(n.name), targets, _api_concurrency(args)
-    )
+    outcomes = bounded_map(_fetch, targets, _api_concurrency(args))
     # Input-ordered results: attachment and stderr notes stay deterministic
     # no matter which worker finished first.
     for n, (ok, value) in zip(targets, outcomes):
         if ok:
-            n.events = _summarize_events(value)
+            items, was_truncated = value
+            n.events = _summarize_events(items)
+            if was_truncated:
+                truncated.append(n.name)
         else:
             print(f"Cannot fetch events for {n.name}: {value}", file=sys.stderr)
             errors.append(f"{n.name}: {value}")
@@ -510,7 +531,7 @@ def _attach_node_events(args, accel: List[NodeInfo], client) -> List[str]:
             f"{_EVENTS_NODE_CAP}-node fetch cap",
             file=sys.stderr,
         )
-    return errors
+    return errors, truncated
 
 
 def _resolve_client(args, client):
@@ -1024,7 +1045,25 @@ def run_check(args, nodes: Optional[List[dict]] = None,
         nodes, kube_client = _fetch_nodes(args, timer)
     result = CheckResult(exit_code=EXIT_OK)
     with timer.phase("detect"):
-        accel, ready = select_accelerator_nodes(nodes, _registry_from_args(args))
+        from tpu_node_checker import fastpath
+
+        entries = None
+        if isinstance(nodes, fastpath.ProjectedFleet):
+            if fastpath.reuse_allowed(args):
+                # Content-addressed reuse: an unchanged grading digest
+                # reuses the node's NodeInfo AND its payload entry by
+                # reference — a full relist re-extracts O(changes).
+                accel, ready, entries, _changed = nodes.reuse.select(
+                    nodes, _registry_from_args(args)
+                )
+            else:
+                # A per-round attachment source (probe/events/history/
+                # cordon) mutates NodeInfo per round: extract fresh.
+                accel, ready = select_accelerator_nodes(
+                    nodes.docs(), _registry_from_args(args)
+                )
+        else:
+            accel, ready = select_accelerator_nodes(nodes, _registry_from_args(args))
         slices = group_slices(accel)
     result.accel, result.slices = accel, slices
 
@@ -1035,9 +1074,15 @@ def run_check(args, nodes: Optional[List[dict]] = None,
 
     if getattr(args, "node_events", False):
         with timer.phase("events"):
-            event_errors = _attach_node_events(args, accel, kube_client)
+            event_errors, events_truncated = _attach_node_events(
+                args, accel, kube_client
+            )
         if event_errors:
             degradation["events"] = event_errors[:_EVENTS_NODE_CAP]
+        if events_truncated:
+            # A capped events walk must never read as a complete one: the
+            # node names whose triage may be missing its NEWEST events.
+            degradation["events_truncated"] = events_truncated[:_EVENTS_NODE_CAP]
 
     # Per-node health history + hysteresis (--history): verdicts feed the
     # FSM here — after every probe surface attached, before any remediation
@@ -1078,7 +1123,7 @@ def run_check(args, nodes: Optional[List[dict]] = None,
 
     with timer.phase("render"):
         payload = report.build_json_payload(
-            accel, effective_ready, slices, timings_ms=None
+            accel, effective_ready, slices, timings_ms=None, entries=entries
         )
         multislices = group_multislices(
             slices, getattr(args, "multislice_label", None) or ()

@@ -30,7 +30,9 @@ import os
 import subprocess
 import sys
 import tempfile
+import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -767,6 +769,95 @@ def resolve_cluster_config(
     return load_incluster_config()
 
 
+def _oracle_page_decoder(resp, page_index):
+    """Default page decoder: the sanctioned full-body decode in
+    ``tpu_node_checker.fastpath`` (events walks, raw-dict node LISTs,
+    drop-in session doubles that carry no raw bytes)."""
+    from tpu_node_checker import fastpath
+
+    return fastpath.oracle_decode_page(resp)
+
+
+_PREFETCH_STOP = object()
+
+# Decode time above which pipelining the next page pays for its worker
+# handoff (~0.4 ms measured): full decodes of a 500-node page run ~10-20 ms
+# (pipeline on), tier-0 page reuse runs ~10 µs (pipeline off).  ≤ 0 forces
+# the pipeline always-on (test seam).
+_PREFETCH_MIN_DECODE_S = 0.001
+
+
+class _PrefetchSlot:
+    """Single-slot fetch/decode pipeline for one paginated walk.
+
+    While the caller thread decodes page N, the next page (whose continue
+    token was peeked from page N's raw bytes) is already in flight on ONE
+    persistent named daemon worker over the same pooled session (spawning
+    a thread per page costs ~0.5 ms × pages — real money once decode is
+    near-free).  One slot, by design: the walk is serial in tokens, so
+    deeper prefetch could only speculate.  ``take`` re-raises the fetch's
+    exception on the caller thread, so the 410-restart and retry/breaker
+    semantics are exactly the serial walk's.
+    """
+
+    def __init__(self, fetch):
+        self._fetch = fetch
+        self._requests: queue.Queue = queue.Queue(1)
+        self._results: queue.Queue = queue.Queue(1)
+        self._worker = None
+        self._pending = None
+
+    def _run(self) -> None:
+        while True:
+            params = self._requests.get()
+            if params is _PREFETCH_STOP:
+                return
+            try:
+                outcome = ("resp", self._fetch(params))
+            except BaseException as exc:  # tnc: allow-broad-except(carried to the caller thread and re-raised by take())
+                outcome = ("exc", exc)
+            self._results.put(outcome)
+
+    def start(self, params: dict) -> None:
+        self.discard()
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._run, name="tnc-list-prefetch", daemon=True
+            )
+            self._worker.start()
+        self._pending = params
+        self._requests.put(params)
+
+    def take(self, params: dict):
+        """The response for ``params`` — the pending prefetch when it was
+        started for exactly these params, a fresh inline fetch otherwise."""
+        if self._pending == params:
+            self._pending = None
+            kind, value = self._results.get()
+            if kind == "exc":
+                raise value
+            return value
+        self.discard()
+        return self._fetch(params)
+
+    def discard(self) -> None:
+        """Drop any pending fetch (walk restart, mispeeked token, walk
+        end): wait out the in-flight request and swallow its outcome — a
+        discarded response is never consumed, a discarded error never
+        raised."""
+        if self._pending is not None:
+            self._pending = None
+            self._results.get()
+
+    def close(self) -> None:
+        """End the worker (walk over).  Any pending fetch is discarded
+        first so the stop sentinel is the queue's next item."""
+        self.discard()
+        if self._worker is not None:
+            self._requests.put(_PREFETCH_STOP)
+            self._worker = None
+
+
 class KubeClient:
     """Just enough Kubernetes API for this tool: one LIST, plus an opt-in
     PATCH for ``--cordon-failed``.
@@ -778,6 +869,17 @@ class KubeClient:
 
     def __init__(self, config: ClusterConfig, session=None):
         self.config = config
+        # LIST-truncation counters by resource (no-silent-caps rule): a
+        # walk that exhausted its page budget with the continue token
+        # still set lost its tail — surfaced via transport_stats →
+        # payload.api_transport.list_truncated → the
+        # tpu_node_checker_api_list_truncated_total metric family.
+        self.truncations: dict = {}
+        self._trunc_lock = threading.Lock()
+        # Projection page cache (tpu_node_checker.fastpath), built on
+        # first projected LIST; lives with the client so the keep-alive
+        # client cache also carries the relist reuse state across rounds.
+        self._projector = None
         if session is None:
             # Stdlib transport by default (see _StdlibSession: requests'
             # import cost has no place on the latency budget).  Anything
@@ -792,59 +894,108 @@ class KubeClient:
         elif config.basic_auth:
             self._session.auth = config.basic_auth
 
-    # LIST page size.  kubectl's own chunk size: a 64-host slice still fits
-    # one request (the single-request fast path is unchanged — one GET, no
-    # continue token in the response), while a 5k-node mixed cluster streams
-    # in ~10 bounded bodies instead of one multi-hundred-MB response.
-    LIST_PAGE_LIMIT = 500
+    # LIST page size.  Was 500 (kubectl's chunk size) through PR 9, when
+    # the per-page cost was DECODE-bound (~30 ms of json.loads per page);
+    # with projection decode + page reuse the walk is ROUND-TRIP-bound
+    # (~2 ms turnaround per request vs microseconds of decode), so larger
+    # pages halve what a relist actually waits on.  1000 keeps bodies
+    # ~1 MB — bounded memory, same etcd range-read shape — while a 64-host
+    # slice still fits one request (the single-request fast path is
+    # unchanged: one GET, no continue token in the response).
+    LIST_PAGE_LIMIT = 1000
 
     def _paged_list(
-        self, path: str, params: dict, timeout: float, max_pages: int
-    ) -> Tuple[List[dict], Optional[str], Optional[str]]:
+        self, path: str, params: dict, timeout: float, max_pages: int,
+        decode_page=None,
+    ) -> Tuple[list, Optional[str], Optional[str]]:
         """Follow ``limit``/``continue`` for one GET list — the single
-        pagination walk both node and event LISTs share.
+        pagination walk both node and event LISTs share, PIPELINED: while
+        page N decodes on this thread, page N+1 (continue token peeked
+        from page N's raw bytes — ``fastpath.peek_continue``) is already
+        in flight on the prefetch slot.  The peek is trust-but-verify:
+        decode yields the authoritative token, and a mismatch discards the
+        speculative fetch instead of ever consuming a wrong page.
+
+        ``decode_page(resp, page_index) -> (items, meta)`` is the page
+        decoder — the projection path for node LISTs, the sanctioned
+        ``fastpath.oracle_decode_page`` otherwise; no full-body
+        ``json.loads`` lives on this walk (tnc-lint TNC018).
 
         Returns ``(items, leftover_continue, resource_version)``:
         ``leftover_continue`` is non-None iff ``max_pages`` was exhausted
-        with the token still set (the caller decides whether that is fatal
-        or a stderr note); ``resource_version`` is the list's
+        with the token still set (the caller surfaces the truncation —
+        never silently); ``resource_version`` is the list's
         ``metadata.resourceVersion`` — the point-in-time a subsequent
         ``watch`` resumes from.  A 410 Gone mid-walk (expired snapshot;
         status read from either the stdlib ClusterAPIError or a drop-in
         requests.HTTPError) restarts the walk from scratch once.
         """
-        for attempt in (0, 1):
-            page_params = dict(params)
-            items: List[dict] = []
-            rv: Optional[str] = None
-            try:
-                for _ in range(max_pages):
-                    resp = self._session.get(
-                        f"{self.config.server}{path}",
-                        params=page_params,
-                        timeout=timeout,
-                    )
-                    resp.raise_for_status()
-                    doc = resp.json()
-                    items.extend(doc.get("items") or [])
-                    meta = doc.get("metadata") or {}
-                    if meta.get("resourceVersion"):
-                        rv = str(meta["resourceVersion"])
-                    cont = meta.get("continue")
-                    if not cont:
-                        return items, None, rv
-                    page_params = dict(page_params, **{"continue": cont})
-                return items, page_params.get("continue"), rv
-            except Exception as exc:  # tnc: allow-broad-except(re-raised unless 410)
-                status = getattr(exc, "status_code", None)
-                if status is None:
-                    status = getattr(
-                        getattr(exc, "response", None), "status_code", None
-                    )
-                if attempt == 0 and status == 410 and page_params.get("continue"):
-                    continue  # expired token: one clean restart
-                raise
-        raise AssertionError("unreachable")  # pragma: no cover
+        from tpu_node_checker import fastpath
+
+        if decode_page is None:
+            decode_page = _oracle_page_decoder
+
+        def fetch(p):
+            return self._session.get(
+                f"{self.config.server}{path}", params=p, timeout=timeout
+            )
+
+        prefetch = _PrefetchSlot(fetch)
+        try:
+            for attempt in (0, 1):
+                page_params = dict(params)
+                items: list = []
+                rv: Optional[str] = None
+                # Prefetch pays only when decode is worth overlapping: a
+                # tier-0 page-reuse walk decodes in microseconds, and the
+                # worker handoff would cost ~0.4 ms/page of pure overhead.
+                # Adaptive: pipeline page N+1 iff page N-1's decode was
+                # slower than the handoff (cold walks, oracle mode, churn
+                # windows) — measured, not guessed.
+                decode_was_slow = _PREFETCH_MIN_DECODE_S <= 0
+                try:
+                    for page_idx in range(max_pages):
+                        resp = prefetch.take(page_params)
+                        resp.raise_for_status()
+                        peeked = fastpath.peek_continue(
+                            getattr(resp, "content", None)
+                        )
+                        if peeked and decode_was_slow and page_idx + 1 < max_pages:
+                            prefetch.start(
+                                dict(page_params, **{"continue": peeked})
+                            )
+                        decode_t0 = time.perf_counter()
+                        page_items, meta = decode_page(resp, page_idx)
+                        decode_was_slow = (
+                            time.perf_counter() - decode_t0
+                            > _PREFETCH_MIN_DECODE_S
+                        )
+                        items.extend(page_items)
+                        if meta.get("resourceVersion"):
+                            rv = str(meta["resourceVersion"])
+                        cont = meta.get("continue")
+                        if not cont:
+                            # Last page (or a mispeek that "found" a token
+                            # the metadata does not carry): nothing left.
+                            prefetch.discard()
+                            return items, None, rv
+                        page_params = dict(page_params, **{"continue": cont})
+                        if cont != peeked:
+                            prefetch.discard()
+                    return items, page_params.get("continue"), rv
+                except Exception as exc:  # tnc: allow-broad-except(re-raised unless 410)
+                    prefetch.discard()
+                    status = getattr(exc, "status_code", None)
+                    if status is None:
+                        status = getattr(
+                            getattr(exc, "response", None), "status_code", None
+                        )
+                    if attempt == 0 and status == 410 and page_params.get("continue"):
+                        continue  # expired token: one clean restart
+                    raise
+            raise AssertionError("unreachable")  # pragma: no cover
+        finally:
+            prefetch.close()
 
     def list_nodes(
         self,
@@ -872,11 +1023,14 @@ class KubeClient:
         label_selector: Optional[str] = None,
         timeout: float = DEFAULT_TIMEOUT_S,
         page_limit: Optional[int] = LIST_PAGE_LIMIT,
-    ) -> Tuple[List[dict], Optional[str]]:
+        decode_page=None,
+    ) -> Tuple[list, Optional[str]]:
         """:meth:`list_nodes` plus the list's ``metadata.resourceVersion`` —
         the seed a :meth:`watch_nodes` stream resumes from.  One walk, same
         pagination/410 semantics; ``resource_version`` is ``None`` when the
-        server reports none (offline fixtures)."""
+        server reports none (offline fixtures).  ``decode_page`` overrides
+        the page decoder (the projection fast path rides through here so
+        the params/bound/truncation handling cannot fork per caller)."""
         params = {}
         if label_selector:
             params["labelSelector"] = label_selector
@@ -884,17 +1038,57 @@ class KubeClient:
             params["limit"] = str(page_limit)
         # Bound the walk: per-request timeouts never bound a server that
         # keeps 200-ing with a non-advancing continue token.  1000 pages =
-        # half a million nodes at the default page size — far past any real
+        # a million nodes at the default page size — far past any real
         # cluster, so hitting the cap is a broken server, graded exit 1.
         items, leftover, rv = self._paged_list(
-            "/api/v1/nodes", params, timeout, max_pages=1000
+            "/api/v1/nodes", params, timeout, max_pages=1000,
+            decode_page=decode_page,
         )
         if leftover:
+            self._count_truncation("nodes")
             raise ClusterAPIError(
                 "LIST /api/v1/nodes did not terminate within 1000 pages "
                 "(non-advancing continue token?)"
             )
         return items, rv
+
+    def list_nodes_projected(
+        self,
+        label_selector: Optional[str] = None,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        page_limit: Optional[int] = LIST_PAGE_LIMIT,
+    ):
+        """The relist fast path: :meth:`list_nodes_with_rv` through the
+        projection decoder (``fastpath.ListProjector``) instead of a full
+        ``json.loads`` per page.
+
+        Returns a :class:`~tpu_node_checker.fastpath.ProjectedFleet` —
+        pruned grading-view docs plus per-node content digests — with
+        unchanged pages/byte-runs reused by reference from the previous
+        walk (the projector lives on this client, which the checker's
+        keep-alive client cache carries across rounds).  Pagination, the
+        410 restart, the 1000-page bound and the retry ladder are exactly
+        :meth:`list_nodes_with_rv`'s — it IS that walk, with the decoder
+        swapped.
+        """
+        from tpu_node_checker import fastpath
+
+        if self._projector is None:
+            self._projector = fastpath.ListProjector()
+        items, rv = self.list_nodes_with_rv(
+            label_selector=label_selector, timeout=timeout,
+            page_limit=page_limit, decode_page=self._projector.decode_page,
+        )
+        return fastpath.ProjectedFleet(
+            items, rv, self._projector.reuse,
+            pages=self._projector.take_walk_pages(),
+        )
+
+    @property
+    def projector_stats(self) -> Optional[dict]:
+        """The projection decoder's reuse counters (None before the first
+        projected LIST) — bench/test seam, not a payload surface."""
+        return self._projector.stats if self._projector is not None else None
 
     # A healthy-but-quiet watch stream with bookmarks enabled still ticks
     # about once a minute; silence past this long means the connection is
@@ -965,6 +1159,24 @@ class KubeClient:
         may be missing, and pretending otherwise would be worse.  Needs
         ``events: list`` RBAC (deploy/rbac.yaml).
         """
+        return self.list_node_events_paged(name, timeout=timeout, limit=limit)[0]
+
+    def list_node_events_paged(
+        self,
+        name: str,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        limit: int = EVENTS_PAGE_LIMIT,
+    ) -> Tuple[List[dict], bool]:
+        """:meth:`list_node_events` plus an explicit truncation verdict.
+
+        ``(items, truncated)`` — ``truncated`` is True when the walk
+        exhausted :data:`EVENTS_MAX_PAGES` with the continue token still
+        set, meaning the NEWEST events (etcd returns oldest-first) may be
+        missing from triage.  The shortfall is counted
+        (``transport_stats()['list_truncated']``) and noted on stderr;
+        the checker additionally stamps it into the payload's degradation
+        detail — a capped walk must never read as a complete one.
+        """
         params = {
             "fieldSelector": (
                 f"involvedObject.kind=Node,involvedObject.name={name}"
@@ -975,12 +1187,18 @@ class KubeClient:
             "/api/v1/events", params, timeout, max_pages=self.EVENTS_MAX_PAGES
         )
         if leftover:
+            self._count_truncation("events")
             print(
                 f"node {name}: event list exceeded {self.EVENTS_MAX_PAGES} "
                 "pages; the newest events may be missing from triage",
                 file=sys.stderr,
             )
-        return items
+        return items, bool(leftover)
+
+    def _count_truncation(self, resource: str) -> None:
+        # Locked: the per-sick-node events walks fan out across threads.
+        with self._trunc_lock:
+            self.truncations[resource] = self.truncations.get(resource, 0) + 1
 
     def set_retry_policy(self, policy) -> None:
         """Install (or clear) the graded retry policy on the transport.
@@ -1004,6 +1222,12 @@ class KubeClient:
         by_reason = getattr(self._session, "retries_by_reason", None)
         if isinstance(by_reason, dict) and by_reason:
             stats["retries_by_reason"] = dict(by_reason)
+        with self._trunc_lock:
+            if self.truncations:
+                # Only when a truncation actually happened: healthy rounds'
+                # payloads stay byte-identical to the pre-truncation-stat
+                # surface (pinned by the fast-path parity tests).
+                stats["list_truncated"] = dict(self.truncations)
         return stats
 
     def close(self) -> None:

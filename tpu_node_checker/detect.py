@@ -472,15 +472,30 @@ def select_accelerator_nodes(
 # --------------------------------------------------------------------------- #
 
 
+# Topology labels repeat fleet-wide (a 5k-node fleet carries a handful of
+# distinct values) but parse per node per round — 2ms of every relist tick
+# before this cache.  Bounded: label garbage must not grow it forever.
+_TOPOLOGY_CACHE: dict = {}
+_TOPOLOGY_CACHE_MAX = 1024
+_TOPOLOGY_MISS = object()
+
+
 def parse_topology(topology: Optional[str]) -> Optional[Tuple[int, ...]]:
     """Parse a GKE topology label value like ``"2x2x1"`` or ``"16x16"``."""
     if not topology or not isinstance(topology, str):
         return None
+    cached = _TOPOLOGY_CACHE.get(topology, _TOPOLOGY_MISS)
+    if cached is not _TOPOLOGY_MISS:
+        return cached
     try:
         dims = tuple(int(d) for d in topology.lower().split("x"))
     except ValueError:
-        return None
-    return dims if dims and all(d > 0 for d in dims) else None
+        dims = None
+    result = dims if dims and all(d > 0 for d in dims) else None
+    if len(_TOPOLOGY_CACHE) >= _TOPOLOGY_CACHE_MAX:
+        _TOPOLOGY_CACHE.clear()
+    _TOPOLOGY_CACHE[topology] = result
+    return result
 
 
 def topology_chip_count(topology: Optional[str]) -> Optional[int]:
@@ -715,37 +730,61 @@ def group_multislices(
     return sorted(by_group.values(), key=lambda m: m.group)
 
 
+def slice_group_key(info: NodeInfo) -> Optional[Tuple]:
+    """The slice-grouping key of one node — ``None`` for non-TPU nodes,
+    ``("__single__", name)`` for degenerate single-host slices, otherwise
+    ``(nodepool, accelerator, topology)``.  ONE definition, shared by
+    :func:`group_slices` and the watch-stream engine's incremental slice
+    cache, so the two can never group differently.
+    """
+    if not info.is_tpu:
+        return None
+    expected = topology_chip_count(info.tpu_topology)
+    if expected is not None and expected <= info.accelerators:
+        # Single-host slice type (topology fits on one host): every node
+        # is its own logical slice.  Grouping them by nodepool would let
+        # one Ready host mark a pool of dead ones "complete".
+        return ("__single__", info.name)
+    if info.tpu_topology is None and info.nodepool is None:
+        return ("__single__", info.name)
+    return (info.nodepool, info.tpu_accelerator, info.tpu_topology)
+
+
+def build_slice(key: Tuple, hosts: Sequence[NodeInfo]) -> SliceInfo:
+    """One slice group → its :class:`SliceInfo` (hosts in caller order)."""
+    first = hosts[0]
+    s = SliceInfo(
+        accelerator=first.tpu_accelerator,
+        topology=first.tpu_topology,
+        nodepool=first.nodepool,
+        single_host=key[0] == "__single__",
+    )
+    s.hosts.extend(hosts)
+    return s
+
+
+def sort_slices(slices) -> List[SliceInfo]:
+    """Deterministic slice order: by nodepool then first host name — the
+    payload-pinned ordering every builder (full or incremental) shares."""
+    return sorted(
+        slices,
+        key=lambda s: (s.nodepool or "", s.hosts[0].name if s.hosts else ""),
+    )
+
+
 def group_slices(infos: Sequence[NodeInfo]) -> List[SliceInfo]:
     """Group TPU nodes into logical slices by (nodepool, accelerator, topology).
 
     Nodes without TPU devices are ignored; TPU nodes without topology labels
     each form a degenerate single-host slice.
     """
-    by_key: Dict[Tuple, SliceInfo] = {}
+    by_key: Dict[Tuple, List[NodeInfo]] = {}
     for info in infos:
-        if not info.is_tpu:
+        key = slice_group_key(info)
+        if key is None:
             continue
-        expected = topology_chip_count(info.tpu_topology)
-        if expected is not None and expected <= info.accelerators:
-            # Single-host slice type (topology fits on one host): every node
-            # is its own logical slice.  Grouping them by nodepool would let
-            # one Ready host mark a pool of dead ones "complete".
-            key = ("__single__", info.name)
-        elif info.tpu_topology is None and info.nodepool is None:
-            key = ("__single__", info.name)
-        else:
-            key = (info.nodepool, info.tpu_accelerator, info.tpu_topology)
-        s = by_key.get(key)
-        if s is None:
-            s = by_key[key] = SliceInfo(
-                accelerator=info.tpu_accelerator,
-                topology=info.tpu_topology,
-                nodepool=info.nodepool,
-                single_host=key[0] == "__single__",
-            )
-        s.hosts.append(info)
-    # Deterministic order: by nodepool then first host name.
-    return sorted(
-        by_key.values(),
-        key=lambda s: (s.nodepool or "", s.hosts[0].name if s.hosts else ""),
-    )
+        hosts = by_key.get(key)
+        if hosts is None:
+            hosts = by_key[key] = []
+        hosts.append(info)
+    return sort_slices(build_slice(k, hosts) for k, hosts in by_key.items())

@@ -1,0 +1,36 @@
+"""Relist fast path: projection decoding + content-addressed node reuse.
+
+Public surface re-exported from :mod:`tpu_node_checker.fastpath.projection`;
+see that module's docstring for the cost model and the fallback contract
+(DESIGN.md §16).
+"""
+
+from tpu_node_checker.fastpath.projection import (
+    GRADING_PROJECTION,
+    ListProjector,
+    NodeReuseCache,
+    ProjectedFleet,
+    ProjectedNode,
+    ProjectionError,
+    grading_digest,
+    oracle_decode_page,
+    peek_continue,
+    project_node_doc,
+    projection_enabled,
+    reuse_allowed,
+)
+
+__all__ = [
+    "GRADING_PROJECTION",
+    "ListProjector",
+    "NodeReuseCache",
+    "ProjectedFleet",
+    "ProjectedNode",
+    "ProjectionError",
+    "grading_digest",
+    "oracle_decode_page",
+    "peek_continue",
+    "project_node_doc",
+    "projection_enabled",
+    "reuse_allowed",
+]

@@ -51,6 +51,9 @@ Exported metric families:
 * ``tpu_node_checker_api_{connections_opened,requests,requests_reused}_total``
   and ``tpu_node_checker_api_retries_total{reason}`` — k8s API transport
   lifecycle: sockets dialed, requests sent, keep-alive reuse, retry ladder;
+* ``tpu_node_checker_api_list_truncated_total{resource}`` — paginated LIST
+  walks whose page budget ran out with the continue token still set (the
+  listing's tail was silently absent before this counter existed);
 * ``tpu_node_checker_watch_breaker_open`` /
   ``tpu_node_checker_watch_breaker_consecutive_failures`` — watch-mode
   circuit-breaker state ("the monitor itself is degraded" is alertable
@@ -592,6 +595,21 @@ def render_metrics(
             "connection (no handshake paid).",
             [({}, transport.get("requests_reused", 0))],
         )
+        truncated = transport.get("list_truncated")
+        if truncated:
+            # No-silent-caps: a LIST walk that exhausted its page budget
+            # with the continue token still set lost its tail — per
+            # resource, so an events-triage shortfall and a node-LIST
+            # abort alert differently.  Absent entirely on healthy
+            # sessions (the payload omits the key at zero).
+            family(
+                "tpu_node_checker_api_list_truncated_total",
+                "counter",
+                "Paginated LIST walks that exhausted their page budget "
+                "with the continue token still set (the tail of the "
+                "listing was not fetched), by resource.",
+                [({"resource": r}, n) for r, n in sorted(truncated.items())],
+            )
         if "retries" in transport:
             # Graded-retry telemetry (utils/retry.py): a climbing series
             # means the API path is absorbing transient faults — the

@@ -156,13 +156,19 @@ def build_json_payload(
     slices: Sequence[SliceInfo],
     timings_ms: Optional[Dict[str, float]] = None,
     error: Optional[str] = None,
+    entries: Optional[List[dict]] = None,
 ) -> dict:
+    """``entries`` (the relist fast path) is the pre-built ``_node_entry``
+    list aligned with ``accel`` — cached entries are reused BY REFERENCE
+    for digest-unchanged nodes, so they must be byte-identical to what
+    ``_node_entry`` would rebuild (same function, same inputs; pinned by
+    the fast-path parity tests)."""
     payload = {
         "total_nodes": len(accel),
         "ready_nodes": len(ready),
         "total_chips": sum(n.accelerators for n in accel),
         "ready_chips": sum(n.accelerators for n in ready),
-        "nodes": [_node_entry(n) for n in accel],
+        "nodes": [_node_entry(n) for n in accel] if entries is None else entries,
         "slices": [s.to_dict() for s in slices],
     }
     if timings_ms is not None:

@@ -55,15 +55,20 @@ def grading_view(node: dict) -> tuple:
     """The grading-relevant projection of one raw node object.
 
     Everything ``detect.extract_node_info`` reads — name, labels,
-    annotations, spec (unschedulable/taints), allocatable/capacity, and
-    conditions MINUS their heartbeat timestamps.  Two nodes with equal
-    views grade identically, so a MODIFIED event whose view is unchanged
-    (a kubelet status heartbeat, a lease bump serialized onto the object)
-    updates the cache without dirtying the node — the property that keeps
-    steady-state ticks at O(changes) on a chatty API server.
+    annotations, ``spec.unschedulable``/``spec.taints`` (NOT the rest of
+    spec: podCIDR/providerID churn is invisible to grading), allocatable/
+    capacity, and conditions MINUS their heartbeat timestamps.  Two nodes
+    with equal views grade identically, so a MODIFIED event whose view is
+    unchanged (a kubelet status heartbeat, a lease bump serialized onto
+    the object) updates the cache without dirtying the node — the
+    property that keeps steady-state ticks at O(changes) on a chatty API
+    server.  This is also the preimage of the relist fast path's content
+    address (``fastpath.grading_digest``), so a raw watch-event object and
+    its projection-pruned twin hash identically by construction.
     """
     meta = node.get("metadata") if isinstance(node.get("metadata"), dict) else {}
     status = node.get("status") if isinstance(node.get("status"), dict) else {}
+    spec = node.get("spec") if isinstance(node.get("spec"), dict) else {}
     conditions = status.get("conditions")
     cond_sig: tuple = ()
     if isinstance(conditions, list):
@@ -81,7 +86,8 @@ def grading_view(node: dict) -> tuple:
         meta.get("name"),
         meta.get("labels"),
         meta.get("annotations"),
-        node.get("spec"),
+        spec.get("unschedulable"),
+        spec.get("taints"),
         status.get("allocatable"),
         status.get("capacity"),
         cond_sig,
@@ -145,40 +151,59 @@ class NodeCache:
     def __init__(self):
         self._lock = threading.Lock()
         self._nodes: Dict[str, dict] = {}
-        self._views: Dict[str, tuple] = {}
+        # name → 16-byte grading digest (fastpath.grading_digest): the one
+        # content address both a projected relist and a raw watch event
+        # produce, so seed-vs-apply comparisons never cross types.
+        self._views: Dict[str, bytes] = {}
         self._changed: Set[str] = set()
         self._removed: Set[str] = set()
         self.resource_version: Optional[str] = None
 
-    def seed(self, items: List[dict], resource_version: Optional[str]) -> None:
+    def seed(self, items, resource_version: Optional[str]) -> None:
         """Replace the cache with a fresh LIST, diffing against what was
         already held: only nodes that appeared, vanished, or changed their
         grading view land in the changed/removed sets — a relist after a
-        brief stream hiccup dirties (and later re-encodes) almost nothing."""
-        fresh: Dict[str, dict] = {}
-        fresh_views: Dict[str, tuple] = {}
-        for node in items:
-            meta = node.get("metadata") if isinstance(node.get("metadata"), dict) else {}
-            name = meta.get("name")
-            if not isinstance(name, str) or not name:
-                continue
-            fresh[name] = node
-            fresh_views[name] = grading_view(node)
+        brief stream hiccup dirties (and later re-encodes) almost nothing.
+
+        ``items`` is a :class:`~tpu_node_checker.fastpath.ProjectedFleet`
+        on the fast path (digests ride along — unchanged byte-runs carried
+        their digest by reference, so this loop hashes nothing), or a raw
+        node list (offline fixtures, drop-in clients), which is digested
+        here through the same one definition.
+        """
+        from tpu_node_checker.fastpath import ProjectedFleet, grading_digest
+
+        if isinstance(items, ProjectedFleet):
+            fresh, fresh_views = items.seed_maps()
+        else:
+            fresh = {}
+            fresh_views = {}
+            for node in items:
+                meta = node.get("metadata") if isinstance(node.get("metadata"), dict) else {}
+                name = meta.get("name")
+                if not isinstance(name, str) or not name:
+                    continue
+                fresh[name] = node
+                fresh_views[name] = grading_digest(node)
         with self._lock:
-            for name, view in fresh_views.items():
-                if self._views.get(name) != view:
-                    self._changed.add(name)
-                self._removed.discard(name)
-            for name in self._nodes:
-                if name not in fresh:
-                    self._removed.add(name)
-                    self._changed.discard(name)
+            # C-speed diffing (the relist hot path): names whose
+            # (name, digest) pair is new or different, and names that
+            # vanished — both as dict-view set operations, no Python loop
+            # over 5k unchanged nodes.
+            dirty = {name for name, _ in fresh_views.items() - self._views.items()}
+            gone = self._views.keys() - fresh_views.keys()
+            self._changed |= dirty
+            self._changed -= gone
+            self._removed -= fresh_views.keys()
+            self._removed |= gone
             self._nodes = fresh
             self._views = fresh_views
             self.resource_version = resource_version
 
     def apply(self, etype: str, obj: dict) -> None:
         """Fold one ADDED/MODIFIED/DELETED event into the cache."""
+        from tpu_node_checker.fastpath import grading_digest
+
         if not isinstance(obj, dict):
             return
         meta = obj.get("metadata") if isinstance(obj.get("metadata"), dict) else {}
@@ -186,7 +211,7 @@ class NodeCache:
         if not isinstance(name, str) or not name:
             return
         rv = meta.get("resourceVersion")
-        view = grading_view(obj) if etype != "DELETED" else None
+        view = grading_digest(obj) if etype != "DELETED" else None
         with self._lock:
             if rv:
                 self.resource_version = str(rv)
@@ -324,6 +349,15 @@ class StreamRoundEngine:
         self._entries_list: List[dict] = []
         self._last_result = None
         self._last_history_rollup: Optional[dict] = None
+        # Incremental slice cache (the relist fast path, one level up):
+        # group membership, SliceInfo objects and their payload dicts are
+        # rebuilt ONLY for groups touching a changed node — every other
+        # slice (and its serialized payload entry) is reused by reference,
+        # exactly like per-node entries.  None until the first full build.
+        self._slice_infos: Optional[Dict[tuple, object]] = None
+        self._slice_members: Dict[tuple, set] = {}
+        self._node_slice_key: Dict[str, tuple] = {}
+        self._slice_dicts: Dict[tuple, dict] = {}
 
     # -- stream lifecycle ----------------------------------------------------
 
@@ -360,8 +394,11 @@ class StreamRoundEngine:
         label_selector = getattr(self.args, "label_selector", None)
         if reason is not None:
             with timer.phase("list"):
-                items, rv = client.list_nodes_with_rv(label_selector=label_selector)
-            self.cache.seed(items, rv)
+                # The relist fast path: projection decode + page/byte-run
+                # reuse on the client's ListProjector, digests riding into
+                # the seed — a post-loss relist re-extracts O(changes).
+                fleet = client.list_nodes_projected(label_selector=label_selector)
+            self.cache.seed(fleet, fleet.resource_version)
             self.stats.count_relist(reason)
             self._seeded = True
         with timer.phase("watch_connect"):
@@ -372,8 +409,8 @@ class StreamRoundEngine:
             except WatchGone:
                 # The LIST's resourceVersion already expired (aggressive
                 # compaction): one more relist, then the connect must stick.
-                items, rv = client.list_nodes_with_rv(label_selector=label_selector)
-                self.cache.seed(items, rv)
+                fleet = client.list_nodes_projected(label_selector=label_selector)
+                self.cache.seed(fleet, fleet.resource_version)
                 self.stats.count_relist("gone")
                 stream = client.watch_nodes(
                     self.cache.resource_version, label_selector=label_selector
@@ -496,19 +533,91 @@ class StreamRoundEngine:
             self._entries_list = [self._entries[n] for n in self._accel_names]
         return frozenset(changed_names)
 
+    def _slices_incremental(self, changed: FrozenSet[str]):
+        """The round's slices, rebuilding only groups a changed node
+        touches (old group, new group, or both on a label move); every
+        other SliceInfo — and its cached payload dict — carries over by
+        reference.  Key/grouping/order semantics are detect.py's own
+        (``slice_group_key``/``build_slice``/``sort_slices``), so this can
+        never drift from a from-scratch ``group_slices``."""
+        from tpu_node_checker.detect import (
+            build_slice,
+            group_slices,
+            slice_group_key,
+            sort_slices,
+        )
+
+        if self._slice_infos is None:
+            # First (seed) build: one full pass, membership derived from it.
+            accel = [self._infos[n] for n in self._accel_names]
+            slices = group_slices(accel)
+            self._slice_infos = {}
+            self._slice_members = {}
+            self._node_slice_key = {}
+            self._slice_dicts = {}
+            for s in slices:
+                key = slice_group_key(s.hosts[0])
+                self._slice_infos[key] = s
+                self._slice_members[key] = {h.name for h in s.hosts}
+                for h in s.hosts:
+                    self._node_slice_key[h.name] = key
+            return slices
+        affected = set()
+        for name in changed:
+            old_key = self._node_slice_key.pop(name, None)
+            if old_key is not None:
+                affected.add(old_key)
+                members = self._slice_members.get(old_key)
+                if members is not None:
+                    members.discard(name)
+            info = self._infos.get(name)
+            key = slice_group_key(info) if info is not None else None
+            if key is not None:
+                self._node_slice_key[name] = key
+                self._slice_members.setdefault(key, set()).add(name)
+                affected.add(key)
+        for key in affected:
+            members = self._slice_members.get(key)
+            if not members:
+                self._slice_members.pop(key, None)
+                self._slice_infos.pop(key, None)
+                self._slice_dicts.pop(key, None)
+                continue
+            # Hosts in name order == the full build's accel order (the
+            # engine's accel list is name-sorted): byte-identical payloads.
+            hosts = [self._infos[n] for n in sorted(members)]
+            self._slice_infos[key] = build_slice(key, hosts)
+            self._slice_dicts.pop(key, None)  # re-rendered at payload time
+        return sort_slices(self._slice_infos.values())
+
+    def _slice_payload(self, slices) -> List[dict]:
+        """Payload dicts for ``slices`` — cached per group, re-rendered
+        only when the group was rebuilt (its cache entry was evicted)."""
+        from tpu_node_checker.detect import slice_group_key
+
+        out = []
+        for s in slices:
+            key = slice_group_key(s.hosts[0])
+            d = self._slice_dicts.get(key)
+            if d is None:
+                d = s.to_dict()
+                self._slice_dicts[key] = d
+            out.append(d)
+        return out
+
     def _build_result(self, timer, changed: FrozenSet[str]):
         """Assemble a fresh CheckResult over the cached fleet — the
         grading itself is ``checker.grade_fleet``, the SAME ladder
         ``run_check`` applies, so the two modes cannot drift; only the
         per-node work is amortized into the caches."""
         from tpu_node_checker import checker
-        from tpu_node_checker.detect import group_multislices, group_slices
+        from tpu_node_checker.detect import group_multislices
 
         accel = [self._infos[n] for n in self._accel_names]
         ready = [n for n in accel if n.ready and n.schedulable]
         effective_ready = [n for n in ready if n.effectively_ready]
         with timer.phase("slices"):
-            slices = group_slices(accel)
+            slices = self._slices_incremental(changed)
             multislices = group_multislices(
                 slices, getattr(self.args, "multislice_label", None) or ()
             )
@@ -522,7 +631,7 @@ class StreamRoundEngine:
                 "total_chips": sum(n.accelerators for n in accel),
                 "ready_chips": sum(n.accelerators for n in effective_ready),
                 "nodes": self._entries_list,
-                "slices": [s.to_dict() for s in slices],
+                "slices": self._slice_payload(slices),
             }
             if multislices:
                 payload["multislices"] = [m.to_dict() for m in multislices]
