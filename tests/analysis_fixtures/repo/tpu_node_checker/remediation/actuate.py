@@ -1,0 +1,20 @@
+"""Seeds for TNC019's sanctioned-module half: actuating functions here
+must take the budget ``decision`` and emit an audit event."""
+
+
+def cordon(client, decision, events):  # near-miss: decision + emit, clean
+    client.cordon_node(decision.node)
+    events.emit("remediation-cordon", node=decision.node)
+
+
+def cordon_unproven(client, events):  # EXPECT[TNC019]
+    client.cordon_node("gke-tpu-0")
+    events.emit("remediation-cordon", node="gke-tpu-0")
+
+
+def evict_silent(client, decision, namespace, pod):  # EXPECT[TNC019]
+    client.evict_pod(namespace, pod)
+
+
+def plan_only(decision, events):  # near-miss: no actuator call at all
+    events.emit("remediation-planned", node=decision.node)

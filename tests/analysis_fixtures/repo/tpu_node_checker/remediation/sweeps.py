@@ -1,0 +1,21 @@
+"""Seeds for TNC019's call-site half: actuator calls outside the
+sanctioned actuate module are findings wherever they hide."""
+
+
+def rogue_sweep(client, nodes):
+    for n in nodes:
+        client.cordon_node(n)  # EXPECT[TNC019]
+
+
+def rogue_lift(client, name):
+    client.uncordon_node(name)  # EXPECT[TNC019]
+
+
+def plan_cordon_nodes(nodes):  # near-miss: suffix differs (plural), no call
+    return [n for n in nodes if n.startswith("gke-")]
+
+
+def gated_sweep(client, decisions, actuate, events):
+    # near-miss: routed through the actuate module — the sanctioned shape.
+    for decision in decisions:
+        actuate.cordon(client, decision, events=events)

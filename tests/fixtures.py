@@ -845,3 +845,216 @@ def assert_poll_contract(flat, bijection=True):
             per_round_etags.setdefault((path, rnd), set()).add(etag)
         assert all(len(v) == 1 for v in per_round_etags.values())
     return rounds_seen
+
+
+# ---------------------------------------------------------------------------
+# Mass-failure storm harness (the first concrete slice of ROADMAP item 3's
+# chaos simulator; the remediation budget engine's acceptance surface —
+# DESIGN.md §17).  Deterministic by seed, replayable, driven against REAL
+# checker rounds and a REAL fixture apiserver whose request log is the
+# ground truth the storm invariants are asserted on.
+# ---------------------------------------------------------------------------
+
+
+class StormSchedule:
+    """Seeded mass-failure + flap storm over a multi-slice TPU fleet.
+
+    The fleet: ``slices`` multi-host slices of ``hosts_per_slice`` hosts ×
+    ``chips_per_host`` chips (topology label = the full slice, so every
+    slice is one failure domain).  The script:
+
+    * at ``fail_round``, ``fail_fraction`` of each slice's hosts fail
+      SIMULTANEOUSLY (probe verdict false) and stay failed — the mass
+      storm a blind per-cluster cordon cap turns into self-inflicted
+      capacity loss;
+    * ``flappers_per_slice`` additional hosts flip verdict every round
+      from round 0 — the churn the hysteresis/flap layers absorb.
+
+    Same seed ⇒ same fleet, same failed sets, same flappers: a failing
+    acceptance run replays exactly.
+    """
+
+    def __init__(self, seed: int = 0, slices: int = 2,
+                 hosts_per_slice: int = 4, chips_per_host: int = 4,
+                 fail_round: int = 1, fail_fraction: float = 0.75,
+                 flappers_per_slice: int = 1):
+        import random
+
+        rng = random.Random(seed)
+        self.seed = seed
+        self.fail_round = fail_round
+        self.chips_per_host = chips_per_host
+        self.topology = f"{chips_per_host}x{hosts_per_slice}"
+        self.by_slice: dict = {}
+        self.failed: set = set()
+        self.flappers: set = set()
+        for s in range(slices):
+            hosts = [f"storm-s{s}-h{h}" for h in range(hosts_per_slice)]
+            self.by_slice[f"storm-pool-{s}"] = hosts
+            n_fail = max(1, int(round(fail_fraction * len(hosts))))
+            failed = rng.sample(hosts, n_fail)
+            self.failed.update(failed)
+            healthy = [h for h in hosts if h not in failed]
+            self.flappers.update(
+                rng.sample(healthy, min(flappers_per_slice, len(healthy)))
+            )
+
+    def node_names(self) -> list:
+        return [h for hosts in self.by_slice.values() for h in hosts]
+
+    def nodes(self) -> list:
+        """The fleet as raw node dicts (one nodepool + topology per slice:
+        each slice is one failure domain under ``slice_group_key``)."""
+        out = []
+        for pool, hosts in sorted(self.by_slice.items()):
+            for name in hosts:
+                out.append(make_node(
+                    name,
+                    allocatable={"google.com/tpu": str(self.chips_per_host)},
+                    labels={
+                        "cloud.google.com/gke-tpu-accelerator":
+                            "tpu-v5p-slice",
+                        "cloud.google.com/gke-tpu-topology": self.topology,
+                        "cloud.google.com/gke-nodepool": pool,
+                    },
+                    taints=[TPU_TAINT],
+                ))
+        return out
+
+    def verdicts(self, round_i: int) -> dict:
+        """Per-host probe verdicts for one storm round."""
+        out = {}
+        for name in self.node_names():
+            ok = True
+            if name in self.failed and round_i >= self.fail_round:
+                ok = False
+            elif name in self.flappers:
+                ok = round_i % 2 == 0
+            out[name] = ok
+        return out
+
+
+def storm_apiserver(nodes: list, pods_by_node: Optional[dict] = None,
+                    pdb_protected: Optional[set] = None):
+    """A fixture apiserver whose REQUEST LOG is the storm's ground truth.
+
+    Serves the (mutable) node list with the shared paging protocol,
+    APPLIES cordon/uncordon PATCHes to it (so the next round's LIST — and
+    the budget engine's already-cordoned math — sees prior actuations,
+    exactly like a real apiserver), serves per-node pod lists, and answers
+    Eviction POSTs (429 for ``pdb_protected`` pods — the PDB refusal).
+    Returns ``(server, state)``; ``state["patches"]``/``state["evictions"]``
+    count actuations SERVER-SIDE — the acceptance invariants are asserted
+    on what the cluster actually received, never on the checker's
+    self-report.
+    """
+    import json as _json
+    import re as _re
+    from http.server import BaseHTTPRequestHandler
+    from urllib.parse import parse_qs, urlparse
+
+    state = {
+        "nodes": nodes,
+        "patches": [],
+        "evictions": [],
+        "pods_by_node": pods_by_node or {},
+        "pdb_protected": set(pdb_protected or ()),
+    }
+    evict_re = _re.compile(
+        r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/eviction$"
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, status: int, body: bytes):
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            if parsed.path == "/api/v1/nodes":
+                self._reply(200, _paged_nodelist_body(
+                    state["nodes"], self.path, None, resource_version="1"
+                ))
+                return
+            if parsed.path == "/api/v1/pods":
+                q = parse_qs(parsed.query)
+                selector = (q.get("fieldSelector") or [""])[0]
+                node = selector.rpartition("spec.nodeName=")[2]
+                items = state["pods_by_node"].get(node, [])
+                self._reply(200, _json.dumps(
+                    {"kind": "PodList", "items": items}
+                ).encode())
+                return
+            self._reply(200, b'{"kind": "List", "items": []}')
+
+        def do_PATCH(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = _json.loads(self.rfile.read(length))
+            name = self.path.rpartition("/")[2]
+            state["patches"].append({"node": name, "body": body})
+            for node in state["nodes"]:
+                if node["metadata"]["name"] != name:
+                    continue
+                spec = body.get("spec") or {}
+                if "unschedulable" in spec:
+                    if spec["unschedulable"]:
+                        node["spec"]["unschedulable"] = True
+                    else:
+                        node["spec"].pop("unschedulable", None)
+                annotations = (body.get("metadata") or {}).get("annotations")
+                if annotations:
+                    merged = node["metadata"].setdefault("annotations", {})
+                    for key, value in annotations.items():
+                        if value is None:  # strategic-merge null = delete
+                            merged.pop(key, None)
+                        else:
+                            merged[key] = value
+            self._reply(200, b"{}")
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(length)
+            m = evict_re.match(urlparse(self.path).path)
+            if not m:
+                self._reply(404, b'{"error": "not found"}')
+                return
+            namespace, pod = m.group(1), m.group(2)
+            if pod in state["pdb_protected"]:
+                # The Eviction API's PDB refusal: 429 Too Many Requests.
+                self._reply(429, _json.dumps({
+                    "kind": "Status", "status": "Failure",
+                    "reason": "TooManyRequests",
+                    "message": "Cannot evict pod as it would violate the "
+                               "pod's disruption budget.",
+                }).encode())
+                return
+            state["evictions"].append(
+                {"namespace": namespace, "pod": pod}
+            )
+            self._reply(201, b'{"kind": "Status", "status": "Success"}')
+
+        def log_message(self, *args):
+            pass
+
+    return serve_http(Handler), state
+
+
+def storm_available_by_slice(schedule: StormSchedule, nodes: list) -> dict:
+    """Per-slice AVAILABLE chips from the apiserver's live node state —
+    the floor invariant's ground truth (cordoned = out of the pool)."""
+    cordoned = {
+        n["metadata"]["name"]
+        for n in nodes
+        if n["spec"].get("unschedulable")
+    }
+    return {
+        pool: sum(
+            schedule.chips_per_host for h in hosts if h not in cordoned
+        )
+        for pool, hosts in schedule.by_slice.items()
+    }

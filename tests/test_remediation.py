@@ -1,0 +1,879 @@
+"""Remediation tier tests: the budget engine, drain, repair, leases —
+and the seeded mass-failure STORM acceptance matrix (DESIGN.md §17).
+
+The storm invariant, asserted end-to-end on the fixture apiserver's OWN
+request log (never the checker's self-report): under a scripted N-node
+simultaneous failure + flap storm the system never actuates past the
+disruption budget and never takes a slice below its healthy-chip floor,
+while every refusal is visible (denial records + counter + deduped Slack
+lines) — and with the aggregator killed mid-storm, checkers fall back to
+local budgets without exceeding the fleet budget they last leased.
+"""
+
+import json
+import time
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli, report
+from tpu_node_checker.detect import select_accelerator_nodes
+from tpu_node_checker.metrics import render_metrics
+from tpu_node_checker.remediation.budget import (
+    ActuationLedger,
+    BudgetEngine,
+    FleetLeaseBudget,
+    parse_disruption_budget,
+)
+from tpu_node_checker.remediation.lease import LeaseClient
+from tpu_node_checker.resources import default_registry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    """Engine/tracker state is process-cached for watch mode; tests must
+    never share a ledger (or lifetime denial counters) across cases."""
+    checker._REMEDIATION_CACHE["key"] = None
+    checker._REMEDIATION_CACHE["bundle"] = None
+    checker._HISTORY_CACHE["key"] = None
+    checker._HISTORY_CACHE["tracker"] = None
+    yield
+    checker._REMEDIATION_CACHE["key"] = None
+    checker._REMEDIATION_CACHE["bundle"] = None
+    checker._HISTORY_CACHE["key"] = None
+    checker._HISTORY_CACHE["tracker"] = None
+
+
+def _kubeconfig(tmp_path, port):
+    p = tmp_path / "kubeconfig"
+    p.write_text(
+        f"""
+apiVersion: v1
+kind: Config
+current-context: t
+contexts: [{{name: t, context: {{cluster: t, user: t}}}}]
+clusters: [{{name: t, cluster: {{server: "http://127.0.0.1:{port}"}}}}]
+users: [{{name: t, user: {{token: tok}}}}]
+"""
+    )
+    return str(p)
+
+
+def _write_reports(tmp_path, verdicts):
+    d = tmp_path / "probes"
+    d.mkdir(exist_ok=True)
+    for host, ok in verdicts.items():
+        (d / f"{host}.json").write_text(json.dumps({
+            "ok": ok,
+            "level": "compute",
+            "hostname": host,
+            "written_at": time.time(),
+            "error": None if ok else "matmul numerics failed",
+        }))
+    return str(d)
+
+
+def _accel(nodes):
+    accel, _ready = select_accelerator_nodes(nodes, default_registry())
+    return accel
+
+
+# ---------------------------------------------------------------------------
+# Units: budget parsing, ledger, decision ladder
+# ---------------------------------------------------------------------------
+
+
+class TestDisruptionBudgetParse:
+    def test_bare_count_is_per_round(self):
+        assert parse_disruption_budget("4") == (4, None)
+
+    @pytest.mark.parametrize("raw,window_s", [
+        ("4/30s", 30.0), ("4/10m", 600.0), ("2/1h", 3600.0),
+        ("1/1d", 86400.0), ("3/45", 45.0),
+    ])
+    def test_windows(self, raw, window_s):
+        count, window = parse_disruption_budget(raw)
+        assert window == window_s and count == int(raw.split("/")[0])
+
+    @pytest.mark.parametrize("raw", ["", "x", "0", "4/", "4/0", "4/10y",
+                                     "-1", "4/10m/2"])
+    def test_malformed_fails_loudly(self, raw):
+        with pytest.raises(ValueError):
+            parse_disruption_budget(raw)
+
+
+class TestActuationLedger:
+    def test_sliding_window(self):
+        clock = {"t": 0.0}
+        ledger = ActuationLedger(clock=lambda: clock["t"])
+        ledger.charge(2)
+        clock["t"] = 5.0
+        ledger.charge(1)
+        assert ledger.in_window(10.0) == 3
+        clock["t"] = 11.0  # the first charge (t=0) ages out
+        assert ledger.in_window(10.0) == 1
+        assert ledger.in_window(None) == 0  # no window = per-round math
+
+
+class TestBudgetEngine:
+    def _engine(self, accel, **kw):
+        engine = BudgetEngine(**kw)
+        engine.begin_round(accel, trace_id="t1")
+        return engine
+
+    def test_legacy_cordon_max_parity(self):
+        # enabled=False: exactly the old candidates[:budget] outcomes —
+        # grants in order until the total-cordoned-state cap, then
+        # cordon-max denials (now recorded, not silent).
+        accel = _accel(fx.tpu_v5p_64_slice())
+        engine = self._engine(accel, cordon_max=2, enabled=False)
+        verdicts = [engine.decide("cordon", n) for n in accel[:4]]
+        assert [d.allowed for d in verdicts] == [True, True, False, False]
+        assert all(d.reason == "cordon-max" for d in verdicts[2:])
+        assert engine.slice_floor_pct is None  # legacy mode: no floor
+        assert engine.denied_total == {"cordon-max": 2}
+
+    def test_slice_floor_refuses_the_nth_expendable_node(self):
+        # v5p-64: 16 hosts x 4 chips, one domain.  Floor 90% = 58 chips:
+        # the FIRST cordon (down to 60) passes, the second (56) refuses —
+        # each node individually expendable, the slice collectively not.
+        accel = _accel(fx.tpu_v5p_64_slice())
+        engine = self._engine(accel, slice_floor_pct=90.0, cordon_max=100)
+        first = engine.decide("cordon", accel[0])
+        second = engine.decide("cordon", accel[1])
+        assert first.allowed
+        assert not second.allowed and second.reason == "slice-floor"
+        assert "v5p-pool" in second.domain
+
+    def test_floor_counts_same_round_grants_before_any_patch(self):
+        # The grant itself (no PATCH applied, no flag flipped) must already
+        # shrink the domain the next candidate sees.
+        accel = _accel(fx.tpu_v5p_64_slice())
+        engine = self._engine(accel, slice_floor_pct=50.0, cordon_max=100)
+        allowed = [engine.decide("cordon", n).allowed for n in accel]
+        # 64 chips, floor 32: exactly 8 grants (down to 32), rest refused.
+        assert sum(allowed) == 8 and allowed[:8] == [True] * 8
+
+    def test_single_host_domains_exempt_from_floor(self):
+        accel = _accel(fx.tpu_v5e_single_host())
+        engine = self._engine(accel, slice_floor_pct=90.0, cordon_max=10)
+        assert engine.decide("cordon", accel[0]).allowed
+
+    def test_disruption_budget_spans_actions_and_windows(self):
+        clock = {"t": 0.0}
+        accel = _accel(fx.tpu_v5p_64_slice())
+        engine = BudgetEngine(budget=2, window_s=60.0, cordon_max=100,
+                              slice_floor_pct=1.0,
+                              clock=lambda: clock["t"])
+        engine.begin_round(accel)
+        d1, d2 = (engine.decide("cordon", n) for n in accel[:2])
+        assert d1.allowed and d2.allowed
+        d3 = engine.decide("cordon", accel[2])
+        assert not d3.allowed and d3.reason == "disruption-budget"
+        engine.commit(d1)
+        engine.commit(d2)
+        # Next round inside the window: still exhausted.
+        engine.begin_round(accel)
+        assert not engine.decide("cordon", accel[3]).allowed
+        # Past the window: permits return.
+        clock["t"] = 61.0
+        engine.begin_round(accel)
+        assert engine.decide("cordon", accel[3]).allowed
+
+    def test_dry_run_grants_never_age_into_the_window_ledger(self):
+        clock = {"t": 0.0}
+        accel = _accel(fx.tpu_v5p_64_slice())
+        engine = BudgetEngine(budget=1, window_s=60.0, cordon_max=100,
+                              slice_floor_pct=1.0,
+                              clock=lambda: clock["t"])
+        engine.begin_round(accel)
+        d = engine.decide("cordon", accel[0], dry_run=True)
+        assert d.allowed
+        engine.commit(d)  # dry-run commit is a no-op on the ledger
+        engine.begin_round(accel)
+        assert engine.decide("cordon", accel[0]).allowed
+
+    def test_capacity_restoring_actions_always_granted(self):
+        accel = _accel(fx.tpu_v5p_64_slice())
+        engine = self._engine(accel, budget=1, cordon_max=1)
+        assert engine.decide("uncordon", accel[0]).allowed
+        assert engine.decide("clear-annotation", accel[0]).allowed
+
+    def test_denial_fingerprint_dedupes_to_domain_reason(self):
+        accel = _accel(fx.tpu_v5p_64_slice())
+        engine = self._engine(accel, slice_floor_pct=99.0, cordon_max=100)
+        for n in accel:
+            engine.decide("cordon", n)
+        from tpu_node_checker.remediation.budget import (
+            denial_fingerprint,
+        )
+
+        # 15 refused nodes, ONE (domain, reason) pair.
+        assert len(engine.denials()) >= 10
+        assert len(denial_fingerprint(engine.denials())) == 1
+
+
+# ---------------------------------------------------------------------------
+# Units: lease client fallback semantics
+# ---------------------------------------------------------------------------
+
+
+class _FakeResp:
+    def __init__(self, status, doc):
+        self.status_code = status
+        self._doc = doc
+
+    def json(self):
+        return self._doc
+
+
+class _FakeSession:
+    def __init__(self, script):
+        self.script = list(script)
+        self.posts = []
+
+    def post(self, url, data=None, headers=None, timeout=None):
+        self.posts.append(json.loads(data))
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def close(self):
+        pass
+
+
+class TestLeaseClient:
+    def test_grant_denial_and_remaining_tracking(self):
+        session = _FakeSession([
+            _FakeResp(200, {"granted": True, "remaining": 2}),
+            _FakeResp(409, {"granted": False, "remaining": 0,
+                            "reason": "exhausted"}),
+        ])
+        lease = LeaseClient("http://agg", cluster="c1", session=session)
+        assert lease.acquire(1) == (True, "lease-granted")
+        assert lease.fleet_remaining == 2
+        assert lease.acquire(1) == (False, "lease-denied")
+        assert lease.fleet_remaining == 0
+        assert session.posts[0]["cluster"] == "c1"
+
+    def test_unreachable_never_exceeds_last_leased_allowance(self):
+        session = _FakeSession([
+            _FakeResp(200, {"granted": True, "remaining": 2}),
+            OSError("connection refused"),
+            OSError("connection refused"),
+            OSError("connection refused"),
+        ])
+        lease = LeaseClient("http://agg", session=session)
+        assert lease.acquire(1)[0]
+        # Aggregator dies: spend down the allowance it last confirmed…
+        assert lease.acquire(1) == (True, "lease-unreachable-local-budget")
+        assert lease.acquire(1) == (True, "lease-unreachable-local-budget")
+        # …and never past it.
+        assert lease.acquire(1) == (False, "lease-unreachable")
+
+    def test_never_reached_falls_back_to_local_budget_alone(self):
+        lease = LeaseClient(
+            "http://agg", session=_FakeSession([OSError("refused")])
+        )
+        assert lease.acquire(1) == (True, "lease-unreachable-local-budget")
+
+    def test_404_is_unreachable_not_a_denial(self):
+        # Older aggregator / no fleet budget configured: the protocol is
+        # additive — local budgets govern.
+        lease = LeaseClient(
+            "http://agg", session=_FakeSession([_FakeResp(404, {})])
+        )
+        granted, reason = lease.acquire(1)
+        assert granted and reason == "lease-unreachable-local-budget"
+
+
+class TestFleetLeaseBudget:
+    def test_grants_until_exhausted_then_409(self):
+        budget = FleetLeaseBudget(2, 60.0, clock=lambda: 0.0)
+        status, body = budget.grant({"count": 1, "cluster": "a"})
+        assert (status, body["granted"], body["remaining"]) == (200, True, 1)
+        status, body = budget.grant({"count": 2, "cluster": "b"})
+        assert status == 409 and not body["granted"]
+        status, body = budget.grant({"count": 1, "cluster": "b"})
+        assert status == 200 and body["remaining"] == 0
+
+    def test_bad_count_is_400(self):
+        budget = FleetLeaseBudget(2)
+        assert budget.grant({"count": 0})[0] == 400
+        assert budget.grant({"count": "x"})[0] == 400
+
+    def test_roundless_budget_resets_per_round(self):
+        budget = FleetLeaseBudget(1, None)
+        assert budget.grant({"count": 1})[0] == 200
+        assert budget.grant({"count": 1})[0] == 409
+        budget.reset_round()
+        assert budget.grant({"count": 1})[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# Units: repair tracker double-fire protection
+# ---------------------------------------------------------------------------
+
+
+class TestRepairTracker:
+    def test_restart_never_double_fires(self, tmp_path):
+        from tpu_node_checker.history.store import HistoryStore
+        from tpu_node_checker.remediation.repair import RepairTracker
+
+        store = HistoryStore(str(tmp_path / "h.jsonl"))
+        store.load()
+        tracker = RepairTracker(store)
+        assert not tracker.in_flight("n1")
+        tracker.mark_started("n1", "cmd")
+        store.flush()
+        assert tracker.in_flight("n1")
+        # Simulated restart: a fresh store + tracker reseed from disk.
+        store2 = HistoryStore(str(tmp_path / "h.jsonl"))
+        store2.load()
+        tracker2 = RepairTracker(store2)
+        assert tracker2.in_flight("n1")
+        tracker2.mark_succeeded("n1")
+        assert not tracker2.in_flight("n1")
+
+    def test_roll_up_ages_only_in_flight(self):
+        from tpu_node_checker.remediation.repair import RepairTracker
+
+        tracker = RepairTracker()
+        tracker.mark_started("n1", "cmd")
+        tracker.mark_started("n2", "cmd")
+        tracker.mark_failed("n2", "boom")
+        roll = tracker.roll_up()
+        assert roll["in_flight"] == ["n1"]
+        assert roll["fired_total"] == 2 and roll["failed_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI validation
+# ---------------------------------------------------------------------------
+
+
+class TestRemediationCli:
+    @pytest.mark.parametrize("argv,fragment", [
+        (["--slice-floor-pct", "50"], "requires --cordon-failed or --drain"),
+        (["--disruption-budget", "4"], "requires --cordon-failed or --drain"),
+        (["--disruption-lease", "http://x"],
+         "requires --cordon-failed or --drain"),
+        (["--drain-failed"], "requires --probe or --probe-results"),
+        (["--probe-results", "d", "--cordon-failed", "--drain-failed"],
+         "replaces --cordon-failed"),
+        (["--probe-results", "d", "--drain-failed", "--repair-cmd", "x"],
+         "require --history"),
+        (["--probe-results", "d", "--cordon-failed", "--history", "h",
+          "--repair-cmd", "x", "--repair-webhook", "y"],
+         "mutually exclusive"),
+        (["--fleet-disruption-budget", "4"], "requires --federate"),
+        (["--probe-results", "d", "--cordon-failed",
+          "--disruption-budget", "nope"], "malformed disruption budget"),
+        (["--probe-results", "d", "--cordon-failed",
+          "--slice-floor-pct", "0"], "must be in (0, 100]"),
+        (["--probe-results", "d", "--cordon-failed",
+          "--slice-floor-pct", "101"], "must be in (0, 100]"),
+        (["--federate", "e.json", "--serve", "0", "--drain-failed"],
+         "--federate runs no check rounds"),
+        (["--probe-results", "d", "--cordon-failed", "--no-drain-dry-run"],
+         "--no-drain-dry-run requires --drain-failed"),
+        (["--probe-results", "d", "--cordon-failed", "--history", "h",
+          "--no-repair-dry-run"],
+         "--no-repair-dry-run requires --repair-cmd"),
+    ])
+    def test_flag_validation(self, argv, fragment, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(argv)
+        assert fragment in capsys.readouterr().err
+
+    def test_repair_requires_history_and_actuator(self, capsys):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--probe-results", "d", "--history", "h",
+                            "--repair-cmd", "x"])
+        assert "require --cordon-failed or --drain-failed" in (
+            capsys.readouterr().err
+        )
+
+
+# ---------------------------------------------------------------------------
+# Regression pin: no remediation flags ⇒ byte-identical surface
+# ---------------------------------------------------------------------------
+
+
+class TestNoFlagsByteIdentity:
+    def test_plain_run_payload_and_metrics_carry_no_remediation(
+        self, tmp_path, capsys
+    ):
+        nodes_file = tmp_path / "nodes.json"
+        nodes_file.write_text(json.dumps(fx.node_list(fx.tpu_v5p_64_slice())))
+        reports = _write_reports(
+            tmp_path, {"gke-tpu-v5p-0": False, "gke-tpu-v5p-1": True}
+        )
+        args = cli.parse_args([
+            "--nodes-json", str(nodes_file),
+            "--probe-results", reports, "--json",
+        ])
+        result = checker.run_check(args)
+        for key in ("remediation", "drain", "repair", "cordon", "uncordon"):
+            assert key not in result.payload
+        assert "remediation" not in render_metrics(result)
+
+    def test_legacy_cordon_without_denials_is_unchanged(self, tmp_path):
+        # --cordon-failed with no cap hit: the engine ran (legacy alias)
+        # but the payload shape is exactly the pre-engine one.
+        nodes_file = tmp_path / "nodes.json"
+        nodes_file.write_text(json.dumps(fx.node_list(fx.tpu_v5p_64_slice())))
+        reports = _write_reports(tmp_path, {"gke-tpu-v5p-0": False})
+        args = cli.parse_args([
+            "--nodes-json", str(nodes_file),
+            "--probe-results", reports,
+            "--cordon-failed", "--cordon-dry-run", "--json",
+        ])
+        result = checker.run_check(args)
+        assert "remediation" not in result.payload
+        assert set(result.payload["cordon"]) == {
+            "dry_run", "cordoned", "failed", "already_cordoned",
+            "skipped_over_cap",
+        }
+        assert "remediation" not in render_metrics(result)
+
+    def test_legacy_cap_denial_becomes_visible(self, tmp_path):
+        # The no-silent-caps satellite: a --cordon-max refusal now carries
+        # an audit record and the denied_total counter.
+        nodes_file = tmp_path / "nodes.json"
+        nodes_file.write_text(json.dumps(fx.node_list(fx.tpu_v5p_64_slice())))
+        reports = _write_reports(
+            tmp_path, {"gke-tpu-v5p-0": False, "gke-tpu-v5p-1": False}
+        )
+        args = cli.parse_args([
+            "--nodes-json", str(nodes_file),
+            "--probe-results", reports,
+            "--cordon-failed", "--cordon-dry-run", "--json",
+        ])
+        result = checker.run_check(args)
+        block = result.payload["remediation"]
+        assert block["denied_total"] == {"cordon-max": 1}
+        assert block["denials"][0]["reason"] == "cordon-max"
+        assert result.payload["cordon"]["skipped_over_cap"] == [
+            "gke-tpu-v5p-1"
+        ]
+        text = render_metrics(result)
+        assert (
+            'tpu_node_checker_remediation_denied_total{reason="cordon-max"}'
+            " 1.0" in text
+        )
+
+
+# ---------------------------------------------------------------------------
+# The storm acceptance matrix (server-side counted)
+# ---------------------------------------------------------------------------
+
+
+def _storm_args(tmp_path, port, reports, extra):
+    return cli.parse_args([
+        "--kubeconfig", _kubeconfig(tmp_path, port),
+        "--probe-results", reports, "--json", *extra,
+    ])
+
+
+class TestStormInvariant:
+    def test_budget_and_floor_hold_under_mass_failure(self, tmp_path):
+        storm = fx.StormSchedule(seed=7, slices=2, hosts_per_slice=4,
+                                 chips_per_host=4, fail_round=1,
+                                 fail_fraction=0.75, flappers_per_slice=1)
+        server, state = fx.storm_apiserver(storm.nodes())
+        try:
+            port = server.server_address[1]
+            patches_per_round = []
+            last_payload = None
+            for round_i in range(6):
+                reports = _write_reports(tmp_path, storm.verdicts(round_i))
+                args = _storm_args(tmp_path, port, reports, [
+                    "--cordon-failed", "--cordon-max", "8",
+                    "--slice-floor-pct", "50", "--disruption-budget", "2",
+                ])
+                before = len(state["patches"])
+                result = checker.run_check(args)
+                last_payload = result.payload
+                patches_per_round.append(len(state["patches"]) - before)
+                # Floor invariant, from the SERVER's node state: no slice
+                # ever below 50% of its 16 chips.
+                for pool, chips in fx.storm_available_by_slice(
+                    storm, state["nodes"]
+                ).items():
+                    assert chips >= 8, (round_i, pool, chips)
+            # Budget invariant: never more than 2 actuations per round.
+            assert all(n <= 2 for n in patches_per_round), patches_per_round
+            # The storm DID actuate (bounded), and DID refuse (visibly).
+            assert sum(patches_per_round) == 4  # 2 per slice = the floors
+            block = last_payload["remediation"]
+            assert block["denials"], "storm refusals must be recorded"
+            assert set(block["denied_total"]) <= {
+                "slice-floor", "disruption-budget", "cordon-max"
+            }
+            assert "slice-floor" in block["denied_total"]
+            assert block["domains"]["at_floor"] == 2  # both slices pinned
+        finally:
+            server.shutdown()
+
+    def test_storm_denials_dedupe_for_slack(self, tmp_path):
+        storm = fx.StormSchedule(seed=3, slices=1, hosts_per_slice=4,
+                                 chips_per_host=4, fail_round=0,
+                                 fail_fraction=1.0, flappers_per_slice=0)
+        server, state = fx.storm_apiserver(storm.nodes())
+        try:
+            port = server.server_address[1]
+            fps = []
+            for round_i in range(2):
+                reports = _write_reports(tmp_path, storm.verdicts(round_i))
+                args = _storm_args(tmp_path, port, reports, [
+                    "--cordon-failed", "--cordon-max", "8",
+                    "--slice-floor-pct", "75",
+                ])
+                result = checker.run_check(args)
+                fps.append(checker._round_denials_fp(result))
+            # One (domain, reason) pair per standing condition — identical
+            # across rounds, so the watch loop's change fingerprint fires
+            # ONE alert for the whole storm, not one per round.
+            assert fps[0] == fps[1] and len(fps[0]) == 1
+            message = report.format_slack_message(
+                result.accel, result.ready, result.slices,
+                healthy=False,
+                remediation=result.payload["remediation"],
+            )
+            refusal_lines = [
+                line for line in message.splitlines()
+                if "remediation refused" in line
+            ]
+            # 3 refused nodes → ONE deduped line naming the domain.
+            assert len(refusal_lines) == 1
+            assert "storm-pool-0" in refusal_lines[0]
+        finally:
+            server.shutdown()
+
+
+class TestStormDrain:
+    def _pods(self):
+        def pod(name, owner_kind=None, mirror=False, grace=30):
+            meta = {"name": name, "namespace": "default"}
+            if owner_kind:
+                meta["ownerReferences"] = [{"kind": owner_kind, "name": "o"}]
+            if mirror:
+                meta["annotations"] = {"kubernetes.io/config.mirror": "x"}
+            return {
+                "metadata": meta,
+                "spec": {"terminationGracePeriodSeconds": grace},
+                "status": {"phase": "Running"},
+            }
+
+        return {
+            "storm-s0-h0": [pod("job-a", owner_kind="Job", grace=60),
+                            pod("ds-a", owner_kind="DaemonSet"),
+                            pod("mirror-a", mirror=True)],
+            "storm-s0-h1": [pod("pdb-a")],
+        }
+
+    def _storm(self):
+        return fx.StormSchedule(seed=1, slices=1, hosts_per_slice=4,
+                                chips_per_host=4, fail_round=0,
+                                fail_fraction=0.5, flappers_per_slice=0)
+
+    def test_dry_run_default_reports_blast_radius_without_acting(
+        self, tmp_path
+    ):
+        storm = self._storm()
+        storm.failed = {"storm-s0-h0", "storm-s0-h1"}  # the pod-bearing pair
+        server, state = fx.storm_apiserver(storm.nodes(),
+                                           pods_by_node=self._pods())
+        try:
+            reports = _write_reports(tmp_path, storm.verdicts(0))
+            args = _storm_args(tmp_path, server.server_address[1], reports, [
+                "--drain-failed", "--cordon-max", "8",
+                "--slice-floor-pct", "25",
+            ])
+            result = checker.run_check(args)
+            assert state["evictions"] == [] and state["patches"] == []
+            drain = result.payload["drain"]
+            assert drain["dry_run"] is True
+            assert sorted(drain["drained"]) == sorted(storm.failed)
+            # Grace accounting covers only evictable pods (60s for job-a;
+            # the DaemonSet and mirror pods are skipped like kubectl
+            # drain skips them).
+            assert drain["grace_seconds_total"] == 60 + 30
+        finally:
+            server.shutdown()
+
+    def test_live_drain_evicts_then_cordons_and_pdb_is_a_denial(
+        self, tmp_path
+    ):
+        storm = self._storm()
+        failed = sorted(storm.failed)
+        pods = self._pods()
+        # Make sure the two failed nodes are exactly the pod-bearing ones.
+        storm.failed = {"storm-s0-h0", "storm-s0-h1"}
+        failed = sorted(storm.failed)
+        server, state = fx.storm_apiserver(
+            storm.nodes(), pods_by_node=pods, pdb_protected={"pdb-a"},
+        )
+        try:
+            reports = _write_reports(tmp_path, storm.verdicts(0))
+            args = _storm_args(tmp_path, server.server_address[1], reports, [
+                "--drain-failed", "--no-drain-dry-run",
+                "--cordon-max", "8", "--slice-floor-pct", "25",
+            ])
+            result = checker.run_check(args)
+            # h0: one real eviction (job-a), then the cordon PATCH.
+            assert state["evictions"] == [
+                {"namespace": "default", "pod": "job-a"}
+            ]
+            patched = [p["node"] for p in state["patches"]]
+            assert patched == ["storm-s0-h0"]
+            drain = result.payload["drain"]
+            assert drain["drained"] == ["storm-s0-h0"]
+            assert drain["failed"] == []
+            # h1's PDB refusal: a budget denial (reason=pdb), NOT an error
+            # — and the node was NOT cordoned.
+            denials = result.payload["remediation"]["denials"]
+            assert {"action": "drain", "node": "storm-s0-h1",
+                    "reason": "pdb"}.items() <= denials[0].items()
+            assert "storm-s0-h1" not in patched
+            assert failed == ["storm-s0-h0", "storm-s0-h1"]
+        finally:
+            server.shutdown()
+
+
+class TestLeaseFallbackMidStorm:
+    def test_aggregator_killed_mid_storm_never_exceeds_last_lease(
+        self, tmp_path
+    ):
+        from tpu_node_checker.server.app import FleetStateServer
+
+        storm = fx.StormSchedule(seed=11, slices=2, hosts_per_slice=4,
+                                 chips_per_host=4, fail_round=0,
+                                 fail_fraction=1.0, flappers_per_slice=0)
+        api_server, state = fx.storm_apiserver(storm.nodes())
+        fleet = FleetLeaseBudget(3, 3600.0)
+        aggregator = FleetStateServer(0, lease=fleet.grant)
+        try:
+            agg_url = f"http://127.0.0.1:{aggregator.port}"
+            extra = [
+                "--cordon-failed", "--cordon-max", "8",
+                "--slice-floor-pct", "25", "--disruption-lease", agg_url,
+            ]
+            port = api_server.server_address[1]
+            reports = _write_reports(tmp_path, storm.verdicts(0))
+            result = checker.run_check(
+                _storm_args(tmp_path, port, reports, extra)
+            )
+            # Round 1: the fleet budget (3) bounded actuation, not the
+            # local caps (floor would have allowed 3 per slice = 6).
+            assert len(state["patches"]) == 3
+            lease_block = result.payload["remediation"]["lease"]
+            assert lease_block["granted"] == 3
+            assert result.payload["remediation"]["denied_total"][
+                "lease-denied"
+            ] >= 1
+            # Kill the aggregator mid-storm.
+            aggregator.close()
+            reports = _write_reports(tmp_path, storm.verdicts(1))
+            result = checker.run_check(
+                _storm_args(tmp_path, port, reports, extra)
+            )
+            # Fallback: local budgets govern, bounded by the last-leased
+            # fleet allowance (0 remaining) — NO further actuation.
+            assert len(state["patches"]) == 3
+            block = result.payload["remediation"]
+            assert block["denied_total"]["lease-unreachable"] >= 1
+            assert "unreachable" in block["lease"]
+        finally:
+            aggregator.close()
+            api_server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Repair hooks end-to-end (cmd channel, restart-proof)
+# ---------------------------------------------------------------------------
+
+
+class TestRepairSweep:
+    def test_repair_fires_once_and_survives_restart(self, tmp_path):
+        storm = fx.StormSchedule(seed=5, slices=1, hosts_per_slice=4,
+                                 chips_per_host=4, fail_round=0,
+                                 fail_fraction=0.25, flappers_per_slice=0)
+        server, state = fx.storm_apiserver(storm.nodes())
+        fired = tmp_path / "fired.log"
+        try:
+            port = server.server_address[1]
+            extra = [
+                "--cordon-failed", "--cordon-max", "8",
+                "--slice-floor-pct", "25",
+                "--history", str(tmp_path / "history.jsonl"),
+                "--repair-cmd", f'echo "$TNC_NODE" >> {fired}',
+                "--no-repair-dry-run",
+            ]
+            # Round 0: the failed node is condemned and cordoned (the
+            # quarantine annotation lands server-side).
+            reports = _write_reports(tmp_path, storm.verdicts(0))
+            checker.run_check(_storm_args(tmp_path, port, reports, extra))
+            assert len(state["patches"]) == 1
+            # Round 1: the node now reads quarantined-by-us → repair fires.
+            result = checker.run_check(
+                _storm_args(tmp_path, port, reports, extra)
+            )
+            (failed_node,) = storm.failed
+            assert fired.read_text().split() == [failed_node]
+            assert result.payload["repair"]["started"] == [failed_node]
+            # Round 2: in-flight — never double-fires.
+            result = checker.run_check(
+                _storm_args(tmp_path, port, reports, extra)
+            )
+            assert fired.read_text().split() == [failed_node]
+            assert result.payload["repair"]["started"] == []
+            roll = result.payload["remediation"]["repairs"]
+            assert roll["in_flight"] == [failed_node]
+            # Simulated restart: fresh process caches, state reseeds from
+            # the history store — STILL no double-fire.
+            checker._REMEDIATION_CACHE["key"] = None
+            checker._HISTORY_CACHE["key"] = None
+            result = checker.run_check(
+                _storm_args(tmp_path, port, reports, extra)
+            )
+            assert fired.read_text().split() == [failed_node]
+            assert result.payload["repair"]["started"] == []
+        finally:
+            server.shutdown()
+
+    def test_repair_dry_run_default_fires_nothing(self, tmp_path):
+        storm = fx.StormSchedule(seed=5, slices=1, hosts_per_slice=4,
+                                 chips_per_host=4, fail_round=0,
+                                 fail_fraction=0.25, flappers_per_slice=0)
+        server, state = fx.storm_apiserver(storm.nodes())
+        fired = tmp_path / "fired.log"
+        try:
+            port = server.server_address[1]
+            extra = [
+                "--cordon-failed", "--cordon-max", "8",
+                "--slice-floor-pct", "25",
+                "--history", str(tmp_path / "history.jsonl"),
+                "--repair-cmd", f'echo "$TNC_NODE" >> {fired}',
+            ]
+            reports = _write_reports(tmp_path, storm.verdicts(0))
+            checker.run_check(_storm_args(tmp_path, port, reports, extra))
+            result = checker.run_check(
+                _storm_args(tmp_path, port, reports, extra)
+            )
+            assert not fired.exists()
+            assert result.payload["repair"]["dry_run"] is True
+            assert result.payload["repair"]["started"] == list(storm.failed)
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Serving surfaces: the budget view and the lease endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestServingSurfaces:
+    def _get(self, port, path):
+        from tpu_node_checker.cluster import _StdlibSession
+
+        session = _StdlibSession()
+        try:
+            resp = session.get(f"http://127.0.0.1:{port}{path}", timeout=5)
+            return resp.status_code, json.loads(resp.content or b"{}")
+        finally:
+            session.close()
+
+    def _post(self, port, path, doc):
+        from tpu_node_checker.cluster import _StdlibSession
+
+        session = _StdlibSession()
+        try:
+            resp = session.post(
+                f"http://127.0.0.1:{port}{path}", data=json.dumps(doc),
+                headers={"Content-Type": "application/json"}, timeout=5,
+            )
+            return resp.status_code, json.loads(resp.content or b"{}")
+        finally:
+            session.close()
+
+    def test_remediation_view_404_until_published(self):
+        from tpu_node_checker.server.app import FleetStateServer
+
+        server = FleetStateServer(0)
+        try:
+            status, body = self._get(server.port, "/api/v1/remediation")
+            assert status == 404 and "not active" in body["error"]
+            server.publish_remediation({"enabled": True, "denials": []})
+            status, body = self._get(server.port, "/api/v1/remediation")
+            assert status == 200 and body["enabled"] is True
+            server.publish_remediation(None)  # flags dropped: back to 404
+            assert self._get(server.port, "/api/v1/remediation")[0] == 404
+        finally:
+            server.close()
+
+    def test_lease_endpoint_404_without_fleet_budget(self):
+        from tpu_node_checker.server.app import FleetStateServer
+
+        server = FleetStateServer(0)
+        try:
+            status, body = self._post(
+                server.port, "/api/v1/global/disruption-lease", {"count": 1}
+            )
+            assert status == 404
+            assert "no fleet disruption budget" in body["error"]
+        finally:
+            server.close()
+
+    def test_aggregator_wires_fleet_budget_from_flag(self, tmp_path):
+        from tpu_node_checker.federation.aggregator import FederationEngine
+
+        endpoints = tmp_path / "endpoints.json"
+        endpoints.write_text(json.dumps(
+            {"clusters": [{"name": "c1", "url": "http://127.0.0.1:1"}]}
+        ))
+        args = cli.parse_args([
+            "--federate", str(endpoints), "--serve", "0",
+            "--fleet-disruption-budget", "2/10m",
+        ])
+        engine = FederationEngine(args)
+        try:
+            assert engine.lease_budget is not None
+            assert engine.lease_budget.budget == 2
+            assert engine.lease_budget.window_s == 600.0
+            text = engine.render_metrics()
+            assert (
+                'tpu_node_checker_federation_lease_total{result="granted"} '
+                "0.0" in text
+            )
+            assert (
+                "tpu_node_checker_federation_fleet_budget_remaining 2.0"
+                in text
+            )
+        finally:
+            engine.close()
+
+    def test_lease_endpoint_grants_and_denies_over_http(self):
+        from tpu_node_checker.server.app import FleetStateServer
+
+        server = FleetStateServer(0, lease=FleetLeaseBudget(1, 3600.0).grant)
+        try:
+            status, body = self._post(
+                server.port, "/api/v1/global/disruption-lease",
+                {"count": 1, "cluster": "c1"},
+            )
+            assert (status, body["granted"]) == (200, True)
+            status, body = self._post(
+                server.port, "/api/v1/global/disruption-lease",
+                {"count": 1, "cluster": "c2"},
+            )
+            assert (status, body["granted"]) == (409, False)
+            assert "exhausted" in body["reason"]
+            status, _ = self._post(
+                server.port, "/api/v1/global/disruption-lease", {"count": -1}
+            )
+            assert status == 400
+        finally:
+            server.close()

@@ -472,7 +472,7 @@ class TestWatch:
         waits = []
         clock = {"t": 100.0}
 
-        def fake_run_check(args, tracer=None):
+        def fake_run_check(args, tracer=None, events=None):
             clock["t"] += 3.0  # the check itself costs 3 virtual seconds
             return checker.CheckResult(exit_code=0)
 
@@ -492,7 +492,7 @@ class TestWatch:
         waits = []
         clock = {"t": 0.0}
 
-        def fake_run_check(args, tracer=None):
+        def fake_run_check(args, tracer=None, events=None):
             clock["t"] += 25.0  # slower than the 10s interval
             return checker.CheckResult(exit_code=0)
 
@@ -752,7 +752,7 @@ class TestWatchBreaker:
         sent, waits = [], []
         script = list(script)
 
-        def fake_run_check(args, tracer=None):
+        def fake_run_check(args, tracer=None, events=None):
             if not script:
                 raise KeyboardInterrupt
             step = script.pop(0)
@@ -826,7 +826,7 @@ class TestWatchBreaker:
 
         script = ["fail", "fail", "fail"]
 
-        def fake_run_check(args, tracer=None):
+        def fake_run_check(args, tracer=None, events=None):
             if not script:
                 raise KeyboardInterrupt
             script.pop(0)

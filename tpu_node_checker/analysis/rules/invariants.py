@@ -431,6 +431,80 @@ class ListHotPathDecode(Rule):
                     )
 
 
+class ActuatorGate(Rule):
+    slug = "actuator-gate"
+    code = "TNC019"
+    doc = ("every actuator call site (cordon_node/uncordon_node/"
+           "clear_quarantine_annotation/evict_pod) lives in "
+           "remediation/actuate.py, reachable only through the budget "
+           "engine's Decision — and each actuating function there takes a "
+           "``decision`` parameter and emits an audit event")
+
+    _ACTUATORS = ("cordon_node", "uncordon_node",
+                  "clear_quarantine_annotation", "evict_pod")
+    _SANCTIONED = "tpu_node_checker/remediation/actuate.py"
+    # cluster.py DEFINES the client methods (their bodies call the raw
+    # transport, not each other) — definitions are not call sites.
+    _DEFINER = "tpu_node_checker/cluster.py"
+
+    def _actuator_calls(self, tree: ast.AST):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name is not None and name.split(".")[-1] in self._ACTUATORS:
+                    yield node, name
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package() or ctx.path == self._DEFINER:
+            return
+        if ctx.path != self._SANCTIONED:
+            for node, name in self._actuator_calls(ctx.tree):
+                yield self.finding(
+                    ctx.path, node,
+                    f"actuator call {name}() outside the budget-gated "
+                    "actuate module — route it through "
+                    "remediation.actuate so the Decision gate and the "
+                    "audit event cannot be skipped",
+                )
+            return
+        # Inside the sanctioned module: every function that actuates must
+        # carry the Decision (the proof the budget engine ran) and emit
+        # the audit event — an audit-free actuator is a silent one.
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, ast.FunctionDef):
+                continue
+            calls = [
+                name
+                for node in walk_skipping_nested_functions(func)
+                if isinstance(node, ast.Call)
+                and (name := call_name(node)) is not None
+                and name.split(".")[-1] in self._ACTUATORS
+            ]
+            if not calls:
+                continue
+            arg_names = {a.arg for a in func.args.args}
+            arg_names |= {a.arg for a in func.args.kwonlyargs}
+            if "decision" not in arg_names:
+                yield self.finding(
+                    ctx.path, func,
+                    f"{func.name}() calls {calls[0]}() without taking a "
+                    "'decision' parameter — the budget engine's Decision "
+                    "is the proof the gate ran",
+                )
+            emits = any(
+                isinstance(node, ast.Call)
+                and (name := call_name(node)) is not None
+                and name.split(".")[-1] in ("emit", "_audit")
+                for node in walk_skipping_nested_functions(func)
+            )
+            if not emits:
+                yield self.finding(
+                    ctx.path, func,
+                    f"{func.name}() actuates ({calls[0]}) but emits no "
+                    "audit event — every actuation is one event-log line",
+                )
+
+
 class TestWallClock(Rule):
     slug = "test-wall-clock"
     code = "TNC016"
@@ -471,5 +545,6 @@ RULES: List[Rule] = [
     ExitCode(),
     ObsDiscipline(),
     ListHotPathDecode(),
+    ActuatorGate(),
     TestWallClock(),
 ]

@@ -557,6 +557,112 @@ def _resolve_client(args, client):
 # (tests, embedders) rebuilds instead of riding a mis-tuned FSM.
 _HISTORY_CACHE: dict = {"key": None, "tracker": None}
 
+# Remediation bundle (budget engine + lease client + repair tracker),
+# cached across rounds for the same reason: the sliding-window actuation
+# ledger, the lifetime denied/action counters, and the last-leased fleet
+# allowance must all survive from round to round — a per-round engine
+# would re-grant a fresh window budget every interval.  Keyed by every
+# budget knob PLUS the round's data sources, so two different embedded
+# runs (tests) never share a ledger.
+_REMEDIATION_CACHE: dict = {"key": None, "bundle": None}
+
+
+def _remediation_enabled(args) -> bool:
+    """True when any of the NEW remediation flags is present — the switch
+    between legacy --cordon-max-only budgeting and the full engine
+    (slice floors, disruption budgets, leases).  The regression pin rides
+    on this: all-False means payload/metrics stay byte-identical."""
+    return bool(
+        getattr(args, "slice_floor_pct", None) is not None
+        or getattr(args, "disruption_budget", None)
+        or getattr(args, "drain_failed", False)
+        or getattr(args, "repair_cmd", None)
+        or getattr(args, "repair_webhook", None)
+        or getattr(args, "disruption_lease", None)
+    )
+
+
+def _round_events(args, events):
+    """The round's audit EventLog: the watch loop hands down the shared
+    Observability log (so ``--event-log`` captures remediation lines);
+    one-shot runs mint a stderr-only one with the same cluster-stamp
+    policy (explicit identity only)."""
+    if events is not None:
+        return events
+    from tpu_node_checker.obs.events import EventLog
+
+    cluster = (
+        getattr(args, "cluster_name", None)
+        or os.environ.get("TNC_CLUSTER_NAME")
+        or None
+    )
+    return EventLog(cluster=cluster)
+
+
+def _build_remediation(args, history, events=None) -> dict:
+    """Flags → ``{"engine", "tracker", "events"}`` (cached across rounds).
+
+    Always built when ANY actuator flag is on: in legacy mode (no new
+    remediation flags) the engine enforces exactly the old --cordon-max
+    semantics, with its denials made visible (audit event + counter)
+    instead of silently skipped.
+    """
+    from tpu_node_checker.remediation import (
+        BudgetEngine,
+        parse_disruption_budget,
+    )
+    from tpu_node_checker.remediation.repair import RepairTracker
+
+    events = _round_events(args, events)
+    budget_raw = getattr(args, "disruption_budget", None)
+    lease_url = getattr(args, "disruption_lease", None)
+    repair_on = bool(
+        getattr(args, "repair_cmd", None)
+        or getattr(args, "repair_webhook", None)
+    )
+    key = (
+        getattr(args, "slice_floor_pct", None),
+        budget_raw,
+        lease_url,
+        getattr(args, "cordon_max", 1),
+        bool(getattr(args, "drain_failed", False)),
+        repair_on,
+        os.path.abspath(args.history) if getattr(args, "history", None) else None,
+        getattr(args, "nodes_json", None),
+        getattr(args, "probe_results", None),
+        getattr(args, "kubeconfig", None),
+    )
+    if _REMEDIATION_CACHE["key"] == key:
+        bundle = _REMEDIATION_CACHE["bundle"]
+        bundle["events"] = bundle["engine"].events = events
+        return bundle
+    budget = window = None
+    if budget_raw:
+        budget, window = parse_disruption_budget(budget_raw)
+    lease = None
+    if lease_url:
+        from tpu_node_checker.remediation.lease import LeaseClient
+
+        name, _source = resolve_cluster_name(args)
+        lease = LeaseClient(lease_url, cluster=name)
+    engine = BudgetEngine(
+        slice_floor_pct=getattr(args, "slice_floor_pct", None),
+        budget=budget,
+        window_s=window,
+        cordon_max=getattr(args, "cordon_max", 1) or 1,
+        lease=lease,
+        events=events,
+        enabled=_remediation_enabled(args),
+    )
+    tracker = (
+        RepairTracker(history["store"] if history is not None else None)
+        if repair_on
+        else None
+    )
+    bundle = {"engine": engine, "tracker": tracker, "events": events}
+    _REMEDIATION_CACHE["key"], _REMEDIATION_CACHE["bundle"] = key, bundle
+    return bundle
+
 
 def _build_history(args):
     """``--history FILE`` → ``{"store", "fsm"}`` (None when the flag is off).
@@ -730,7 +836,9 @@ def _history_payload(history: dict, accel: List[NodeInfo]) -> dict:
     }
 
 
-def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> dict:
+def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None,
+                              fsm=None, engine=None, events=None,
+                              trace_id=None) -> dict:
     """``--uncordon-recovered``: lift OUR quarantines once chips pass again.
 
     The closing half of the quarantine lifecycle.  A node qualifies only
@@ -796,15 +904,30 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None, fsm=None
         ]
         print(f"--uncordon-recovered: cannot reach cluster: {exc}", file=sys.stderr)
         return report_entry
+    from tpu_node_checker.remediation import actuate
     from tpu_node_checker.utils.fanout import bounded_map
 
+    engine = _ensure_engine(args, accel, engine, trace_id)
     workers = _api_concurrency(args)
+    # Uncordons restore capacity: the budget engine always grants them,
+    # but routing the PATCH through the actuate module keeps the audit
+    # trail (and the TNC019 call-site invariant) uniform.
+    decisions = {
+        n.name: engine.decide("uncordon", n) for n in candidates
+    }
     # Bounded parallel PATCHes (one pooled connection per worker); outcomes
     # come back in candidate order, so report lists and stderr notes stay
     # deterministic.  A dead-socket PATCH is NEVER transparently retried by
     # the transport (it may have applied) — it lands here as a failure note.
     for n, (ok, err) in zip(
-        candidates, bounded_map(lambda n: client.uncordon_node(n.name), candidates, workers)
+        candidates,
+        bounded_map(
+            lambda n: actuate.uncordon(
+                client, decisions[n.name], events=events, trace_id=trace_id
+            ),
+            candidates,
+            workers,
+        ),
     ):
         if not ok:
             report_entry["failed"].append({"node": n.name, "error": str(err)})
@@ -812,11 +935,22 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None, fsm=None
         else:
             n.cordoned = False
             n.quarantined_by_us = False
+            engine.commit(decisions[n.name])
             report_entry["uncordoned"].append(n.name)
             print(f"Uncordoned {n.name} (chip probe recovered).", file=sys.stderr)
+    stale_decisions = {
+        n.name: engine.decide("clear-annotation", n) for n in stale
+    }
     for n, (ok, err) in zip(
         stale,
-        bounded_map(lambda n: client.clear_quarantine_annotation(n.name), stale, workers),
+        bounded_map(
+            lambda n: actuate.clear_annotation(
+                client, stale_decisions[n.name], events=events,
+                trace_id=trace_id,
+            ),
+            stale,
+            workers,
+        ),
     ):
         if not ok:
             report_entry["failed"].append({"node": n.name, "error": str(err)})
@@ -825,6 +959,7 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None, fsm=None
             )
         else:
             n.quarantined_by_us = False
+            engine.commit(stale_decisions[n.name])
             report_entry["stale_annotations_cleared"].append(n.name)
             print(
                 f"Cleared stale quarantine annotation on {n.name} "
@@ -834,7 +969,90 @@ def _uncordon_recovered_nodes(args, accel: List[NodeInfo], client=None, fsm=None
     return report_entry
 
 
-def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> dict:
+def _ensure_engine(args, accel, engine, trace_id=None):
+    """Sweeps invoked directly (tests, embedders) without a round-owned
+    engine still get the legacy --cordon-max gate — never a crash, never
+    an ungated actuation."""
+    if engine is not None:
+        return engine
+    from tpu_node_checker.remediation import BudgetEngine
+
+    engine = BudgetEngine(
+        cordon_max=getattr(args, "cordon_max", 1) or 1, enabled=False
+    )
+    engine.begin_round(accel, trace_id=trace_id)
+    return engine
+
+
+def _failed_candidates(accel: List[NodeInfo], fsm=None) -> List[NodeInfo]:
+    """The evidence rules for the cordon AND drain sweeps — one definition,
+    so the two actuators can never disagree about who is condemnable:
+    kubelet-Ready, schedulable, not already cordoned, carrying a REAL
+    failed probe report this round (``level="missing"`` is absence, not
+    evidence), FSM-gated (FAILED/CHRONIC) under ``--history``."""
+    if fsm is None:
+        return [
+            n
+            for n in accel
+            if n.ready
+            and n.schedulable  # dead-plugin nodes must not consume the budget
+            and not n.cordoned
+            and n.probe is not None
+            and not n.probe.get("ok")
+            and n.probe.get("level") != "missing"  # absent report ≠ dead chips
+        ]
+    return [
+        n
+        for n in accel
+        if n.ready
+        and n.schedulable
+        and not n.cordoned
+        and n.probe is not None
+        and n.probe.get("level") != "missing"
+        and fsm.cordon_eligible(n.name)
+    ]
+
+
+def _drain_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None,
+                        engine=None, events=None, trace_id=None) -> dict:
+    """``--drain-failed``: evict-then-cordon the condemned nodes.
+
+    Same candidates as the cordon sweep (one evidence definition), same
+    budget gate, but the actuation is the civilized sequence: Eviction-API
+    POSTs (PDBs get their vote — a refusal is a budget denial with
+    ``reason="pdb"``, never an error), then the cordon PATCH.  Dry-run is
+    the DEFAULT (``--no-drain-dry-run`` opts into real evictions); dry
+    runs still LIST the node's pods so the report shows the real blast
+    radius (pod list + summed termination grace).
+    """
+    from tpu_node_checker.remediation.drain import drain_nodes
+
+    engine = _ensure_engine(args, accel, engine, trace_id)
+    candidates = _failed_candidates(accel, fsm)
+    dry_run = bool(getattr(args, "drain_dry_run", True))
+    if not candidates:
+        return {"dry_run": dry_run, "drained": [], "failed": [],
+                "pods_evicted": 0, "grace_seconds_total": 0}
+    try:
+        client = _resolve_client(args, client)
+    except Exception as exc:  # tnc: allow-broad-except(drain is best-effort, like cordoning)
+        print(f"--drain-failed: cannot reach cluster: {exc}", file=sys.stderr)
+        return {
+            "dry_run": dry_run,
+            "drained": [],
+            "failed": [
+                {"node": n.name, "error": f"no cluster client: {exc}"}
+                for n in candidates
+            ],
+            "pods_evicted": 0,
+            "grace_seconds_total": 0,
+        }
+    return drain_nodes(args, candidates, client, engine, events=events,
+                       trace_id=trace_id)
+
+
+def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None,
+                         engine=None, events=None, trace_id=None) -> dict:
     """``--cordon-failed``: mark probe-failed nodes unschedulable.
 
     Auto-quarantine for the one failure mode only this tool can see — a
@@ -866,34 +1084,27 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> 
     The evidence rule survives the swap: a PATCH still requires a real
     probe report this round (``level="missing"`` is absence, not evidence).
     """
-    if fsm is None:
-        candidates = [
-            n
-            for n in accel
-            if n.ready
-            and n.schedulable  # dead-plugin nodes must not consume the budget
-            and not n.cordoned
-            and n.probe is not None
-            and not n.probe.get("ok")
-            and n.probe.get("level") != "missing"  # absent report ≠ dead chips
-        ]
-    else:
-        candidates = [
-            n
-            for n in accel
-            if n.ready
-            and n.schedulable
-            and not n.cordoned
-            and n.probe is not None
-            and n.probe.get("level") != "missing"
-            and fsm.cordon_eligible(n.name)
-        ]
+    engine = _ensure_engine(args, accel, engine, trace_id)
+    candidates = _failed_candidates(accel, fsm)
     cap = getattr(args, "cordon_max", 1)
     already = sum(1 for n in accel if n.cordoned)
-    budget = max(0, cap - already)
-    to_cordon, capped = candidates[:budget], candidates[budget:]
+    dry_run = bool(getattr(args, "cordon_dry_run", False))
+    # The budget engine has the only veto left: the Nth grant that would
+    # exceed --cordon-max (the legacy alias), take a slice below its
+    # floor, or exhaust the disruption budget/lease is refused — recorded
+    # as an audit event and a denied_total sample, never a silent skip.
+    to_cordon, decisions, capped = [], {}, []
+    for n in candidates:
+        decision = engine.decide("cordon", n, dry_run=dry_run)
+        if decision.allowed:
+            to_cordon.append(n)
+            decisions[n.name] = decision
+        elif decision.reason == "cordon-max":
+            capped.append(n)
+        # Other refusals (slice-floor / disruption-budget / lease) live in
+        # the engine's denial list, surfaced via payload["remediation"].
     report_entry: dict = {
-        "dry_run": bool(getattr(args, "cordon_dry_run", False)),
+        "dry_run": dry_run,
         "cordoned": [],
         "failed": [],
         "already_cordoned": already,
@@ -908,10 +1119,18 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> 
         )
     if not to_cordon:
         return report_entry
-    if report_entry["dry_run"]:
+    if dry_run:
         report_entry["cordoned"] = sorted(n.name for n in to_cordon)
         for n in to_cordon:
             print(f"[dry-run] would cordon {n.name} (chip probe failed)", file=sys.stderr)
+            if events is not None:
+                events.emit(
+                    "remediation-cordon",
+                    trace_id=trace_id,
+                    node=n.name,
+                    domain=decisions[n.name].domain,
+                    dry_run=True,
+                )
         return report_entry
     try:
         client = _resolve_client(args, client)
@@ -921,19 +1140,27 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None) -> 
         ]
         print(f"--cordon-failed: cannot reach cluster: {exc}", file=sys.stderr)
         return report_entry
+    from tpu_node_checker.remediation import actuate
     from tpu_node_checker.utils.fanout import bounded_map
 
     # Bounded parallel PATCHes, results consumed in candidate order (see
     # _uncordon_recovered_nodes for the ordering/retry rationale).
     for n, (ok, err) in zip(
         to_cordon,
-        bounded_map(lambda n: client.cordon_node(n.name), to_cordon, _api_concurrency(args)),
+        bounded_map(
+            lambda n: actuate.cordon(
+                client, decisions[n.name], events=events, trace_id=trace_id
+            ),
+            to_cordon,
+            _api_concurrency(args),
+        ),
     ):
         if not ok:
             report_entry["failed"].append({"node": n.name, "error": str(err)})
             print(f"Cordon of {n.name} failed: {err}", file=sys.stderr)
         else:
             n.cordoned = True
+            engine.commit(decisions[n.name])
             report_entry["cordoned"].append(n.name)
             print(f"Cordoned {n.name} (chip probe failed).", file=sys.stderr)
     return report_entry
@@ -1022,7 +1249,7 @@ def stamp_expected_chips(payload: dict, expected_key, expected_n, have_chips) ->
 
 
 def run_check(args, nodes: Optional[List[dict]] = None,
-              tracer=None) -> CheckResult:
+              tracer=None, events=None) -> CheckResult:
     """Pure-ish core of the run: everything except printing and Slack I/O
     gating decisions is computed here so tests can drive it directly.
 
@@ -1030,7 +1257,9 @@ def run_check(args, nodes: Optional[List[dict]] = None,
     trace.Tracer` — the check's phases become spans on the SAME trace the
     caller's publish span and debug ring share; without one, a fresh
     tracer is minted (one-shot mode), and either way the payload carries
-    the round's ``trace_id``.
+    the round's ``trace_id``.  ``events`` (watch mode) is the shared
+    Observability event log the remediation audit lines ride; without one
+    a stderr-only log is minted on demand.
     """
     timer = tracer if tracer is not None else PhaseTimer()
     kube_client = None
@@ -1102,23 +1331,59 @@ def run_check(args, nodes: Optional[List[dict]] = None,
     )
 
     cordon_report = uncordon_report = None
-    if getattr(args, "cordon_failed", False) or getattr(args, "uncordon_recovered", False):
+    drain_report = repair_report = None
+    remediation = None
+    actuation = (
+        getattr(args, "cordon_failed", False)
+        or getattr(args, "uncordon_recovered", False)
+        or getattr(args, "drain_failed", False)
+        or getattr(args, "repair_cmd", None)
+        or getattr(args, "repair_webhook", None)
+    )
+    if actuation:
         # Before render, so payload["nodes"] reflects post-cordon state.
+        # EVERY actuator below rides the budget engine's decision function
+        # (tnc-lint TNC019): the evidence rules pick candidates, budgets
+        # have the only remaining veto, and each decision — grant, denial,
+        # drain, repair — is one audit event joinable to this round's
+        # trace.
+        remediation = _build_remediation(args, history, events)
+        engine, audit = remediation["engine"], remediation["events"]
+        engine.begin_round(accel, trace_id=timer.trace_id)
+        fsm = history["fsm"] if history is not None else None
         with timer.phase("cordon"):
-            fsm = history["fsm"] if history is not None else None
             if getattr(args, "uncordon_recovered", False):
                 # Uncordon FIRST: a recovered node leaving quarantine frees
                 # --cordon-max budget for this round's new failures.
                 uncordon_report = _uncordon_recovered_nodes(
-                    args, accel, client=kube_client, fsm=fsm
+                    args, accel, client=kube_client, fsm=fsm, engine=engine,
+                    events=audit, trace_id=timer.trace_id,
                 )
             if getattr(args, "cordon_failed", False):
                 cordon_report = _cordon_failed_nodes(
-                    args, accel, client=kube_client, fsm=fsm
+                    args, accel, client=kube_client, fsm=fsm, engine=engine,
+                    events=audit, trace_id=timer.trace_id,
+                )
+        if getattr(args, "drain_failed", False):
+            with timer.phase("drain"):
+                drain_report = _drain_failed_nodes(
+                    args, accel, client=kube_client, fsm=fsm, engine=engine,
+                    events=audit, trace_id=timer.trace_id,
+                )
+        if getattr(args, "repair_cmd", None) or getattr(
+            args, "repair_webhook", None
+        ):
+            from tpu_node_checker.remediation.repair import run_repairs
+
+            with timer.phase("repair"):
+                repair_report = run_repairs(
+                    args, accel, engine, remediation["tracker"], fsm=fsm,
+                    events=audit, trace_id=timer.trace_id,
                 )
     if history is not None:
         # Flush AFTER remediation: the persisted round already carries the
-        # out-of-band RECOVERING resets the sweep acted on.
+        # out-of-band RECOVERING resets the sweep acted on — and the
+        # repair sweep's own state lines.
         history["store"].flush()
 
     with timer.phase("render"):
@@ -1186,12 +1451,28 @@ def run_check(args, nodes: Optional[List[dict]] = None,
             payload["cordon"] = cordon_report
         if uncordon_report is not None:
             payload["uncordon"] = uncordon_report
+        if drain_report is not None:
+            payload["drain"] = drain_report
+        if repair_report is not None:
+            payload["repair"] = repair_report
+        if remediation is not None:
+            engine = remediation["engine"]
+            if engine.enabled or engine.ever_denied:
+                # The budget view: domains, floors, denials, counters —
+                # what /api/v1/remediation and the remediation_* metric
+                # families serve.  Legacy runs (no new flags) attach it
+                # only once a denial has occurred, so the no-flags payload
+                # stays byte-identical (the PR 3 --history rule).
+                payload["remediation"] = engine.payload_block()
         if history is not None:
             # Per-node state/streak/flaps already ride on each node entry
             # (NodeInfo.health); this is the fleet roll-up plus the round's
             # transition log — what Slack and the metrics families consume.
             payload["history"] = _history_payload(history, accel)
-        for phase_name, rep in (("cordon", cordon_report), ("uncordon", uncordon_report)):
+        for phase_name, rep in (("cordon", cordon_report),
+                                ("uncordon", uncordon_report),
+                                ("drain", drain_report),
+                                ("repair", repair_report)):
             failed = (rep or {}).get("failed")
             if failed:
                 degradation[phase_name] = [
@@ -1959,7 +2240,7 @@ def _api_write_decision(node: dict, action: str) -> tuple:
     return True, "Ready with passing probe"
 
 
-def _make_serve_control(args):
+def _make_serve_control(args, events=None):
     """The fleet API's write-path seam: decide over the snapshot, PATCH on
     a PRIVATE client.
 
@@ -2001,17 +2282,27 @@ def _make_serve_control(args):
         if dry_run:
             return 200, {**body, "would_apply": True}
         from tpu_node_checker.cluster import KubeClient, resolve_cluster_config
+        from tpu_node_checker.remediation import actuate
+        from tpu_node_checker.remediation.budget import Decision
 
         client = KubeClient(
             resolve_cluster_config(
                 getattr(args, "kubeconfig", None), getattr(args, "context", None)
             )
         )
+        # The API write path decided eligibility (evidence rules) and the
+        # --cordon-max budget above; the actuation itself still rides the
+        # actuate module with an explicit granted Decision, so the TNC019
+        # call-site invariant — and the per-actuation audit event — hold
+        # on every path that touches a node.
+        decision = Decision(True, action, name, None, reason)
         try:
             if action == "cordon":
-                client.cordon_node(name)
+                actuate.cordon(client, decision, events=events,
+                               trace_id=snap.trace_id)
             else:
-                client.uncordon_node(name)
+                actuate.uncordon(client, decision, events=events,
+                                 trace_id=snap.trace_id)
         finally:
             client.close()
         if action == "cordon":
@@ -2183,6 +2474,10 @@ def watch(args) -> int:
     # resumed from a log that records only the code, or an error round).
     # Part of the change fingerprint so a same-code node swap still alerts.
     last_sick: Optional[tuple] = None
+    # The previous round's budget/lease denial fingerprint — one Slack
+    # alert per (domain, reason) per window, not one per refused node
+    # per round (same dedup clock the sick-set half rides).
+    last_denials: Optional[tuple] = None
     if on_change:
         # Resume across restarts: recover the last recorded outcome from the
         # trend log so a pod restart doesn't re-alert on an unchanged state.
@@ -2224,7 +2519,7 @@ def watch(args) -> int:
         fleet_server = FleetStateServer(
             args.serve,
             token=resolve_serve_token(getattr(args, "serve_token", None)),
-            control=_make_serve_control(args),
+            control=_make_serve_control(args, obs.events),
             trend_path=getattr(args, "log_jsonl", None),
             obs=obs,
             **_serve_pool_kwargs(args),
@@ -2278,7 +2573,9 @@ def watch(args) -> int:
                 if engine is not None:
                     result, delta = engine.tick(tracer=tracer)
                 else:
-                    result, delta = run_check(args, tracer=tracer), None
+                    result, delta = run_check(
+                        args, tracer=tracer, events=obs.events
+                    ), None
             except KeyboardInterrupt:
                 raise
             except Exception as exc:  # tnc: allow-broad-except(a bad round must not kill the daemon)
@@ -2309,7 +2606,7 @@ def watch(args) -> int:
                         consecutive_failures=breaker.consecutive_failures,
                         error=str(exc),
                     )
-                sick = None  # an error round observed no nodes
+                sick = denials = None  # an error round observed no nodes
                 changed = last_code is None or code != last_code
                 if webhook:
                     if transition == "opened":
@@ -2377,7 +2674,14 @@ def watch(args) -> int:
                             fleet_server.refresh_metrics(
                                 result, breaker=breaker.as_dict()
                             )
+                    # The budget view (GET /api/v1/remediation): swapped
+                    # per round like every other entity; absent payload
+                    # block clears it back to 404.
+                    fleet_server.publish_remediation(
+                        result.payload.get("remediation")
+                    )
                 sick = _round_sick_set(result)
+                denials = _round_denials_fp(result)
                 # Change fingerprint = exit code + sick-node set: a node
                 # swap inside an unchanged code is still a transition.  The
                 # set half compares only when both sides are known — after
@@ -2399,6 +2703,12 @@ def watch(args) -> int:
                     or code != last_code
                     or actionable
                     or (last_sick is not None and sick != last_sick)
+                    # A NEW (domain, reason) refusal — or one clearing —
+                    # is a transition; the same refusal repeating every
+                    # round of a standing storm is not.
+                    or (last_denials is None and bool(denials))
+                    or (last_denials is not None and denials is not None
+                        and denials != last_denials)
                 )
                 if transition == "closed":
                     print(
@@ -2434,6 +2744,7 @@ def watch(args) -> int:
                 )
             last_code = code
             last_sick = sick
+            last_denials = denials
             effective_interval = interval * breaker.interval_scale()
             if breaker.open:
                 print(
@@ -2464,6 +2775,18 @@ def watch(args) -> int:
             engine.close()
         if fleet_server is not None:
             fleet_server.close()
+
+
+def _round_denials_fp(result: CheckResult) -> tuple:
+    """Budget/lease denial fingerprint for ``--slack-on-change`` dedup —
+    one definition (remediation.budget.denial_fingerprint): a 30-node
+    storm inside one slice is ONE standing refusal, not 30 alerts, and
+    not a fresh alert per round while it persists."""
+    from tpu_node_checker.remediation.budget import denial_fingerprint
+
+    return denial_fingerprint(
+        (result.payload.get("remediation") or {}).get("denials") or []
+    )
 
 
 def _round_sick_set(result: CheckResult) -> tuple:
@@ -3182,6 +3505,8 @@ def render_and_notify(args, result: CheckResult, notify_enabled: bool = True) ->
             cordon=result.payload.get("cordon"),
             uncordon=result.payload.get("uncordon"),
             history=history,
+            drain=result.payload.get("drain"),
+            remediation=result.payload.get("remediation"),
         )
         sent = notify.send_slack_message(
             webhook,

@@ -366,6 +366,91 @@ def build_parser() -> argparse.ArgumentParser:
                         "is Ready with a fresh passing chip probe; human cordons "
                         "are never touched")
 
+    remediation = p.add_argument_group(
+        "Remediation & disruption budgets (slice-aware actuation limits)"
+    )
+    remediation.add_argument("--slice-floor-pct", type=float, default=None,
+                             metavar="PCT",
+                             help="refuse any cordon/drain that would take a "
+                             "failure domain (a multi-host TPU slice, keyed "
+                             "like the grading's slice grouping) below PCT%% "
+                             "of its expected healthy chips — even when each "
+                             "node individually looks expendable (default 90 "
+                             "once any remediation flag engages the budget "
+                             "engine; single-host domains are exempt); "
+                             "requires --cordon-failed or --drain-failed")
+    remediation.add_argument("--disruption-budget", metavar="N[/WINDOW]",
+                             help="cap disruptive actuations (cordon, drain, "
+                             "repair) at N per round, or N per sliding "
+                             "WINDOW (30s/10m/1h/1d) across rounds; refused "
+                             "actuations surface as audit events, "
+                             "remediation_denied_total samples and deduped "
+                             "Slack lines — a mass-failure storm degrades "
+                             "into bounded actuation plus visible refusals, "
+                             "never a self-inflicted capacity drain; "
+                             "requires --cordon-failed or --drain-failed")
+    remediation.add_argument("--drain-failed", action="store_true",
+                             help="drain (evict-then-cordon) condemned nodes "
+                             "instead of bare-cordoning them: pods are "
+                             "evicted through the Eviction API so "
+                             "PodDisruptionBudgets get their vote (a PDB "
+                             "refusal is a budget denial, reason=pdb, never "
+                             "an error), then the node is cordoned; same "
+                             "evidence rules as --cordon-failed (which it "
+                             "replaces — the two are mutually exclusive); "
+                             "DRY-RUN BY DEFAULT")
+    remediation.add_argument("--drain-dry-run", dest="drain_dry_run",
+                             action="store_true", default=True,
+                             help="with --drain-failed: report the eviction "
+                             "list and grace accounting without evicting "
+                             "anything (THE DEFAULT — --no-drain-dry-run "
+                             "opts into real evictions)")
+    remediation.add_argument("--no-drain-dry-run", dest="drain_dry_run",
+                             action="store_false",
+                             help="with --drain-failed: actually evict and "
+                             "cordon (overrides the default dry-run)")
+    remediation.add_argument("--repair-cmd", metavar="CMD",
+                             help="fire CMD (through the shell; TNC_NODE/"
+                             "TNC_DOMAIN/TNC_REASON/TNC_TRACE_ID in the "
+                             "environment) once per node the FSM condemns "
+                             "(FAILED/CHRONIC) while it sits in our "
+                             "quarantine; per-node repair state rides the "
+                             "--history store so a restart never "
+                             "double-fires; each firing charges the "
+                             "disruption budget; DRY-RUN BY DEFAULT; "
+                             "requires --history and an actuator flag")
+    remediation.add_argument("--repair-webhook", metavar="URL",
+                             help="like --repair-cmd but POST the repair "
+                             "facts ({node, domain, reason, trace_id}) as "
+                             "JSON to URL (mutually exclusive with "
+                             "--repair-cmd)")
+    remediation.add_argument("--repair-dry-run", dest="repair_dry_run",
+                             action="store_true", default=True,
+                             help="with --repair-cmd/--repair-webhook: log "
+                             "which repairs would fire without firing them "
+                             "(THE DEFAULT — --no-repair-dry-run opts in)")
+    remediation.add_argument("--no-repair-dry-run", dest="repair_dry_run",
+                             action="store_false",
+                             help="with --repair-cmd/--repair-webhook: "
+                             "actually fire the hooks")
+    remediation.add_argument("--disruption-lease", metavar="URL",
+                             help="borrow each actuation from the federation "
+                             "aggregator's fleet disruption budget first "
+                             "(POST URL/api/v1/global/disruption-lease): a "
+                             "lease denial is a local refusal; an "
+                             "unreachable aggregator falls back to the "
+                             "LOCAL budget, additionally bounded by the "
+                             "fleet allowance last leased — degrading "
+                             "toward less actuation, never more; requires "
+                             "--cordon-failed or --drain-failed")
+    remediation.add_argument("--fleet-disruption-budget", metavar="N[/WINDOW]",
+                             help="with --federate: the fleet-wide actuation "
+                             "budget the aggregator grants disruption "
+                             "leases against (N per merge round, or N per "
+                             "sliding WINDOW); without it the lease "
+                             "endpoint answers 404 and checkers fall back "
+                             "to their local budgets")
+
     # Same group/flags/defaults as the reference (check-gpu-node.py:304-309).
     slack = p.add_argument_group("Slack")
     slack.add_argument("--slack-webhook", help="Slack incoming-webhook URL (or $SLACK_WEBHOOK_URL)")
@@ -486,6 +571,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             ("--uncordon-recovered", args.uncordon_recovered),
             ("--cordon-max", args.cordon_max is not None),
             ("--cordon-dry-run", args.cordon_dry_run),
+            ("--drain-failed", args.drain_failed),
+            ("--repair-cmd", args.repair_cmd),
+            ("--repair-webhook", args.repair_webhook),
+            ("--disruption-budget", args.disruption_budget),
+            ("--disruption-lease", args.disruption_lease),
+            ("--slice-floor-pct", args.slice_floor_pct is not None),
             ("--serve-token", args.serve_token),
             ("--write-rps", args.write_rps is not None),
             ("--json", args.json),
@@ -701,8 +792,61 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             "--report-fresh runs alone (no --emit-probe/--probe/--watch/"
             "--probe-results/--cordon-failed/--uncordon-recovered)"
         )
+    # Remediation & disruption budgets: every budget knob needs an actuator
+    # it can gate, every hook needs the state that stops double-firing —
+    # the silent-no-op rule, applied to the subsystem whose whole job is
+    # making actuation visible.
+    if args.cordon_failed and args.drain_failed:
+        p.error("--drain-failed replaces --cordon-failed (evict-then-cordon "
+                "instead of a bare PATCH) — pass one, not both")
+    from tpu_node_checker.remediation.budget import parse_disruption_budget
+
+    for flag, raw in (
+        ("--disruption-budget", args.disruption_budget),
+        ("--fleet-disruption-budget", args.fleet_disruption_budget),
+    ):
+        if raw is not None:
+            try:
+                parse_disruption_budget(raw)
+            except ValueError as exc:
+                p.error(f"{flag}: {exc}")
+    if args.slice_floor_pct is not None and not (
+        0 < args.slice_floor_pct <= 100
+    ):
+        p.error("--slice-floor-pct must be in (0, 100]")
+    actuator = args.cordon_failed or args.drain_failed
+    for flag, on in (
+        ("--slice-floor-pct", args.slice_floor_pct is not None),
+        ("--disruption-budget", args.disruption_budget),
+        ("--disruption-lease", args.disruption_lease),
+    ):
+        if on and not actuator:
+            p.error(f"{flag} requires --cordon-failed or --drain-failed "
+                    "(a budget with no actuator gates nothing)")
+    if args.repair_cmd and args.repair_webhook:
+        p.error("--repair-cmd and --repair-webhook are mutually exclusive "
+                "(one repair channel per checker)")
+    if args.repair_cmd or args.repair_webhook:
+        if not args.history:
+            p.error("--repair-cmd/--repair-webhook require --history FILE "
+                    "(repair state rides the store so a restart never "
+                    "double-fires)")
+        if not actuator:
+            p.error("--repair-cmd/--repair-webhook require --cordon-failed "
+                    "or --drain-failed (repairs fire on quarantined nodes)")
+    if not args.drain_dry_run and not args.drain_failed:
+        # The silent-no-op rule: arming real evictions with no drain sweep
+        # would let an operator believe draining is live.
+        p.error("--no-drain-dry-run requires --drain-failed")
+    if not args.repair_dry_run and not (args.repair_cmd or args.repair_webhook):
+        p.error("--no-repair-dry-run requires --repair-cmd or "
+                "--repair-webhook")
+    if args.fleet_disruption_budget and not args.federate:
+        p.error("--fleet-disruption-budget requires --federate (the fleet "
+                "budget lives on the aggregator tier)")
     for flag, on in (
         ("--cordon-failed", args.cordon_failed),
+        ("--drain-failed", args.drain_failed),
         ("--uncordon-recovered", args.uncordon_recovered),
     ):
         if on and not (args.probe or args.probe_results):
@@ -713,6 +857,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             # emit-probe mode never runs the check, so the flag would
             # silently do nothing (same rule as --probe-soak/--probe-distributed).
             p.error(f"{flag} cannot be combined with --emit-probe")
+    if args.emit_probe:
+        for flag, on in (
+            ("--repair-cmd", args.repair_cmd),
+            ("--repair-webhook", args.repair_webhook),
+            ("--disruption-budget", args.disruption_budget),
+            ("--disruption-lease", args.disruption_lease),
+            ("--slice-floor-pct", args.slice_floor_pct is not None),
+            ("--fleet-disruption-budget", args.fleet_disruption_budget),
+        ):
+            if on:
+                p.error(f"{flag} cannot be combined with --emit-probe")
     if args.emit_probe:
         for flag, on in (
             ("--slack-webhook", args.slack_webhook),
@@ -736,10 +891,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             p.error("--node-events cannot be combined with --emit-probe")
     if args.cordon_max is not None and args.cordon_max < 1:
         p.error("--cordon-max must be at least 1")
-    if args.cordon_max is not None and not (args.cordon_failed or args.serve is not None):
+    if args.cordon_max is not None and not (
+        args.cordon_failed or args.drain_failed or args.serve is not None
+    ):
         # --serve counts too: the fleet API's cordon endpoint shares the
         # same total-cordoned-state budget as the sweep.
-        p.error("--cordon-max requires --cordon-failed or --serve")
+        p.error("--cordon-max requires --cordon-failed, --drain-failed "
+                "or --serve")
     if args.cordon_dry_run and not (args.cordon_failed or args.uncordon_recovered):
         p.error("--cordon-dry-run requires --cordon-failed or --uncordon-recovered")
     if args.cordon_max is None:
@@ -804,6 +962,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                 ("--node-events", args.node_events),
                 ("--cordon-failed", args.cordon_failed),
                 ("--uncordon-recovered", args.uncordon_recovered),
+                ("--drain-failed", args.drain_failed),
+                ("--repair-cmd", args.repair_cmd),
+                ("--repair-webhook", args.repair_webhook),
+                ("--disruption-budget", args.disruption_budget),
+                ("--disruption-lease", args.disruption_lease),
+                ("--slice-floor-pct", args.slice_floor_pct is not None),
                 ("--strict-slices", args.strict_slices),
                 ("--expected-chips", args.expected_chips),
                 ("--nodes-json", args.nodes_json),

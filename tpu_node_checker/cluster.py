@@ -555,6 +555,13 @@ class _StdlibSession:
     def patch(self, url, data=None, headers=None, timeout=None):
         return self._request("PATCH", url, data=data, headers=headers, timeout=timeout)
 
+    def post(self, url, data=None, headers=None, timeout=None):
+        """Non-idempotent POST (Eviction API, disruption leases, repair
+        webhooks): rides the same pooled transport and retry ladder as
+        PATCH — transparent retry only when the request provably never
+        left the socket."""
+        return self._request("POST", url, data=data, headers=headers, timeout=timeout)
+
 
 @dataclass
 class ClusterConfig:
@@ -1283,6 +1290,69 @@ class KubeClient:
             {"metadata": {"annotations": {QUARANTINE_ANNOTATION: None}}},
             timeout,
         )
+
+    # Pods-per-node walk bound: a TPU host runs a handful of pods; one page
+    # is the steady state and 10 pages (2500 pods) is far past any node.
+    PODS_PAGE_LIMIT = 250
+    PODS_MAX_PAGES = 10
+
+    def list_node_pods(
+        self, name: str, timeout: float = DEFAULT_TIMEOUT_S
+    ) -> List[dict]:
+        """Pods scheduled on one node — the drain actuator's eviction list.
+
+        ``GET /api/v1/pods`` with a server-side ``spec.nodeName`` field
+        selector, paged through the same walk the node LIST uses.  Needs
+        ``pods: list`` RBAC (deploy/rbac.yaml).  A walk that exhausts its
+        page budget is counted (``list_truncated``) like any other capped
+        LIST — a drain must never silently believe it saw every pod.
+        """
+        params = {
+            "fieldSelector": f"spec.nodeName={name}",
+            "limit": str(self.PODS_PAGE_LIMIT),
+        }
+        items, leftover, _rv = self._paged_list(
+            "/api/v1/pods", params, timeout, max_pages=self.PODS_MAX_PAGES
+        )
+        if leftover:
+            self._count_truncation("pods")
+            print(
+                f"node {name}: pod list exceeded {self.PODS_MAX_PAGES} "
+                "pages; the drain's eviction list may be incomplete",
+                file=sys.stderr,
+            )
+        return items
+
+    def evict_pod(
+        self,
+        namespace: str,
+        name: str,
+        grace_seconds: Optional[int] = None,
+        timeout: float = DEFAULT_TIMEOUT_S,
+    ) -> None:
+        """``POST .../pods/{name}/eviction`` — the polite delete.
+
+        The Eviction subresource gives PodDisruptionBudgets their vote: a
+        409/429 refusal surfaces as :class:`ClusterAPIError` with the
+        status code attached, which the drain actuator maps to a budget
+        denial (``reason="pdb"``), never an error.  Requires the
+        ``create`` verb on ``pods/eviction`` (deploy/rbac.yaml).
+        """
+        body: dict = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": name, "namespace": namespace},
+        }
+        if grace_seconds is not None:
+            body["deleteOptions"] = {"gracePeriodSeconds": int(grace_seconds)}
+        resp = self._session.post(
+            f"{self.config.server}/api/v1/namespaces/{namespace}/pods/"
+            f"{name}/eviction",
+            data=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+            timeout=timeout,
+        )
+        resp.raise_for_status()
 
     def _patch_node(self, name: str, body: dict, timeout: float) -> None:
         resp = self._session.patch(

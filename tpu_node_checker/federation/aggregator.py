@@ -108,6 +108,22 @@ class FederationEngine:
         from tpu_node_checker.obs.events import EventLog
 
         self._events = obs.events if obs is not None else EventLog()
+        # Federated disruption budgets (--fleet-disruption-budget): the
+        # aggregator owns ONE fleet-wide actuation window; per-cluster
+        # checkers borrow against it through the lease endpoint.  None =
+        # endpoint answers 404 and checkers use their local budgets.
+        self.lease_budget = None
+        raw = getattr(args, "fleet_disruption_budget", None)
+        if raw:
+            from tpu_node_checker.remediation.budget import (
+                FleetLeaseBudget,
+                parse_disruption_budget,
+            )
+
+            count, window = parse_disruption_budget(raw)
+            self.lease_budget = FleetLeaseBudget(
+                count, window, events=self._events
+            )
         self.last_tracer = None
         self.seq = 0
         self.views: Dict[str, ClusterView] = {}
@@ -313,6 +329,9 @@ class FederationEngine:
 
         t0 = time.monotonic()
         self.seq += 1
+        if self.lease_budget is not None:
+            # Window-less fleet budgets are per merge round.
+            self.lease_budget.reset_round()
         # One trace per merge round: per-cluster fetch spans (on the
         # fetcher threads, args carry the cluster), then merge and publish
         # on the round thread, then each upstream round's own spans
@@ -533,6 +552,26 @@ class FederationEngine:
             "# TYPE tpu_node_checker_last_run_timestamp_seconds gauge",
             _line("tpu_node_checker_last_run_timestamp_seconds", time.time()),
         ]
+        if self.lease_budget is not None:
+            lines += [
+                "# HELP tpu_node_checker_federation_lease_total Disruption "
+                "leases served, by result (granted counts permits, denied "
+                "counts refused requests).",
+                "# TYPE tpu_node_checker_federation_lease_total counter",
+                _line("tpu_node_checker_federation_lease_total",
+                      float(self.lease_budget.granted_total),
+                      {"result": "granted"}),
+                _line("tpu_node_checker_federation_lease_total",
+                      float(self.lease_budget.denied_total),
+                      {"result": "denied"}),
+                "# HELP tpu_node_checker_federation_fleet_budget_remaining "
+                "Actuation permits left in the fleet disruption budget's "
+                "current window/round.",
+                "# TYPE tpu_node_checker_federation_fleet_budget_remaining "
+                "gauge",
+                _line("tpu_node_checker_federation_fleet_budget_remaining",
+                      float(self.lease_budget.remaining())),
+            ]
         return "\n".join(lines) + "\n"
 
     def close(self) -> None:
@@ -567,6 +606,8 @@ def federate(args) -> int:
         federation=True,
         readiness=engine.readiness,
         obs=obs,
+        lease=(engine.lease_budget.grant
+               if engine.lease_budget is not None else None),
         **checker._serve_pool_kwargs(args),
     )
     requested_workers = getattr(args, "serve_workers", None) or 1

@@ -103,7 +103,30 @@ Exported metric families:
   of routed-path fleet-API request latency (replaces the
   ``tpu_node_checker_api_server_request_latency_ms`` pseudo-summary,
   which remains one release as a deprecated alias derived from the merged
-  histogram).
+  histogram);
+* ``tpu_node_checker_remediation_denied_total{reason}`` — actuations the
+  budget engine refused, by reason (``cordon-max``, ``slice-floor``,
+  ``disruption-budget``, ``pdb``, ``lease-denied``,
+  ``lease-unreachable``; ``none`` = zero denials so far) — the
+  no-silent-caps counter: a refused cordon/drain is audit-visible, never
+  a silent skip;
+* ``tpu_node_checker_remediation_actions_total{action}`` — actuations
+  APPLIED through the budget engine (cordon / drain / uncordon /
+  clear-annotation / repair; dry runs excluded);
+* ``tpu_node_checker_remediation_domains{state}`` — failure domains in
+  the budget engine's round view (``total``, and ``at_floor`` = domains
+  with no actuation headroom left above their healthy-chip floor);
+* ``tpu_node_checker_remediation_budget_remaining`` — actuation permits
+  left in the ``--disruption-budget`` window/round;
+* ``tpu_node_checker_remediation_repairs_total{result}`` — repair hooks
+  by outcome (``fired`` / ``succeeded`` / ``failed``), and
+  ``tpu_node_checker_remediation_repair_age_seconds`` — age of the
+  OLDEST repair still without a terminal state (the stuck-repair alert's
+  input; 0 when none are in flight);
+* ``tpu_node_checker_federation_lease_total{result}`` /
+  ``tpu_node_checker_federation_fleet_budget_remaining`` — the
+  ``--federate`` aggregator's disruption-lease traffic (granted permits
+  vs denied requests) and the fleet budget's remaining permits.
 
 This docstring is the package's metric index: tnc-lint's
 ``drift-readme-metrics`` rule (TNC202) fails CI when a family is emitted
@@ -544,6 +567,73 @@ def render_metrics(
                     "Unhealthy hosts beyond the per-host series cap.",
                     [({}, len(unhealthy) - cap)],
                 )
+    remediation = payload.get("remediation")
+    if remediation is not None:
+        # The budget engine's round view (--slice-floor-pct /
+        # --disruption-budget / legacy --cordon-max denials): refusals are
+        # the alertable signal — a rising denied rate during a storm is
+        # the budget protecting capacity, and exactly when humans must
+        # look.
+        denied = remediation.get("denied_total") or {}
+        family(
+            "tpu_node_checker_remediation_denied_total",
+            "counter",
+            "Actuations the disruption-budget engine refused, by reason "
+            "(cordon-max, slice-floor, disruption-budget, pdb, "
+            "lease-denied, lease-unreachable; 'none' = no denials yet).",
+            [({"reason": r}, float(n)) for r, n in sorted(denied.items())]
+            or [({"reason": "none"}, 0.0)],
+        )
+        actions = remediation.get("actions_total") or {}
+        family(
+            "tpu_node_checker_remediation_actions_total",
+            "counter",
+            "Actuations applied through the budget engine, by action "
+            "(cordon/drain/uncordon/clear-annotation/repair; dry runs "
+            "excluded; 'none' = no actuations yet).",
+            [({"action": a}, float(n)) for a, n in sorted(actions.items())]
+            or [({"action": "none"}, 0.0)],
+        )
+        domains = remediation.get("domains") or {}
+        family(
+            "tpu_node_checker_remediation_domains",
+            "gauge",
+            "Failure domains (slices) in the budget engine's view: total, "
+            "and at_floor = no actuation headroom left above the "
+            "healthy-chip floor.",
+            [({"state": "total"}, float(domains.get("total", 0))),
+             ({"state": "at_floor"}, float(domains.get("at_floor", 0)))],
+        )
+        budget = remediation.get("budget")
+        if isinstance(budget, dict):
+            family(
+                "tpu_node_checker_remediation_budget_remaining",
+                "gauge",
+                "Actuation permits left in the --disruption-budget "
+                "window/round.",
+                [({}, float(budget.get("remaining", 0)))],
+            )
+        repairs = remediation.get("repairs")
+        if isinstance(repairs, dict):
+            family(
+                "tpu_node_checker_remediation_repairs_total",
+                "counter",
+                "Repair hooks by outcome (fired = started, succeeded = "
+                "node re-earned HEALTHY, failed = the hook itself "
+                "errored).",
+                [({"result": "fired"}, float(repairs.get("fired_total", 0))),
+                 ({"result": "succeeded"},
+                  float(repairs.get("succeeded_total", 0))),
+                 ({"result": "failed"},
+                  float(repairs.get("failed_total", 0)))],
+            )
+            family(
+                "tpu_node_checker_remediation_repair_age_seconds",
+                "gauge",
+                "Age of the oldest repair with no terminal state (0 = "
+                "none in flight) — the stuck-repair alert's input.",
+                [({}, float(repairs.get("oldest_age_s", 0.0)))],
+            )
     history = payload.get("history")
     if history is not None:
         # Hysteresis roll-up (--history): EVERY state always emits (0

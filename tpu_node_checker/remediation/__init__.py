@@ -1,0 +1,50 @@
+"""Autonomous remediation: slice-aware disruption budgets over the
+existing evidence rules (DESIGN.md §17).
+
+The checker's actuators — cordon, drain (evict-then-cordon), repair hooks
+— are safe to run unattended only when a *budget engine* bounds what they
+may do per round, per window, and per failure domain.  The pieces:
+
+* :mod:`~tpu_node_checker.remediation.budget` — the
+  :class:`~tpu_node_checker.remediation.budget.BudgetEngine`: models every
+  slice as one failure domain (keyed by the same
+  :func:`~tpu_node_checker.detect.slice_group_key` the grading uses) and
+  refuses the Nth cordon/drain that would take a domain below its
+  healthy-chip floor (``--slice-floor-pct``) or exceed the disruption
+  budget (``--disruption-budget N[/WINDOW]``) — even when each node
+  individually looks expendable.  ``--cordon-max`` is folded in as the
+  legacy total-cordoned-state alias, and its denials are no longer silent
+  skips: every refusal is an audit event plus a
+  ``tpu_node_checker_remediation_denied_total{reason}`` sample.
+* :mod:`~tpu_node_checker.remediation.actuate` — the ONE module allowed
+  to call the cluster actuators (tnc-lint TNC019 pins this): every call
+  takes a granted :class:`~tpu_node_checker.remediation.budget.Decision`
+  and emits one audit event.
+* :mod:`~tpu_node_checker.remediation.drain` — evict-then-cordon through
+  the Eviction API (PDB refusals are budget denials, not errors;
+  ``--drain-dry-run`` is first-class and the default).
+* :mod:`~tpu_node_checker.remediation.repair` — scriptable repair hooks
+  (``--repair-cmd`` / ``--repair-webhook``), per-node repair state riding
+  the history store so a restart never double-fires.
+* :mod:`~tpu_node_checker.remediation.lease` — federated budgets: the
+  aggregator owns a fleet budget (``--fleet-disruption-budget``) that
+  per-cluster checkers borrow against through ``POST
+  /api/v1/global/disruption-lease``; a denial is a local refusal, an
+  unreachable aggregator degrades toward *less* actuation, never more.
+"""
+
+from tpu_node_checker.remediation.budget import (
+    DEFAULT_SLICE_FLOOR_PCT,
+    BudgetEngine,
+    Decision,
+    FleetLeaseBudget,
+    parse_disruption_budget,
+)
+
+__all__ = [
+    "DEFAULT_SLICE_FLOOR_PCT",
+    "BudgetEngine",
+    "Decision",
+    "FleetLeaseBudget",
+    "parse_disruption_budget",
+]

@@ -274,6 +274,8 @@ def format_slack_message(
     cordon: Optional[dict] = None,
     uncordon: Optional[dict] = None,
     history: Optional[dict] = None,
+    drain: Optional[dict] = None,
+    remediation: Optional[dict] = None,
 ) -> str:
     """Slack mrkdwn message.
 
@@ -423,6 +425,38 @@ def format_slack_message(
             names = [f.get("node", "?") for f in cordon["failed"]]
             lines.append(
                 f"❌ cordon FAILED — still schedulable: {_named_list(names)}"
+            )
+    if drain:
+        prefix = (
+            "[dry-run] would drain" if drain.get("dry_run") else "drained"
+        )
+        if drain.get("drained"):
+            lines.append(
+                f"🚧 {prefix} (evict-then-cordon, "
+                f"{drain.get('pods_evicted', 0)} pod(s), grace "
+                f"{drain.get('grace_seconds_total', 0)}s): "
+                f"{_named_list(drain['drained'])}"
+            )
+        if drain.get("failed"):
+            names = [f.get("node", "?") for f in drain["failed"]]
+            lines.append(
+                f"❌ drain FAILED — still schedulable: {_named_list(names)}"
+            )
+    if remediation and remediation.get("denials"):
+        # Budget refusals, DEDUPED to (domain, reason): a 30-node storm
+        # inside one slice is one standing refusal line, not 30 — the
+        # per-node detail lives in the payload/event log.  The watch
+        # loop's change fingerprint keys on the same pairs, so a standing
+        # storm alerts once per transition, not once per round.
+        pairs: dict = {}
+        for d in remediation["denials"]:
+            key = (d.get("domain") or d.get("node") or "?",
+                   d.get("reason") or "?")
+            pairs[key] = pairs.get(key, 0) + 1
+        for (domain, reason), count in sorted(pairs.items()):
+            lines.append(
+                f"🛑 remediation refused [{reason}] in `{domain}`: "
+                f"{count} node(s) held back — budget protecting capacity"
             )
     if uncordon:
         prefix = "[dry-run] would uncordon" if uncordon.get("dry_run") else "uncordoned"

@@ -263,6 +263,7 @@ class FleetStateServer:
         federation: bool = False,
         readiness: Optional[Callable] = None,
         obs=None,
+        lease: Optional[Callable] = None,
     ):
         self._snap: Optional[FleetSnapshot] = None
         # The observability layer (obs.Observability): owns the debug ring
@@ -279,6 +280,15 @@ class FleetStateServer:
         # aggregator's /readyz seam: () -> (ok, reason, detail_dict).
         self._federation = federation
         self._readiness = readiness
+        # Federated disruption budgets: the aggregator's lease seam —
+        # ``lease(body_dict) -> (status, body_dict)``; None answers 404 so
+        # checkers pointed at a budget-less aggregator fall back to their
+        # local budgets (the lease client treats 404 as unreachable).
+        self._lease = lease
+        # The checker's budget view (GET /api/v1/remediation): one
+        # pre-serialized Entity swapped per round by publish_remediation —
+        # request threads only ever negotiate an immutable reference.
+        self._remediation = None
         self._global = None  # merge.GlobalSnapshot, swapped atomically
         self._seq = 0
         self._breaker: Optional[dict] = None
@@ -316,6 +326,9 @@ class FleetStateServer:
         router.add("GET", "/api/v1/slices", self._get_collection("slices"))
         router.add("GET", "/api/v1/nodes/{name}", self._get_node)
         router.add("GET", "/api/v1/trend", self._get_trend)
+        router.add("GET", "/api/v1/remediation", self._get_remediation)
+        router.add("POST", "/api/v1/global/disruption-lease",
+                   self._post_lease)
         router.add("GET", "/api/v1/debug/rounds", self._get_debug_rounds)
         router.add("GET", "/api/v1/debug/rounds/{trace_id}",
                    self._get_debug_round)
@@ -488,6 +501,20 @@ class FleetStateServer:
         self._seq = max(self._seq + 1, snap.seq)
         self._snap = snap
 
+    def publish_remediation(self, doc: Optional[dict]) -> None:
+        """Swap the budget view one round's engine produced (None clears
+        it).  Serialized once here, negotiated per request — the read path
+        stays lock-free (TNC011)."""
+        if doc is None:
+            self._remediation = None
+            return
+        body = (json.dumps(doc, ensure_ascii=False) + "\n").encode("utf-8")
+        from tpu_node_checker.server.snapshot import Entity
+
+        self._remediation = Entity(
+            body, "application/json; charset=utf-8"
+        )
+
     def refresh_metrics(self, result, breaker: Optional[dict] = None) -> None:
         """A steady watch-stream tick: served content is unchanged (no
         snapshot swap, every poller's ETag keeps 304-ing) but the scrape
@@ -658,6 +685,17 @@ class FleetStateServer:
             self._trend.entity(snap.seq if snap else 0), req.headers
         )
 
+    def _get_remediation(self, req: Request) -> Response:
+        entity = self._remediation
+        if entity is None:
+            return json_response(
+                404,
+                {"error": "remediation is not active on this checker: no "
+                          "actuator flag (--cordon-failed/--drain-failed) "
+                          "ran this round"},
+            )
+        return negotiate(entity, req.headers)
+
     def _get_healthz(self, req: Request) -> Response:
         return json_response(200, {"ok": True})
 
@@ -764,6 +802,30 @@ class FleetStateServer:
         return Response(200, body, headers)
 
     # -- write handlers -------------------------------------------------------
+
+    def _post_lease(self, req: Request) -> Response:
+        """``POST /api/v1/global/disruption-lease``: borrow from the fleet
+        disruption budget.  No bearer gate — a lease moves budget numbers,
+        never cluster state; the actuation it authorizes still happens one
+        tier down, behind that cluster's own evidence rules and RBAC."""
+        if self._lease is None:
+            return json_response(
+                404,
+                {"error": "no fleet disruption budget configured "
+                          "(--fleet-disruption-budget on the aggregator); "
+                          "checkers fall back to their local budgets"},
+            )
+        try:
+            body = json.loads(req.body) if req.body else {}
+            if not isinstance(body, dict):
+                raise ValueError("lease request must be a JSON object")
+        except (ValueError, AttributeError) as exc:
+            return json_response(400, {"error": f"bad lease request: {exc}"})
+        try:
+            status, resp = self._lease(body)
+        except Exception as exc:  # tnc: allow-broad-except(a lease-seam bug is a response, not a serving-thread crash)
+            status, resp = 500, {"error": f"lease failed: {exc}"}
+        return json_response(status, resp)
 
     def _post_control(self, req: Request) -> Response:
         action = "cordon" if req.path.endswith("/cordon") else "uncordon"
