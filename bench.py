@@ -67,6 +67,14 @@ Relist fast path evidence (the projection tentpole, BENCH_r10):
   the p50 is ASSERTED < 1/4 of the oracle batch price measured under
   the same conditions.
 
+Chaos-simulator evidence (the scenario-engine tentpole, PR 12):
+
+* ``sim_flapstorm_rounds_p50_ms`` — per-round wall cost of the seeded
+  flap-storm scenario: REAL checker rounds (history, budget engine,
+  cordon sweeps) against a simulated apiserver, graded by the invariant
+  matrix.  Both bench runs are ASSERTED green AND byte-identical
+  (the ``--seed`` replay contract) before the number is printed.
+
 Bench honesty: every latency case records ``{n, p50_ms, iqr_ms}`` under
 ``sample_stats``; cases whose IQR exceeds 25% of their p50 are listed in
 ``variance_warnings`` (and printed to stderr) so a run-to-run delta can
@@ -1278,6 +1286,26 @@ def main() -> int:
     shutil.rmtree(reports_dir, ignore_errors=True)
     shutil.rmtree(certdir, ignore_errors=True)
     os.unlink(kubeconfig_name)
+
+    # -- chaos-simulator replay cost (the PR 12 scenario engine) ------------
+    # One flap-storm scenario = 8 REAL checker rounds (history + budget
+    # engine + cordon sweeps) against a simulated apiserver, graded by the
+    # invariant matrix; the per-round wall cost is what a CI scenario-grid
+    # run pays per round of coverage.  Runs twice for sample depth; every
+    # run must ALSO be green — a fast-but-violated scenario is not a bench
+    # number, and the two reports must replay byte-identically (the seed
+    # contract, exercised from the bench harness too).
+    from tpu_node_checker.sim.engine import run_scenario
+
+    sim_runs = [run_scenario("flap-storm", 7) for _ in range(2)]
+    for run in sim_runs:
+        assert run.ok, [v for v in run.report["invariants"] if not v["ok"]]
+    assert sim_runs[0].report_json == sim_runs[1].report_json
+    sim_flapstorm_p50 = _case_p50(
+        "sim_flapstorm_rounds",
+        [ms for run in sim_runs for ms in run.round_ms],
+    )
+
     baseline_ms = 2000.0  # the <2 s north-star budget
     assert cold_p50 < baseline_ms, f"cold e2e p50 {cold_p50:.0f}ms breaches the 2s budget"
     print(
@@ -1315,6 +1343,7 @@ def main() -> int:
                 "watch_traced_tax_pct": round(watch_traced_tax_pct, 1),
                 "nodes5k_watch_churn1pct_p50_ms": round(watch_churn_p50, 2),
                 "nodes5k_fault30_p50_ms": round(nodes5k_fault30_p50, 2),
+                "sim_flapstorm_rounds_p50_ms": round(sim_flapstorm_p50, 2),
                 "serve_etag_hit_p50_ms": round(serve_etag_p50, 3),
                 "serve_cold_encode_p50_ms": round(serve_cold_p50, 3),
                 "serve_sustained_rps": round(serve_rps),

@@ -1,0 +1,2 @@
+"""Seeds for TNC020 (sim-determinism): the simulator package draws no
+global randomness and reads no wall clock outside the clock seam."""

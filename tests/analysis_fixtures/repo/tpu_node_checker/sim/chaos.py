@@ -1,0 +1,42 @@
+"""TNC020 true positives and their nearest tempting negatives."""
+
+import os
+import random
+import time
+
+
+def pick_failures(hosts):
+    return random.sample(hosts, 2)  # EXPECT[TNC020]
+
+
+def jitter_schedule():
+    random.seed(1234)  # EXPECT[TNC020]
+    return random.random()  # EXPECT[TNC020]
+
+
+def stamp_round(record):
+    record["ts"] = time.time()  # EXPECT[TNC020]
+    return record
+
+
+def pace_round():
+    time.sleep(0.5)  # EXPECT[TNC020]
+
+
+def mint_trace_prefix():
+    return os.urandom(4).hex()  # EXPECT[TNC020]
+
+
+def seeded_failures(seed, hosts):
+    # near-miss: a SEEDED instance is the sanctioned shape — its methods
+    # share names with the module-level global-RNG functions.
+    rng = random.Random(seed)
+    rng.seed(seed)
+    return rng.sample(hosts, 2)
+
+
+def paced_by_clock(clock, record):
+    # near-miss: time flows through the injectable seam object.
+    clock.sleep(1.0)
+    record["ts"] = clock.now()
+    return record

@@ -1,0 +1,12 @@
+"""Near-miss: the clock SEAM is the one sim file allowed to read reality
+— TNC020 exempts exactly this path."""
+
+import time
+
+
+def wall_now():
+    return time.time()
+
+
+def real_pace(seconds):
+    time.sleep(seconds)

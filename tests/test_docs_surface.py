@@ -17,10 +17,15 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 def _parser_flags() -> set:
-    # The REAL parser's actions — no source regex to fall out of sync.
+    # The REAL parsers' actions — no source regex to fall out of sync.
+    # The simulate subcommand (`tnc simulate …`) is a second real surface
+    # whose flags the README documents in its own section.
+    from tpu_node_checker.sim import cli as sim_cli
+
     return {
         opt
-        for action in cli.build_parser()._actions
+        for parser in (cli.build_parser(), sim_cli.build_parser())
+        for action in parser._actions
         for opt in action.option_strings
         if opt.startswith("--")
     }

@@ -505,6 +505,80 @@ class ActuatorGate(Rule):
                 )
 
 
+class SimDeterminism(Rule):
+    slug = "sim-determinism"
+    code = "TNC020"
+    doc = ("inside ``tpu_node_checker/sim/`` all randomness flows from a "
+           "seeded ``random.Random`` and all time from the injectable "
+           "clock seam (``sim/clock.py``, the one exempt file): "
+           "module-level ``random.*`` calls, wall-clock reads "
+           "(``time.time``/``monotonic``/``perf_counter``, "
+           "``datetime.now``/``utcnow``), ``time.sleep`` pacing, "
+           "``os.urandom`` and ``uuid4`` are findings — each one breaks "
+           "the same-seed-byte-identical replay contract")
+
+    _SEAM = "tpu_node_checker/sim/clock.py"
+    # The stdlib's GLOBAL RNG surface — process-wide hidden state no seed
+    # argument reaches.  random.Random(seed) instances are the sanctioned
+    # shape and deliberately absent.
+    _GLOBAL_RNG = {
+        f"random.{fn}" for fn in (
+            "random", "randint", "randrange", "choice", "choices",
+            "shuffle", "sample", "uniform", "gauss", "getrandbits",
+            "seed", "betavariate", "expovariate", "triangular",
+        )
+    }
+    _WALL = {
+        "time.time": "wall-clock read",
+        "time.time_ns": "wall-clock read",
+        "time.monotonic": "wall-clock read",
+        "time.monotonic_ns": "wall-clock read",
+        "time.perf_counter": "wall-clock read",
+        "time.perf_counter_ns": "wall-clock read",
+        "datetime.now": "wall-clock read",
+        "datetime.utcnow": "wall-clock read",
+        "datetime.datetime.now": "wall-clock read",
+        "datetime.datetime.utcnow": "wall-clock read",
+        "time.sleep": "real sleep",
+        "os.urandom": "entropy read",
+        "uuid.uuid4": "entropy read",
+        "uuid4": "entropy read",
+    }
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if (not ctx.path.startswith("tpu_node_checker/sim/")
+                or ctx.path == self._SEAM):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            if name in self._GLOBAL_RNG:
+                yield self.finding(
+                    ctx.path, node,
+                    f"global-RNG call {name}() in the simulator — draw "
+                    "from the run's seeded random.Random so the same "
+                    "seed replays byte-identically",
+                )
+            kind = self._WALL.get(name)
+            if kind == "entropy read":
+                yield self.finding(
+                    ctx.path, node,
+                    f"entropy read {name}() in the simulator — "
+                    "unseedable randomness can never replay; draw from "
+                    "the run's seeded random.Random instead",
+                )
+            elif kind:
+                yield self.finding(
+                    ctx.path, node,
+                    f"{kind} {name}() in the simulator — route time "
+                    "through the injectable clock seam (sim/clock.py) so "
+                    "scenario replay stays deterministic",
+                )
+
+
 class TestWallClock(Rule):
     slug = "test-wall-clock"
     code = "TNC016"
@@ -546,5 +620,6 @@ RULES: List[Rule] = [
     ObsDiscipline(),
     ListHotPathDecode(),
     ActuatorGate(),
+    SimDeterminism(),
     TestWallClock(),
 ]

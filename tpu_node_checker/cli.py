@@ -988,6 +988,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "simulate":
+        # The chaos simulator rides the same console entry as a subcommand
+        # (`tnc simulate --seed N --scenario flap-storm`); its flag surface
+        # lives in sim/cli.py — a simulator knob is not a checker knob.
+        from tpu_node_checker.sim.cli import main as simulate_main
+
+        return simulate_main(argv[1:])
     args = parse_args(argv)
     try:
         if getattr(args, "trend", None):

@@ -1,0 +1,56 @@
+"""Deterministic chaos simulator (DESIGN.md §18).
+
+Seeded fleet scenarios driving REAL checker and aggregator machinery
+end-to-end against simulated API servers, graded by an invariant
+acceptance matrix.  Entry points:
+
+* ``tnc simulate --seed N --scenario <name>`` — the CLI
+  (:mod:`tpu_node_checker.sim.cli`);
+* :func:`tpu_node_checker.sim.engine.run_scenario` — the library call the
+  tests and bench use;
+* :mod:`tpu_node_checker.sim.fixtures` — the fault/watch/storm scripts
+  and fake-apiserver handlers, promoted out of ``tests/fixtures.py``
+  (which re-exports them, so existing imports keep working).
+
+Determinism contract (enforced by tnc-lint TNC020): inside this package
+all randomness flows from one seeded ``random.Random`` and all time from
+the injectable clock seam (:mod:`tpu_node_checker.sim.clock`) — same seed,
+same scenario, byte-identical report and event log.
+"""
+
+from tpu_node_checker.sim.clock import SimClock, WallClock
+from tpu_node_checker.sim.fixtures import (
+    FaultSchedule,
+    StormSchedule,
+    WatchScript,
+    fault_scheduled_handler,
+    make_node,
+    node_list,
+    paged_nodelist_handler,
+    serve_http,
+    storm_apiserver,
+    storm_available_by_slice,
+    watch_bookmark,
+    watch_error_gone,
+    watch_event,
+    watch_nodelist_handler,
+)
+
+__all__ = [
+    "SimClock",
+    "WallClock",
+    "FaultSchedule",
+    "StormSchedule",
+    "WatchScript",
+    "fault_scheduled_handler",
+    "make_node",
+    "node_list",
+    "paged_nodelist_handler",
+    "serve_http",
+    "storm_apiserver",
+    "storm_available_by_slice",
+    "watch_bookmark",
+    "watch_error_gone",
+    "watch_event",
+    "watch_nodelist_handler",
+]

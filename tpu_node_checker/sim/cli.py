@@ -1,0 +1,124 @@
+"""``tnc simulate`` — the chaos simulator's command surface.
+
+Dispatched from the main CLI (``tpu-node-checker simulate …``); its flags
+live here, NOT in the round parser, because a simulator knob is not a
+checker knob (and the README ``## Flags`` ≡ cli.py drift gate, TNC203,
+covers the round surface only — simulate documents its own table in the
+README's "Chaos simulation" section).
+
+Exit codes follow the spirit of the check contract: **0** every invariant
+green, **3** at least one invariant violated (the fleet "exists but is
+not healthy" family), **1** internal error, **2** usage error (argparse).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from tpu_node_checker import checker
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-node-checker simulate",
+        description=(
+            "Deterministic chaos simulator: run a seeded fleet scenario "
+            "against real checker/aggregator machinery and grade it with "
+            "the invariant acceptance matrix.  Same --seed, same scenario "
+            "parameters: byte-identical report and event log.  Exit codes: "
+            "0 = all invariants green; 3 = an invariant was violated; "
+            "1 = error."
+        ),
+    )
+    p.add_argument("--scenario", metavar="NAME",
+                   help="scenario to run (see --list-scenarios)")
+    p.add_argument("--seed", type=int, default=0, metavar="N",
+                   help="RNG seed — the replay handle (default 0)")
+    p.add_argument("--clusters", type=int, default=None, metavar="K",
+                   help="clusters to synthesize (scenarios that honor it; "
+                   "see --list-scenarios)")
+    p.add_argument("--nodes-per-cluster", type=int, default=None,
+                   metavar="M",
+                   help="nodes per cluster, rounded up to whole slices")
+    p.add_argument("--rounds", type=int, default=None, metavar="R",
+                   help="check rounds to drive")
+    p.add_argument("--report", choices=("human", "json"), default="human",
+                   help="report format on stdout (json is the "
+                   "byte-replayable CI artifact)")
+    p.add_argument("--list-scenarios", action="store_true",
+                   help="list the scenario grid and exit")
+    return p
+
+
+def _list_scenarios() -> str:
+    from tpu_node_checker.sim.scenarios import SCENARIOS
+
+    lines = []
+    for name in sorted(SCENARIOS):
+        s = SCENARIOS[name]
+        d = s.defaults
+        lines.append(f"{name:20s} {s.title}")
+        lines.append(
+            f"{'':20s} defaults: clusters={d['clusters']} "
+            f"nodes-per-cluster={d['nodes_per_cluster']} "
+            f"rounds={d['rounds']}; tunable: "
+            f"{', '.join(t.replace('_', '-') for t in s.tunable) or 'none'}"
+        )
+        lines.append(f"{'':20s} invariants: {', '.join(s.invariants)}")
+    return "\n".join(lines)
+
+
+def _render_human(result) -> str:
+    lines = [
+        f"scenario {result.name!r} seed={result.seed} "
+        + " ".join(f"{k.replace('_', '-')}={v}"
+                   for k, v in sorted(result.params.items())),
+    ]
+    for v in result.report["invariants"]:
+        mark = "PASS" if v["ok"] else "FAIL"
+        lines.append(f"  [{mark}] {v['name']}: {v['detail']}")
+    lines.append(
+        f"{'OK' if result.ok else 'VIOLATED'} — "
+        f"{sum(1 for v in result.report['invariants'] if v['ok'])}"
+        f"/{len(result.report['invariants'])} invariants green; "
+        f"events={result.report['event_count']} "
+        f"digest={result.report['events_digest']}"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = build_parser()
+    args = p.parse_args(argv)
+    if args.list_scenarios:
+        if args.scenario:
+            p.error("--list-scenarios runs alone")
+        print(_list_scenarios())
+        return checker.EXIT_OK
+    if not args.scenario:
+        p.error("--scenario NAME is required (see --list-scenarios)")
+    from tpu_node_checker.sim.engine import ScenarioError, run_scenario
+
+    try:
+        result = run_scenario(
+            args.scenario, args.seed,
+            clusters=args.clusters,
+            nodes_per_cluster=args.nodes_per_cluster,
+            rounds=args.rounds,
+        )
+    except ScenarioError as exc:
+        p.error(str(exc))
+    except Exception as exc:  # tnc: allow-broad-except(the CLI's documented exit-1 contract: any crashed scenario reports its error instead of a traceback impersonating a verdict)
+        print(f"Error: {exc}", file=sys.stderr)
+        return checker.EXIT_ERROR
+    if args.report == "json":
+        sys.stdout.write(result.report_json)
+    else:
+        print(_render_human(result))
+    return checker.EXIT_OK if result.ok else checker.EXIT_NONE_READY
+
+
+def entrypoint(argv: Optional[List[str]] = None) -> None:
+    sys.exit(main(argv))
