@@ -1306,6 +1306,38 @@ def main() -> int:
         [ms for run in sim_runs for ms in run.round_ms],
     )
 
+    # -- tnc-lint whole-repo cost (the ISSUE 13 flow tier) ------------------
+    # The repo-wide lint is a CI gate, so its cost is part of the
+    # development loop's trajectory.  Two full runs (cold rule state each:
+    # run_project builds a fresh Project/graph per call); the flow tier's
+    # own budget — call-graph build + TNC111-113 — is ASSERTED < 10 s, and
+    # the run must be CLEAN: a bench number measured over a failing gate
+    # would be a number about nothing.
+    from tpu_node_checker.analysis.engine import run_project as _lint_repo
+
+    lint_totals = []
+    lint_flow = []
+    for _ in range(2):
+        lint_report = _lint_repo(os.path.dirname(os.path.abspath(__file__)))
+        assert lint_report.findings == [], (
+            "bench ran over a dirty lint gate: "
+            + "; ".join(f"{f.path}:{f.line} {f.code}" for f in
+                        lint_report.findings[:5])
+        )
+        t = lint_report.timings_ms
+        lint_totals.append(t["total"])
+        lint_flow.append(
+            t.get("graph_build", 0.0)
+            + sum(t.get(code, 0.0)
+                  for code in ("TNC111", "TNC112", "TNC113"))
+        )
+    lint_full_repo_p50 = _case_p50("lint_full_repo", lint_totals)
+    lint_graph_flow_p50 = _case_p50("lint_graph_flow", lint_flow)
+    assert lint_graph_flow_p50 < 10_000.0, (
+        f"graph build + TNC111-113 p50 {lint_graph_flow_p50:.0f}ms "
+        "breaches the 10s flow-tier budget"
+    )
+
     baseline_ms = 2000.0  # the <2 s north-star budget
     assert cold_p50 < baseline_ms, f"cold e2e p50 {cold_p50:.0f}ms breaches the 2s budget"
     print(
@@ -1344,6 +1376,8 @@ def main() -> int:
                 "nodes5k_watch_churn1pct_p50_ms": round(watch_churn_p50, 2),
                 "nodes5k_fault30_p50_ms": round(nodes5k_fault30_p50, 2),
                 "sim_flapstorm_rounds_p50_ms": round(sim_flapstorm_p50, 2),
+                "lint_full_repo_p50_ms": round(lint_full_repo_p50, 2),
+                "lint_graph_flow_p50_ms": round(lint_graph_flow_p50, 2),
                 "serve_etag_hit_p50_ms": round(serve_etag_p50, 3),
                 "serve_cold_encode_p50_ms": round(serve_cold_p50, 3),
                 "serve_sustained_rps": round(serve_rps),

@@ -1,0 +1,17 @@
+"""Cross-module mutators for the TNC112 seeds: TNC101 cannot see these
+(wrong file, wrong receiver spelling), the lock-set rule must."""
+
+from tpu_node_checker.flowpkg.state import QuietState, SharedState
+
+
+def reset_racy(state: "SharedState"):
+    state.count = 0  # EXPECT[TNC112]
+
+
+def reset_locked(state: "SharedState"):  # near-miss: takes the object's lock
+    with state._lock:
+        state.count = 0
+
+
+def quiet_reset(state: "QuietState"):
+    state.total = 0  # near-miss: QuietState is reachable from one domain only

@@ -1,0 +1,12 @@
+"""Callees for the TNC113 seeds: one mutates its parameter (the escape
+the publish path must not hand the snapshot to), one only reads it."""
+
+from tpu_node_checker.server.escape import Snap
+
+
+def stamp_late(snap: "Snap"):
+    snap.entities["stamped"] = True
+
+
+def count_entities(snap: "Snap"):  # near-miss: read-only callee
+    return len(snap.entities)

@@ -1,0 +1,27 @@
+"""Thread entries for the TNC112 seeds: the worker thread and the main
+path both reach SharedState, so its writes span two domains."""
+
+import threading
+
+from tpu_node_checker.flowpkg import helper
+from tpu_node_checker.flowpkg.state import SharedState
+
+
+def start_worker(state: "SharedState"):
+    thread = threading.Thread(
+        target=_worker_loop, args=(state,),
+        name="flow-seed-worker", daemon=True,
+    )
+    thread.start()
+    return thread
+
+
+def _worker_loop(state: "SharedState"):
+    helper.reset_racy(state)
+    helper.reset_locked(state)
+
+
+def main_path(state: "SharedState", quiet):
+    state.bump()
+    state.locked_helper_call()
+    helper.quiet_reset(quiet)

@@ -1,0 +1,40 @@
+"""Seeds for TNC112 (lockset-race): lock-guarded state whose OTHER write
+sites live in another module — invisible to the per-file TNC101, visible
+to the whole-program lock-set rule.  ``_bump_unsafe`` is the inherited-
+lockset near-miss: lexically unguarded, but its only caller holds the
+lock, so the call-graph meet rescues it."""
+
+import threading
+
+
+class SharedState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def locked_helper_call(self):
+        with self._lock:
+            _bump_unsafe(self)
+
+
+def _bump_unsafe(state: "SharedState"):
+    # near-miss: every resolved caller holds SharedState._lock, so the
+    # inherited lock-set is non-empty — no finding.
+    state.count += 1
+
+
+class QuietState:
+    """Near-miss: same cross-file write shape, but no thread entry ever
+    reaches it — single-domain state needs no lock consistency."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def tally(self):
+        with self._lock:
+            self.total += 1

@@ -1,0 +1,48 @@
+"""Seeds for TNC113 (snapshot-escape): the publish path's freeze as
+DATAFLOW.  None of these are direct post-swap mutations of the published
+name (that is TNC102, seeded in pub.py/deltapub.py) — they leak the
+snapshot's mutable internals, or mutate what BUILT it, after the swap."""
+
+from tpu_node_checker.flowpkg.mutators import count_entities, stamp_late
+
+
+class Snap:
+    def __init__(self):
+        self.entities = {}
+
+
+class EscapePublisher:
+    def __init__(self):
+        self._snap = None
+        self._hot = None
+
+    def publish_store_internals(self, payload):
+        snap = {"entities": dict(payload)}
+        self._snap = snap
+        self._hot = snap["entities"]  # EXPECT[TNC113]
+
+    def publish_feed_mutation(self, payload):
+        entities = dict(payload)
+        snap = {"entities": entities}
+        self._snap = snap
+        entities["late"] = payload  # EXPECT[TNC113]
+
+    def publish_return_internals(self, payload):
+        snap = {"fragments": dict(payload)}
+        self._snap = snap
+        return snap["fragments"]  # EXPECT[TNC113]
+
+    def publish_pass_to_mutator(self, payload):
+        snap = Snap()
+        snap.entities.update(payload)
+        self._snap = snap
+        stamp_late(snap)  # EXPECT[TNC113]
+
+    def publish_clean_reader(self, payload):
+        # near-misses: build-then-swap, return the HANDLE (not an
+        # internal), and a callee that only reads its parameter.
+        snap = Snap()
+        snap.entities.update(payload)
+        self._snap = snap
+        count_entities(snap)
+        return snap

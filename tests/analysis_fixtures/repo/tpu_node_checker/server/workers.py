@@ -1,9 +1,13 @@
 """Seeds for TNC011 on the worker-pool shape: the accept-loop READ path
 (fast responders, header extraction) takes no locks — a lock there
 serializes every worker — while accept-side bookkeeping (connection
-registry, shed guard) legitimately may."""
+registry, shed guard) legitimately may.  TNC111 seeds ride on the same
+roots: the blocking call hides in ANOTHER module (storeio.py), visible
+only to the call-graph rule, and lands on the root's ``def`` line."""
 
 import threading
+
+from tpu_node_checker.storeio import deep_fetch, fetch_snapshot, shape_route
 
 
 class AcceptWorker:
@@ -26,3 +30,16 @@ class AcceptWorker:
         with self._lock:
             self._accepted += 1
         return conn
+
+    def _get_cached(self, line):  # EXPECT[TNC111]
+        return fetch_snapshot(self._routes.get(line))
+
+    def _get_deep(self, line):  # EXPECT[TNC111]
+        return deep_fetch(self._routes.get(line))  # blocking two calls down
+
+    def _get_shaped(self, line):  # near-miss: the whole callee chain is pure
+        return shape_route(self._routes.get(line, ""))
+
+    # tnc: allow-transitive-blocking(seed: sanctioned root — the waiver on the root covers the callee-file blocking site)
+    def _get_waived(self, line):
+        return fetch_snapshot(self._routes.get(line))

@@ -1,0 +1,18 @@
+"""Callee-side seeds for TNC111: blocking work that is invisible to the
+per-file TNC011 scan because it sits in ANOTHER module, one or two calls
+below a read-path root in server/workers.py."""
+
+import time
+
+
+def fetch_snapshot(pool):
+    time.sleep(0.01)  # the blocking site TNC111 must trace to its root
+    return pool
+
+
+def deep_fetch(pool):
+    return fetch_snapshot(pool)  # depth 2: the ban follows calls
+
+
+def shape_route(route):  # near-miss: pure compute, nothing blocking
+    return [route, len(route)]

@@ -1,0 +1,611 @@
+"""The whole-program flow tier: call-graph builder units, thread-entry
+inference, the TNC111/112/113 graph rules, root-level suppression
+accounting, the ``--graph json`` dump, and the ``--changed-only``
+incremental cache.
+
+Graph units build miniature checkouts under tmp_path — the builder only
+needs a ``tpu_node_checker/`` directory — and assert on the resolved
+edges and the explicit ``unresolved`` bucket: every soundness gap must be
+COUNTED, so the bucket is asserted non-zero wherever dynamism is seeded
+(a silently-empty bucket would mean the builder started lying).
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpu_node_checker.analysis.engine import load_project, run_project
+from tpu_node_checker.analysis.flow.entries import (
+    compute_domains,
+    infer_entries,
+)
+from tpu_node_checker.analysis.flow.graph import build_graph
+
+CORPUS_ROOT = Path(__file__).resolve().parent / "analysis_fixtures" / "repo"
+
+
+def _mini(tmp_path, files):
+    """Write a miniature checkout; returns its root as str."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    (tmp_path / "tpu_node_checker").mkdir(exist_ok=True)
+    init = tmp_path / "tpu_node_checker" / "__init__.py"
+    if not init.exists():
+        init.write_text("")
+    return str(tmp_path)
+
+
+def _graph(tmp_path, files):
+    return build_graph(load_project(_mini(tmp_path, files)))
+
+
+def _edges(graph):
+    return {(s.caller, t) for s in graph.calls for t in s.targets}
+
+
+class TestCallGraphBuilder:
+    def test_direct_and_imported_calls_resolve(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/a.py": (
+                "from tpu_node_checker.b import helper\n"
+                "import tpu_node_checker.b as bee\n"
+                "def caller():\n"
+                "    helper()\n"
+                "    bee.helper()\n"
+            ),
+            "tpu_node_checker/b.py": "def helper():\n    return 1\n",
+        })
+        caller = "tpu_node_checker/a.py::caller"
+        helper = "tpu_node_checker/b.py::helper"
+        assert (caller, helper) in _edges(graph)
+        kinds = [s.kind for s in graph.calls if s.caller == caller]
+        assert kinds.count("direct") == 2  # both spellings resolve
+
+    def test_self_method_dispatch_and_base_class(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/c.py": (
+                "class Base:\n"
+                "    def shared(self):\n"
+                "        return 1\n"
+                "class Child(Base):\n"
+                "    def go(self):\n"
+                "        self.shared()\n"
+                "        self.local()\n"
+                "    def local(self):\n"
+                "        return 2\n"
+            ),
+        })
+        go = "tpu_node_checker/c.py::Child.go"
+        assert (go, "tpu_node_checker/c.py::Base.shared") in _edges(graph)
+        assert (go, "tpu_node_checker/c.py::Child.local") in _edges(graph)
+
+    def test_constructor_assignment_types_locals(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/d.py": (
+                "class Store:\n"
+                "    def get(self):\n"
+                "        return 1\n"
+                "def use():\n"
+                "    store = Store()\n"
+                "    return store.get()\n"
+            ),
+        })
+        assert ("tpu_node_checker/d.py::use",
+                "tpu_node_checker/d.py::Store.get") in _edges(graph)
+
+    def test_decorator_unwrapping_and_property(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/e.py": (
+                "import functools\n"
+                "def deco(fn):\n"
+                "    return fn\n"
+                "@deco\n"
+                "def wrapped():\n"
+                "    return 1\n"
+                "class Box:\n"
+                "    @property\n"
+                "    def value(self):\n"
+                "        return 1\n"
+                "def use():\n"
+                "    return wrapped()\n"
+            ),
+        })
+        # The decorated function is registered under its own name and
+        # calls to it resolve to the body that executes.
+        assert ("tpu_node_checker/e.py::use",
+                "tpu_node_checker/e.py::wrapped") in _edges(graph)
+        box = graph.classes["tpu_node_checker/e.py::Box"]
+        assert "value" in box.properties
+
+    def test_dynamic_dispatch_fallback_low_fanout(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/f.py": (
+                "class OnlyOne:\n"
+                "    def peculiar_method(self):\n"
+                "        return 1\n"
+                "def use(thing):\n"
+                "    return thing.peculiar_method()\n"
+            ),
+        })
+        (site,) = [s for s in graph.calls
+                   if s.name == "thing.peculiar_method"]
+        assert site.kind == "fallback"
+        assert site.targets == (
+            "tpu_node_checker/f.py::OnlyOne.peculiar_method",)
+
+    def test_dispatch_past_the_fanout_cap_is_unresolved(self, tmp_path):
+        classes = "\n".join(
+            f"class C{i}:\n    def crowded(self):\n        return {i}"
+            for i in range(5)
+        )
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/g.py": (
+                f"{classes}\n"
+                "def use(thing):\n"
+                "    return thing.crowded()\n"
+            ),
+        })
+        (site,) = [s for s in graph.calls if s.name == "thing.crowded"]
+        assert site.kind == "unresolved"
+        assert graph.counts["unresolved"] >= 1
+
+    def test_unresolved_bucket_counted_never_silently_zero(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/h.py": (
+                "def use(callback, registry):\n"
+                "    callback()\n"
+                "    registry['x']()\n"
+            ),
+        })
+        assert graph.counts["unresolved"] == 2
+        assert len(graph.unresolved) == 2
+        # The four buckets partition every recorded call.
+        assert sum(graph.counts.values()) == len(graph.calls)
+
+    def test_repo_graph_buckets_partition_and_count_gaps(self):
+        # The real corpus carries seeded dynamism (params called as
+        # functions) — the bucket must be non-zero there, proving the
+        # builder counts what it cannot see instead of dropping it.
+        graph = build_graph(load_project(str(CORPUS_ROOT)))
+        assert sum(graph.counts.values()) == len(graph.calls)
+        assert graph.counts["resolved"] > 0
+        doc = graph.to_dict()
+        assert doc["counts"] == graph.counts
+        assert len(doc["unresolved"]) == graph.counts["unresolved"]
+
+
+class TestThreadEntries:
+    def test_thread_target_partial_and_lambda(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/t.py": (
+                "import threading\n"
+                "from functools import partial\n"
+                "def loop():\n"
+                "    return 1\n"
+                "def other(x):\n"
+                "    return x\n"
+                "def spawn():\n"
+                "    threading.Thread(target=loop, name='a', daemon=True).start()\n"
+                "    threading.Thread(target=partial(other, 1), name='b', daemon=True).start()\n"
+                "    threading.Thread(target=lambda: loop(), name='c', daemon=True).start()\n"
+            ),
+        })
+        entries = infer_entries(graph)
+        fids = {e.fid for e in entries}
+        assert "tpu_node_checker/t.py::loop" in fids
+        assert "tpu_node_checker/t.py::other" in fids
+        assert any("<lambda>" in fid for fid in fids)
+
+    def test_thread_subclass_signal_and_router_handlers(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/u.py": (
+                "import signal\n"
+                "import threading\n"
+                "class Reader(threading.Thread):\n"
+                "    def run(self):\n"
+                "        return 1\n"
+                "def on_term(signum, frame):\n"
+                "    return None\n"
+                "def handle_get(req):\n"
+                "    return req\n"
+                "def wire(router):\n"
+                "    signal.signal(signal.SIGTERM, on_term)\n"
+                "    router.add('GET', '/x', handle_get)\n"
+            ),
+        })
+        entries = infer_entries(graph)
+        kinds = {e.fid: e.kind for e in entries}
+        assert kinds["tpu_node_checker/u.py::Reader.run"] == "thread-subclass"
+        assert kinds["tpu_node_checker/u.py::on_term"] == "signal"
+        assert kinds["tpu_node_checker/u.py::handle_get"] == "http-handler"
+
+    def test_parameter_spawner_roots_call_site_argument(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/v.py": (
+                "def bounded_map(fn, items, pool):\n"
+                "    return [pool.submit(fn, item) for item in items]\n"
+                "def worker(item):\n"
+                "    return item\n"
+                "def caller(pool):\n"
+                "    bounded_map(worker, [1], pool)\n"
+            ),
+        })
+        entries = infer_entries(graph)
+        spawned = {e.fid: e.kind for e in entries}
+        assert spawned.get("tpu_node_checker/v.py::worker") == "spawner-arg"
+
+    def test_domains_span_thread_and_main(self, tmp_path):
+        graph = _graph(tmp_path, {
+            "tpu_node_checker/w.py": (
+                "import threading\n"
+                "def shared():\n"
+                "    return 1\n"
+                "def loop():\n"
+                "    shared()\n"
+                "def spawn():\n"
+                "    threading.Thread(target=loop, name='x', daemon=True).start()\n"
+                "def sync_path():\n"
+                "    shared()\n"
+            ),
+        })
+        domains = compute_domains(graph, infer_entries(graph))
+        shared = domains["tpu_node_checker/w.py::shared"]
+        assert len(shared) >= 2  # the worker thread AND main both reach it
+        assert "main" in shared
+
+
+_READ_ROOT_FILE = "tpu_node_checker/server/workers.py"
+
+
+def _tnc111_project(sleep_in_callee: bool, suppress_root: bool = False):
+    waiver = ("    # tnc: allow-transitive-blocking(unit: sanctioned root)\n"
+              if suppress_root else "")
+    callee_body = ("    time.sleep(0.1)\n" if sleep_in_callee
+                   else "    pass\n")
+    return {
+        _READ_ROOT_FILE: (
+            "from tpu_node_checker.helper import do_fetch\n"
+            "class W:\n"
+            f"{waiver}"
+            "    def _get_thing(self, key):\n"
+            "        return do_fetch(key)\n"
+        ),
+        "tpu_node_checker/helper.py": (
+            "import time\n"
+            "def do_fetch(key):\n"
+            f"{callee_body}"
+            "    return key\n"
+        ),
+    }
+
+
+class TestTransitiveBlocking:
+    def test_cross_file_blocking_lands_on_root(self, tmp_path):
+        root = _mini(tmp_path, _tnc111_project(sleep_in_callee=True))
+        report = run_project(root, only_rules=["transitive-blocking"])
+        (finding,) = report.findings
+        assert finding.code == "TNC111"
+        assert finding.path == _READ_ROOT_FILE
+        assert "time.sleep" in finding.message
+        assert "helper.py" in finding.message  # names the real site
+
+    def test_clean_callee_chain_is_quiet(self, tmp_path):
+        root = _mini(tmp_path, _tnc111_project(sleep_in_callee=False))
+        report = run_project(root, only_rules=["transitive-blocking"])
+        assert report.findings == []
+
+    def test_root_suppression_covers_callee_file_blocking(self, tmp_path):
+        root = _mini(tmp_path, _tnc111_project(True, suppress_root=True))
+        report = run_project(root, only_rules=["transitive-blocking"])
+        assert report.findings == []
+        (shushed,) = report.suppressed
+        assert shushed.code == "TNC111"
+        assert report.unused_suppressions == []
+
+    def test_suppression_surfaces_unused_when_path_disappears(self, tmp_path):
+        # The waiver stays on the root, the blocking callee goes away:
+        # the engine must report the orphaned waiver, not silently keep it.
+        root = _mini(tmp_path, _tnc111_project(False, suppress_root=True))
+        report = run_project(root, only_rules=["transitive-blocking"])
+        assert report.findings == []
+        (unused,) = report.unused_suppressions
+        assert unused["rule"] == "transitive-blocking"
+        assert unused["path"] == _READ_ROOT_FILE
+
+
+class TestLocksetRace:
+    def _project(self, spawn: bool = True, lock_in_helper: bool = False):
+        helper_write = (
+            "    with state._lock:\n        state.count = 0\n"
+            if lock_in_helper else "    state.count = 0\n"
+        )
+        spawn_src = (
+            "import threading\n"
+            "from tpu_node_checker.race_helper import reset\n"
+            "from tpu_node_checker.race_state import State\n"
+            "def start(state: 'State'):\n"
+            "    threading.Thread(target=_loop, args=(state,),"
+            " name='x', daemon=True).start()\n"
+            "def _loop(state: 'State'):\n"
+            "    reset(state)\n"
+        ) if spawn else (
+            "from tpu_node_checker.race_helper import reset\n"
+            "from tpu_node_checker.race_state import State\n"
+            "def sync_only(state: 'State'):\n"
+            "    reset(state)\n"
+        )
+        return {
+            "tpu_node_checker/race_state.py": (
+                "import threading\n"
+                "class State:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.count = 0\n"
+                "    def bump(self):\n"
+                "        with self._lock:\n"
+                "            self.count += 1\n"
+            ),
+            "tpu_node_checker/race_helper.py": (
+                "from tpu_node_checker.race_state import State\n"
+                "def reset(state: 'State'):\n"
+                f"{helper_write}"
+            ),
+            "tpu_node_checker/race_spawn.py": spawn_src,
+        }
+
+    def test_cross_file_unguarded_write_fires(self, tmp_path):
+        root = _mini(tmp_path, self._project())
+        report = run_project(root, only_rules=["lockset-race"])
+        (finding,) = report.findings
+        assert finding.code == "TNC112"
+        assert finding.path == "tpu_node_checker/race_helper.py"
+        assert "State.count" in finding.message
+
+    def test_locked_helper_is_quiet(self, tmp_path):
+        root = _mini(tmp_path, self._project(lock_in_helper=True))
+        report = run_project(root, only_rules=["lockset-race"])
+        assert report.findings == []
+
+    def test_single_domain_is_quiet(self, tmp_path):
+        root = _mini(tmp_path, self._project(spawn=False))
+        report = run_project(root, only_rules=["lockset-race"])
+        assert report.findings == []
+
+    def test_inherited_lockset_rescues_helper(self, tmp_path):
+        # The helper never takes the lock lexically, but its ONLY caller
+        # holds it — the call-graph meet must rescue the site.
+        files = self._project()
+        files["tpu_node_checker/race_state.py"] = (
+            "import threading\n"
+            "from tpu_node_checker.race_inner import bump_inner\n"
+            "class State:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            bump_inner(self)\n"
+        )
+        files["tpu_node_checker/race_inner.py"] = (
+            "from tpu_node_checker.race_state import State\n"
+            "def bump_inner(state: 'State'):\n"
+            "    state.count += 1\n"
+        )
+        root = _mini(tmp_path, files)
+        report = run_project(root, only_rules=["lockset-race"])
+        # race_helper's bare write still fires; the inherited-lock site
+        # in race_inner must NOT.
+        assert [f.path for f in report.findings] == [
+            "tpu_node_checker/race_helper.py"
+        ]
+
+    def test_sanctioned_snapshot_swap_attr_is_excused(self, tmp_path):
+        files = self._project()
+        files["tpu_node_checker/race_state.py"] = (
+            "import threading\n"
+            "class State:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._snap = None\n"
+            "    def publish(self, snap):\n"
+            "        with self._lock:\n"
+            "            self._snap = snap\n"
+        )
+        files["tpu_node_checker/race_helper.py"] = (
+            "from tpu_node_checker.race_state import State\n"
+            "def reset(state: 'State'):\n"
+            "    state._snap = None\n"
+        )
+        root = _mini(tmp_path, files)
+        report = run_project(root, only_rules=["lockset-race"])
+        assert report.findings == []  # SANCTIONED_LOCKFREE: atomic swap
+
+
+class TestSnapshotEscape:
+    def test_corpus_seeds_cover_every_escape_shape(self):
+        report = run_project(str(CORPUS_ROOT),
+                             only_rules=["snapshot-escape"])
+        lines = {(f.path, f.line) for f in report.findings
+                 if f.code == "TNC113"}  # engine meta findings still run
+        source = (CORPUS_ROOT / "tpu_node_checker" / "server"
+                  / "escape.py").read_text().splitlines()
+        expected = {
+            ("tpu_node_checker/server/escape.py", i + 1)
+            for i, line in enumerate(source) if "EXPECT[TNC113]" in line
+        }
+        assert lines == expected
+        # Four distinct escape shapes seeded: store/feed/return/callee.
+        assert len(expected) == 4
+
+    def test_feed_mutation_outside_server_dir_fires(self, tmp_path):
+        # TNC102 never looks outside server/ — the dataflow rule must.
+        root = _mini(tmp_path, {
+            "tpu_node_checker/pub.py": (
+                "class P:\n"
+                "    def __init__(self):\n"
+                "        self._snap = None\n"
+                "    def publish(self, payload):\n"
+                "        entities = dict(payload)\n"
+                "        snap = {'entities': entities}\n"
+                "        self._snap = snap\n"
+                "        entities['late'] = 1\n"
+            ),
+        })
+        report = run_project(root, only_rules=["snapshot-escape"])
+        (finding,) = report.findings
+        assert finding.code == "TNC113"
+        assert "'entities'" in finding.message
+
+
+class TestGraphDumpCli:
+    def test_graph_json_document(self, capsys):
+        from tpu_node_checker.analysis.__main__ import EXIT_CLEAN, main
+
+        rc = main(["--root", str(CORPUS_ROOT), "--graph", "json"])
+        assert rc == EXIT_CLEAN
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc) >= {"modules", "functions", "classes", "edges",
+                            "counts", "unresolved", "thread_entries",
+                            "multi_domain_functions", "build_ms"}
+        assert doc["counts"]["resolved"] > 0
+        # The corpus spawns a worker thread (flowpkg/spawn.py).
+        assert any(e["kind"] == "thread" for e in doc["thread_entries"])
+
+
+class TestIncrementalCache:
+    def _run(self, root, cache):
+        from tpu_node_checker.analysis.cache import run_incremental
+
+        return run_incremental(str(root), cache_path=str(cache))
+
+    def _key(self, report):
+        return (
+            [f.to_dict() for f in report.findings],
+            [f.to_dict() for f in report.suppressed],
+            report.unused_suppressions,
+            report.files_scanned,
+        )
+
+    @pytest.fixture()
+    def corpus_copy(self, tmp_path):
+        import shutil
+
+        dst = tmp_path / "repo"
+        shutil.copytree(CORPUS_ROOT, dst,
+                        ignore=shutil.ignore_patterns("__pycache__"))
+        return dst
+
+    def test_cold_then_warm_matches_full_run(self, corpus_copy, tmp_path):
+        cache = tmp_path / "cache.json"
+        full = run_project(str(corpus_copy))
+        cold = self._run(corpus_copy, cache)
+        warm = self._run(corpus_copy, cache)
+        assert self._key(cold) == self._key(full)
+        assert self._key(warm) == self._key(full)
+        assert cold.cached_files == 0
+        assert warm.cached_files > 0
+
+    def test_changed_file_relints_and_matches_full(self, corpus_copy,
+                                                   tmp_path):
+        cache = tmp_path / "cache.json"
+        self._run(corpus_copy, cache)
+        target = corpus_copy / "tpu_node_checker" / "defaults.py"
+        target.write_text(target.read_text()
+                          + "\ndef fresh(x=[]):\n    return x\n")
+        inc = self._run(corpus_copy, cache)
+        full = run_project(str(corpus_copy))
+        assert self._key(inc) == self._key(full)
+        assert any(f.line > 1 and f.path.endswith("defaults.py")
+                   for f in inc.findings)
+
+    def test_graph_rule_replayed_until_slice_changes(self, corpus_copy,
+                                                     tmp_path):
+        cache = tmp_path / "cache.json"
+        self._run(corpus_copy, cache)
+        # README-only change: contracts re-run, graph rules replay.
+        readme = corpus_copy / "README.md"
+        readme.write_text(readme.read_text() + "\nextra line\n")
+        inc = self._run(corpus_copy, cache)
+        assert "TNC203" in inc.timings_ms
+        assert "TNC111" not in inc.timings_ms
+        # Package change inside TNC111's slice: the rule re-runs.
+        storeio = corpus_copy / "tpu_node_checker" / "storeio.py"
+        storeio.write_text(storeio.read_text() + "\n# moved\n")
+        inc2 = self._run(corpus_copy, cache)
+        assert "TNC111" in inc2.timings_ms
+        full = run_project(str(corpus_copy))
+        assert self._key(inc2) == self._key(full)
+
+    def test_graph_suppression_unused_after_path_disappears(self, tmp_path):
+        import shutil
+
+        src = _mini(tmp_path / "proj",
+                    _tnc111_project(True, suppress_root=True))
+        cache = tmp_path / "cache.json"
+        first = self._run(Path(src), cache)
+        assert first.findings == [] and len(first.suppressed) == 1
+        # The blocking path disappears; the waiver must surface as
+        # unused THROUGH the incremental path too.
+        for rel, content in _tnc111_project(False,
+                                            suppress_root=True).items():
+            (Path(src) / rel).write_text(content)
+        second = self._run(Path(src), cache)
+        assert any(u["rule"] == "transitive-blocking"
+                   for u in second.unused_suppressions)
+        shutil.rmtree(src, ignore_errors=True)
+
+    def test_analyzer_source_change_invalidates_everything(
+            self, corpus_copy, tmp_path, monkeypatch):
+        # Editing a rule's LOGIC moves no code/slug, but the cached
+        # verdicts were produced by the old semantics — the fingerprint
+        # hashes the installed analyzer's sources, so every entry drops.
+        from tpu_node_checker.analysis import cache as cache_mod
+
+        cache = tmp_path / "cache.json"
+        self._run(corpus_copy, cache)
+        warm = self._run(corpus_copy, cache)
+        assert warm.cached_files > 0
+        monkeypatch.setattr(cache_mod, "_analysis_sources_sha",
+                            lambda: "rule-logic-changed")
+        invalidated = self._run(corpus_copy, cache)
+        assert invalidated.cached_files == 0
+        assert self._key(invalidated) == self._key(warm)
+
+    def test_corrupt_cache_degrades_to_full_run(self, corpus_copy,
+                                                tmp_path):
+        cache = tmp_path / "cache.json"
+        cache.write_text("{not json")
+        report = self._run(corpus_copy, cache)
+        full = run_project(str(corpus_copy))
+        assert self._key(report) == self._key(full)
+
+    def test_rule_filter_rejects_changed_only(self, capsys):
+        from tpu_node_checker.analysis.__main__ import EXIT_USAGE, main
+
+        rc = main(["--root", str(CORPUS_ROOT), "--changed-only",
+                   "--rule", "mutable-default"])
+        assert rc == EXIT_USAGE
+        assert "bypasses" in capsys.readouterr().err
+
+
+class TestTimingsSurface:
+    def test_json_report_carries_timings(self, capsys):
+        from tpu_node_checker.analysis.__main__ import main
+
+        main(["--root", str(CORPUS_ROOT), "--format", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        t = doc["timings_ms"]
+        assert "parse" in t and "total" in t and "graph_build" in t
+        for code in ("TNC111", "TNC112", "TNC113"):
+            assert code in t
+        assert doc["schema"] == 2
+
+    def test_human_output_has_timing_line(self, capsys):
+        from tpu_node_checker.analysis.__main__ import main
+
+        main(["--root", str(CORPUS_ROOT)])
+        out = capsys.readouterr().out
+        assert "tnc-lint timings: total" in out

@@ -45,11 +45,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--rule", action="append", metavar="SLUG",
-        help="run only this rule (repeatable; default: all)",
+        help="run only this rule (repeatable; default: all; bypasses the "
+        "incremental cache — a filtered run is not the repo verdict)",
     )
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule table (code, slug, invariant) and exit 0",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="incremental mode: replay per-file findings cached by "
+        "content sha256, re-run graph rules only when a file in their "
+        "reachability slice changed (same report as a full run)",
+    )
+    parser.add_argument(
+        "--cache", metavar="FILE", default=None,
+        help="cache file for --changed-only "
+        "(default: <root>/.tnc-lint-cache.json)",
+    )
+    parser.add_argument(
+        "--graph", choices=("json",), default=None,
+        help="dump the whole-program call graph (symbols, edges, "
+        "thread entries, domains, unresolved bucket) and exit 0",
     )
     try:
         args = parser.parse_args(argv)
@@ -75,9 +92,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"(see --list-rules)", file=sys.stderr,
             )
             return EXIT_USAGE
+        if args.changed_only:
+            print("tnc-lint: --rule bypasses the incremental cache; drop "
+                  "--changed-only for filtered runs", file=sys.stderr)
+            return EXIT_USAGE
+
+    if args.graph is not None:
+        try:
+            return _dump_graph(os.path.abspath(args.root))
+        except NotAProjectRoot as exc:
+            print(f"tnc-lint: {exc}", file=sys.stderr)
+            return EXIT_USAGE
 
     try:
-        report = run_project(os.path.abspath(args.root), only_rules=args.rule)
+        if args.changed_only:
+            from tpu_node_checker.analysis.cache import run_incremental
+
+            report = run_incremental(os.path.abspath(args.root),
+                                     cache_path=args.cache)
+        else:
+            report = run_project(os.path.abspath(args.root),
+                                 only_rules=args.rule)
     except NotAProjectRoot as exc:
         print(f"tnc-lint: {exc}", file=sys.stderr)
         return EXIT_USAGE
@@ -88,6 +123,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         return EXIT_INTERNAL
     print(render_json(report) if args.format == "json" else render_human(report))
     return EXIT_FINDINGS if report.findings else EXIT_CLEAN
+
+
+def _dump_graph(root: str) -> int:
+    """``--graph json``: the whole-program view as one stable document."""
+    import json
+    import time
+
+    from tpu_node_checker.analysis.engine import load_project
+    from tpu_node_checker.analysis.flow import build_graph, infer_entries
+    from tpu_node_checker.analysis.flow.entries import compute_domains
+
+    t0 = time.perf_counter()
+    project = load_project(root)
+    graph = build_graph(project)
+    entries = infer_entries(graph)
+    domains = compute_domains(graph, entries)
+    doc = graph.to_dict()
+    doc["thread_entries"] = [
+        {"domain": e.domain, "function": e.fid, "kind": e.kind,
+         "site": f"{e.path}:{e.lineno}"}
+        for e in entries
+    ]
+    doc["multi_domain_functions"] = {
+        fid: sorted(doms) for fid, doms in sorted(domains.items())
+        if len(doms) > 1
+    }
+    doc["build_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    return EXIT_CLEAN
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ import ast
 import io
 import json
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -45,7 +46,9 @@ _PY_ROOTS = ("tpu_node_checker", "tests")
 _PY_EXTRAS = ("bench.py",)
 _EXCLUDE_PARTS = ("__pycache__", "analysis_fixtures")
 
-JSON_SCHEMA_VERSION = 1
+# v2: adds top-level ``timings_ms`` (parse, graph_build, per-rule, total)
+# — additive, but versioned so CI artifact consumers can tell.
+JSON_SCHEMA_VERSION = 2
 
 
 @dataclass(frozen=True)
@@ -128,6 +131,13 @@ class Report:
     # reach after a refactor (e.g. a broad except that currently re-raises),
     # and that documentation is worth keeping.
     unused_suppressions: List[dict] = field(default_factory=list)
+    # Per-rule wall cost in ms (keyed by rule code), plus the engine's own
+    # phases: "parse", "graph_build" (the flow tier, when it ran), "total".
+    # The whole-repo run is a CI gate — it stays benchmarkable or it rots.
+    timings_ms: Dict[str, float] = field(default_factory=dict)
+    # How many files were replayed from the incremental cache (0 on full
+    # runs) — surfaced so a cached verdict is never mistaken for a scan.
+    cached_files: int = 0
 
     @property
     def exit_code(self) -> int:
@@ -137,9 +147,12 @@ class Report:
         return {
             "schema": JSON_SCHEMA_VERSION,
             "files_scanned": self.files_scanned,
+            "cached_files": self.cached_files,
             "findings": [f.to_dict() for f in self.findings],
             "suppressed": [f.to_dict() for f in self.suppressed],
             "unused_suppressions": self.unused_suppressions,
+            "timings_ms": {k: round(v, 2)
+                           for k, v in sorted(self.timings_ms.items())},
         }
 
 
@@ -208,9 +221,11 @@ def _apply_suppressions(
     return active, suppressed
 
 
-def load_project(root: str) -> Project:
-    """Parse every walked file once.  Raises ``NotAProjectRoot`` when the
-    root does not look like a checkout (no ``tpu_node_checker/`` dir)."""
+# Non-Python contract surfaces the drift rules read.
+TEXT_SURFACES = ("README.md", "deploy/prometheusrule.yaml", "docs/DESIGN.md")
+
+
+def check_project_root(root: str) -> None:
     import os
 
     if not os.path.isdir(os.path.join(root, "tpu_node_checker")):
@@ -218,7 +233,13 @@ def load_project(root: str) -> Project:
             f"{root!r} does not contain a tpu_node_checker/ package — "
             "run from a checkout or pass --root"
         )
-    project = Project(root=root)
+
+
+def walk_py_paths(root: str) -> List[str]:
+    """Root-relative POSIX paths of every Python file in the walk — the
+    ONE enumeration shared by full runs and the incremental cache."""
+    import os
+
     py_paths: List[str] = []
     for top in _PY_ROOTS:
         top_abs = os.path.join(root, top)
@@ -228,24 +249,43 @@ def load_project(root: str) -> Project:
             )
             for name in sorted(filenames):
                 if name.endswith(".py"):
-                    py_paths.append(os.path.join(dirpath, name))
+                    rel = os.path.relpath(
+                        os.path.join(dirpath, name), root
+                    ).replace(os.sep, "/")
+                    py_paths.append(rel)
     for extra in _PY_EXTRAS:
-        extra_abs = os.path.join(root, extra)
-        if os.path.isfile(extra_abs):
-            py_paths.append(extra_abs)
-    for abs_path in py_paths:
-        rel = os.path.relpath(abs_path, root).replace(os.sep, "/")
-        with open(abs_path, "r", encoding="utf-8") as fh:
-            source = fh.read()
-        try:
-            tree = ast.parse(source, filename=rel)
-        except SyntaxError:
-            tree = None
-        project.files[rel] = FileContext(path=rel, source=source, tree=tree)
-        if tree is not None:
-            for virt in _embedded_scripts(rel, tree):
-                project.files[virt.path] = virt
-    for rel in ("README.md", "deploy/prometheusrule.yaml", "docs/DESIGN.md"):
+        if os.path.isfile(os.path.join(root, extra)):
+            py_paths.append(extra)
+    return py_paths
+
+
+def load_py_file(root: str, rel: str, project: Project) -> None:
+    """Parse one walked file (plus its embedded-script virtual files)
+    into ``project.files``."""
+    import os
+
+    with open(os.path.join(root, rel), "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError:
+        tree = None
+    project.files[rel] = FileContext(path=rel, source=source, tree=tree)
+    if tree is not None:
+        for virt in _embedded_scripts(rel, tree):
+            project.files[virt.path] = virt
+
+
+def load_project(root: str) -> Project:
+    """Parse every walked file once.  Raises ``NotAProjectRoot`` when the
+    root does not look like a checkout (no ``tpu_node_checker/`` dir)."""
+    import os
+
+    check_project_root(root)
+    project = Project(root=root)
+    for rel in walk_py_paths(root):
+        load_py_file(root, rel, project)
+    for rel in TEXT_SURFACES:
         abs_path = os.path.join(root, rel)
         if os.path.isfile(abs_path):
             with open(abs_path, "r", encoding="utf-8") as fh:
@@ -290,49 +330,87 @@ def _embedded_scripts(rel: str, tree: ast.AST) -> Iterable[FileContext]:
         )
 
 
-def run_project(root: str, only_rules: Optional[Iterable[str]] = None) -> Report:
-    """Walk + parse + run every registered rule; apply suppressions."""
-    from tpu_node_checker.analysis.rules import FILE_RULES, PROJECT_RULES
+def lint_file(ctx: FileContext, wanted: Optional[set],
+              timings: Optional[Dict[str, float]] = None,
+              ) -> Tuple[List[Finding], List[Finding]]:
+    """One file through suppression extraction + every per-file rule.
 
-    wanted = set(only_rules) if only_rules else None
-    project = load_project(root)
+    Returns ``(active, suppressed)``; marks ``ctx.suppressions`` used.
+    Shared verbatim by the full run and the incremental cache's
+    changed-file path, so the two can never disagree on a file's verdict.
+    """
+    from tpu_node_checker.analysis.rules import FILE_RULES
+
     findings: List[Finding] = []
-    suppressed: List[Finding] = []
-
-    for ctx in project.files.values():
-        file_findings: List[Finding] = []
-        if ctx.tree is None:
-            slug, code = CODE_SYNTAX_ERROR
-            findings.append(Finding(
-                slug, code, ctx.path, 1, 0, "file does not parse as Python"
-            ))
+    if ctx.tree is None:
+        slug, code = CODE_SYNTAX_ERROR
+        return [Finding(slug, code, ctx.path, 1, 0,
+                        "file does not parse as Python")], []
+    sups, meta = extract_suppressions(ctx.source)
+    for sup in sups:  # virtual files: shift to host-file coordinates
+        sup.line += ctx.line_offset
+    ctx.suppressions = sups
+    for m in meta:  # malformed suppressions: never suppressable
+        findings.append(Finding(m.rule, m.code, ctx.path,
+                                m.line + ctx.line_offset, m.col,
+                                m.message))
+    file_findings: List[Finding] = []
+    for rule in FILE_RULES:
+        if wanted is not None and rule.slug not in wanted:
             continue
-        sups, meta = extract_suppressions(ctx.source)
-        for sup in sups:  # virtual files: shift to host-file coordinates
-            sup.line += ctx.line_offset
-        ctx.suppressions = sups
-        for m in meta:  # malformed suppressions: never suppressable
-            findings.append(Finding(m.rule, m.code, ctx.path,
-                                    m.line + ctx.line_offset, m.col,
-                                    m.message))
-        for rule in FILE_RULES:
-            if wanted is not None and rule.slug not in wanted:
-                continue
-            file_findings.extend(rule.check_file(ctx))
-        active, shushed = _apply_suppressions(ctx, file_findings)
-        findings.extend(active)
-        suppressed.extend(shushed)
+        t0 = time.perf_counter()
+        file_findings.extend(rule.check_file(ctx))
+        if timings is not None:
+            timings[rule.code] = (timings.get(rule.code, 0.0)
+                                  + (time.perf_counter() - t0) * 1e3)
+    active, shushed = _apply_suppressions(ctx, file_findings)
+    return findings + active, shushed
 
-    project_findings: List[Finding] = []
+
+def run_project_rules(project: Project, wanted: Optional[set],
+                      timings: Optional[Dict[str, float]] = None,
+                      only_codes: Optional[set] = None,
+                      ) -> Dict[str, List[Finding]]:
+    """Every project rule (drift + graph) -> raw findings per rule code.
+
+    ``only_codes`` lets the incremental cache re-run just the rules whose
+    input slice changed.  Timing attributes the flow tier's one-time graph
+    build to ``graph_build``, not to whichever rule happened to go first.
+    """
+    from tpu_node_checker.analysis.rules import PROJECT_RULES
+
+    out: Dict[str, List[Finding]] = {}
+    prev_build = 0.0
     for rule in PROJECT_RULES:
         if wanted is not None and rule.slug not in wanted:
             continue
-        project_findings.extend(rule.check_project(project))
-    # Project findings land on concrete files too — honor suppressions in
-    # Python surfaces (e.g. a deliberately-undocumented internal flag).
+        if only_codes is not None and rule.code not in only_codes:
+            continue
+        t0 = time.perf_counter()
+        out[rule.code] = list(rule.check_project(project))
+        elapsed = (time.perf_counter() - t0) * 1e3
+        if timings is not None:
+            state = getattr(project, "_flow_state", None)
+            build = state.build_ms if state is not None else 0.0
+            if build != prev_build:  # this rule triggered the graph build
+                timings["graph_build"] = build
+                elapsed = max(0.0, elapsed - (build - prev_build))
+                prev_build = build
+            timings[rule.code] = timings.get(rule.code, 0.0) + elapsed
+    return out
+
+
+def apply_project_findings(project: Project,
+                           per_rule: Dict[str, List[Finding]],
+                           findings: List[Finding],
+                           suppressed: List[Finding]) -> None:
+    """Project findings land on concrete files too — honor suppressions in
+    Python surfaces (e.g. a deliberately-undocumented internal flag, or a
+    graph-rule waiver on a read-path ROOT function)."""
     by_path: Dict[str, List[Finding]] = {}
-    for f in project_findings:
-        by_path.setdefault(f.path, []).append(f)
+    for group in per_rule.values():
+        for f in group:
+            by_path.setdefault(f.path, []).append(f)
     for path, group in by_path.items():
         ctx = project.files.get(path)
         if ctx is None:
@@ -342,6 +420,8 @@ def run_project(root: str, only_rules: Optional[Iterable[str]] = None) -> Report
         findings.extend(active)
         suppressed.extend(shushed)
 
+
+def collect_unused_suppressions(project: Project) -> List[dict]:
     unused = [
         {"path": ctx.path, "line": sup.line, "rule": sup.rule,
          "reason": sup.reason}
@@ -350,10 +430,34 @@ def run_project(root: str, only_rules: Optional[Iterable[str]] = None) -> Report
         if not sup.used
     ]
     unused.sort(key=lambda u: (u["path"], u["line"], u["rule"]))
+    return unused
+
+
+def run_project(root: str, only_rules: Optional[Iterable[str]] = None) -> Report:
+    """Walk + parse + run every registered rule; apply suppressions."""
+    t_start = time.perf_counter()
+    timings: Dict[str, float] = {}
+    wanted = set(only_rules) if only_rules else None
+    t0 = time.perf_counter()
+    project = load_project(root)
+    timings["parse"] = (time.perf_counter() - t0) * 1e3
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+
+    for ctx in project.files.values():
+        active, shushed = lint_file(ctx, wanted, timings)
+        findings.extend(active)
+        suppressed.extend(shushed)
+
+    per_rule = run_project_rules(project, wanted, timings)
+    apply_project_findings(project, per_rule, findings, suppressed)
+
+    unused = collect_unused_suppressions(project)
     findings.sort(key=Finding.sort_key)
     suppressed.sort(key=Finding.sort_key)
+    timings["total"] = (time.perf_counter() - t_start) * 1e3
     return Report(findings, suppressed, files_scanned=len(project.files),
-                  unused_suppressions=unused)
+                  unused_suppressions=unused, timings_ms=timings)
 
 
 def render_human(report: Report) -> str:
@@ -366,12 +470,31 @@ def render_human(report: Report) -> str:
             "matched no finding (informational — the waiver may have "
             "outlived the code it excused)"
         )
+    cached = (f" ({report.cached_files} replayed from cache)"
+              if report.cached_files else "")
     lines.append(
         f"tnc-lint: {len(report.findings)} finding(s), "
         f"{len(report.suppressed)} suppressed, "
         f"{len(report.unused_suppressions)} unused suppression(s), "
-        f"{report.files_scanned} files scanned"
+        f"{report.files_scanned} files scanned{cached}"
     )
+    t = report.timings_ms
+    if t:
+        phases = ", ".join(
+            f"{key} {t[key]:.0f}ms" for key in ("parse", "graph_build")
+            if key in t
+        )
+        rules = sorted(
+            ((k, v) for k, v in t.items()
+             if k not in ("parse", "graph_build", "total")),
+            key=lambda kv: -kv[1],
+        )[:3]
+        slowest = ", ".join(f"{k} {v:.0f}ms" for k, v in rules)
+        lines.append(
+            f"tnc-lint timings: total {t.get('total', 0.0):.0f}ms"
+            + (f" ({phases}; slowest rules: {slowest})" if phases or slowest
+               else "")
+        )
     return "\n".join(lines)
 
 

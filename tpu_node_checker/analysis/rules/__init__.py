@@ -13,9 +13,10 @@ from typing import List
 
 from tpu_node_checker.analysis.rules.base import Rule
 from tpu_node_checker.analysis.rules import contracts, invariants, locks
+from tpu_node_checker.analysis.flow import rules as flow
 
 FILE_RULES: List[Rule] = list(invariants.RULES) + list(locks.RULES)
-PROJECT_RULES: List[Rule] = list(contracts.RULES)
+PROJECT_RULES: List[Rule] = list(contracts.RULES) + list(flow.RULES)
 ALL_RULES: List[Rule] = FILE_RULES + PROJECT_RULES
 
 RULE_SLUGS = frozenset(rule.slug for rule in ALL_RULES)

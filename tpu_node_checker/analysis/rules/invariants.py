@@ -88,8 +88,13 @@ class BlockingReadPath(Rule):
            "locks and does no blocking I/O")
 
     # Builder-side functions in snapshot.py: run once per round, off the
-    # request path, so blocking work is their job.
-    _SNAPSHOT_BUILDERS = ("build_", "json_entity", "__init__")
+    # request path, so blocking work is their job.  The TrendCache's
+    # ``_rebuild``/``_build_entity`` belong here too: they execute on the
+    # tnc-trend-swr thread (or the sanctioned first build), and the
+    # transitive rule (TNC111) surfaced them as phantom read roots when
+    # they were enumerated as such.
+    _SNAPSHOT_BUILDERS = ("build_", "json_entity", "__init__",
+                          "_rebuild", "_build_entity")
 
     def _read_path_functions(self, ctx: FileContext):
         if ctx.path == "tpu_node_checker/server/snapshot.py":

@@ -751,6 +751,7 @@ class FleetStateServer:
             body["round"] = self._snap.seq
         return json_response(200 if ok else 503, body)
 
+    # tnc: allow-transitive-blocking(the per-scrape stats block reads counters under FleetStats._lock by design — DESIGN §13: /metrics is the one endpoint whose body moves every scrape, and a scrape is not the 50k req/s fast path; the fast-path responders stay lock-free and separately rooted)
     def _get_metrics(self, req: Request) -> Response:
         """The round's fleet families + this server's live request stats.
 

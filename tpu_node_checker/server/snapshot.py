@@ -490,6 +490,7 @@ class TrendCache:
 
         return (seq, file_signature(self.path))
 
+    # tnc: allow-transitive-blocking(the SWR first build is the one sanctioned synchronous store read — once per process, before any stale entity exists to serve; every later rebuild runs on the tnc-trend-swr thread, per the TNC011 exception annotated on the lock below)
     def entity(self, seq: int) -> Entity:
         key = self._signature(seq)
         # tnc: allow-blocking-read-path(the sanctioned exception — DESIGN §10/§13: one stat per request; the lock guards flag flips and the FIRST build only, every later rebuild runs on a tnc-trend-swr thread while readers get the stale entity)
@@ -517,7 +518,9 @@ class TrendCache:
 
     def _rebuild(self, key) -> None:
         entity = self._build_entity()
-        with self._lock:  # tnc: allow-blocking-read-path(runs on the tnc-trend-swr thread, never a request thread; the lock guards the commit flags only)
+        # Runs on the tnc-trend-swr thread, never a request thread (it is
+        # a builder in TNC011's enumeration); the lock guards commit flags.
+        with self._lock:
             # Last writer wins: commit unconditionally (the build read the
             # file as it is NOW), clear pending only if no newer key change
             # superseded this rebuild mid-flight.
