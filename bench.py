@@ -441,6 +441,97 @@ def _serve_load_harness(payload: dict, exit_code: int, workers: int = 2):
         os.unlink(payload_file.name)
 
 
+def _bench_trend_100k() -> dict:
+    """Fleet analytics at 100k-round scale — ROADMAP item 5's named case
+    (BENCH_r13): a 100-node fleet's 1000 rounds (100k history lines)
+    queried two ways.  The RAW leg replays the whole JSONL per query —
+    the pre-analytics cost every --trend-style question paid.  The
+    ROLL-UP leg answers from the segment store's running aggregates +
+    retained closed buckets (ingest folds each round ONCE, when it
+    happens).  Honesty gates before any number: the roll-up node stats
+    must EQUAL the raw replay's, and the roll-up path must (a) be ≥10x
+    faster and (b) answer under 50 ms p50.
+
+    Also runnable alone (``python bench.py --trend-100k``): the case is
+    pure CPU + local files, so it grades this PR's acceptance on boxes
+    whose loopback-bound legacy cases cannot meet their absolute-ms
+    budgets.
+    """
+    import random as _random
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from tpu_node_checker.analytics import SegmentStore, build_analytics_docs
+    from tpu_node_checker.analytics.queries import replay_raw
+
+    trend_nodes_n, trend_rounds = 100, 1000
+    rng = _random.Random(13)
+    ana_dir = _tempfile.mkdtemp(prefix="bench-analytics-")
+    hist_path = os.path.join(ana_dir, "history.jsonl")
+    t0 = 1_700_000_000.0
+    store = SegmentStore(os.path.join(ana_dir, "segments"))
+    store.load()
+    last_ok: dict = {}
+    with open(hist_path, "w", encoding="utf-8") as hist_f:
+        for r in range(trend_rounds):
+            ts = t0 + 30.0 * r
+            for i in range(trend_nodes_n):
+                node = f"bench-tpu-{i:03d}"
+                ok = rng.random() < (0.5 if i < 5 else 0.995)
+                hist_f.write(json.dumps({
+                    "schema": 1, "node": node, "ts": ts, "ok": ok,
+                    "state": "HEALTHY" if ok else "SUSPECT",
+                }) + "\n")
+                flipped = node in last_ok and last_ok[node] != ok
+                last_ok[node] = ok
+                store.observe(node, ts, ok,
+                              "HEALTHY" if ok else "SUSPECT", flipped,
+                              group={"cluster": "bench"})
+            if r % 50 == 0:
+                store.flush(ts)
+    store.flush(t0 + 30.0 * trend_rounds + 86_400.0)
+    # Equivalence gate: the roll-up fold must match the raw replay
+    # exactly — a fast wrong answer is not a bench number.
+    oracle = replay_raw(hist_path)
+    assert len(oracle) == trend_nodes_n
+    for node, want in oracle.items():
+        got = store.node_stats[node]
+        assert (got["n"], got["ok"], got["flips"], got["onsets"]) == (
+            want["n"], want["ok"], want["flips"], want["onsets"]
+        ), node
+    raw_ms = []
+    for _ in range(5):
+        t_start = time.perf_counter()
+        replay_raw(hist_path)
+        raw_ms.append((time.perf_counter() - t_start) * 1000.0)
+    trend_raw_p50 = _case_p50("trend_100k_rounds_raw", raw_ms)
+    rollup_ms = []
+    for _ in range(21):
+        t_start = time.perf_counter()
+        docs = build_analytics_docs(store)
+        rollup_ms.append((time.perf_counter() - t_start) * 1000.0)
+    assert docs["slo"]["fleet"]["nodes"] == trend_nodes_n
+    assert docs["offenders"]["offenders"][0]["node"].startswith("bench-tpu-00")
+    trend_rollup_p50 = _case_p50("trend_100k_rounds", rollup_ms)
+    trend_speedup = trend_raw_p50 / trend_rollup_p50
+    assert trend_rollup_p50 < 50.0, (
+        f"roll-up analytics query p50 {trend_rollup_p50:.1f}ms breaches "
+        "the 50ms budget"
+    )
+    assert trend_speedup >= 10.0, (
+        f"roll-up path only {trend_speedup:.1f}x over raw replay "
+        f"({trend_rollup_p50:.1f}ms vs {trend_raw_p50:.1f}ms) — the ≥10x "
+        "gate failed"
+    )
+    _shutil.rmtree(ana_dir, ignore_errors=True)
+    return {
+        "trend_100k_rounds_p50_ms": round(trend_rollup_p50, 3),
+        "trend_100k_rounds_raw_p50_ms": round(trend_raw_p50, 2),
+        "trend_100k_rounds_speedup": round(trend_speedup, 1),
+        "trend_100k_history_lines": trend_nodes_n * trend_rounds,
+    }
+
+
 def main() -> int:
     fx = _fixtures()
     payload = json.dumps(fx.node_list(fx.tpu_v5e_256_slice())).encode()
@@ -1306,6 +1397,12 @@ def main() -> int:
         [ms for run in sim_runs for ms in run.round_ms],
     )
 
+    # -- fleet analytics: 100k-round history, roll-ups vs raw replay --------
+    trend_case = _bench_trend_100k()
+    trend_rollup_p50 = trend_case["trend_100k_rounds_p50_ms"]
+    trend_raw_p50 = trend_case["trend_100k_rounds_raw_p50_ms"]
+    trend_speedup = trend_case["trend_100k_rounds_speedup"]
+
     # -- tnc-lint whole-repo cost (the ISSUE 13 flow tier) ------------------
     # The repo-wide lint is a CI gate, so its cost is part of the
     # development loop's trajectory.  Two full runs (cold rule state each:
@@ -1376,6 +1473,9 @@ def main() -> int:
                 "nodes5k_watch_churn1pct_p50_ms": round(watch_churn_p50, 2),
                 "nodes5k_fault30_p50_ms": round(nodes5k_fault30_p50, 2),
                 "sim_flapstorm_rounds_p50_ms": round(sim_flapstorm_p50, 2),
+                "trend_100k_rounds_p50_ms": round(trend_rollup_p50, 3),
+                "trend_100k_rounds_raw_p50_ms": round(trend_raw_p50, 2),
+                "trend_100k_rounds_speedup": round(trend_speedup, 1),
                 "lint_full_repo_p50_ms": round(lint_full_repo_p50, 2),
                 "lint_graph_flow_p50_ms": round(lint_graph_flow_p50, 2),
                 "serve_etag_hit_p50_ms": round(serve_etag_p50, 3),
@@ -1439,4 +1539,19 @@ def _provenance() -> dict:
 if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--serve-child":
         sys.exit(_serve_child(sys.argv[2], int(sys.argv[3])))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--trend-100k":
+        # The fleet-analytics case alone (gates asserted inside): JSON on
+        # stdout with the same sample-stats/provenance honesty as a full
+        # run.
+        case = _bench_trend_100k()
+        print(json.dumps({
+            "metric": "trend_100k_rounds_p50_ms",
+            "value": case["trend_100k_rounds_p50_ms"],
+            "unit": "ms",
+            **case,
+            "sample_stats": _SAMPLE_STATS,
+            "variance_warnings": _VARIANCE_WARNINGS,
+            **_provenance(),
+        }))
+        sys.exit(0)
     sys.exit(main())

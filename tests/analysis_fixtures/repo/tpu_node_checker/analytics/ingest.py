@@ -1,0 +1,25 @@
+"""Seeds for TNC021's call-site half: raw segment writes outside
+segments.py are findings; the append_bucket gate is the sanctioned path."""
+
+import json
+
+from tpu_node_checker.analytics import segments
+
+
+def rogue_flush(path, records):
+    lines = [json.dumps(r) for r in records]
+    segments.rollup_append_lines(path, lines)  # EXPECT[TNC021]
+
+
+def rogue_compact(path, records):
+    segments.rollup_replace_file(  # EXPECT[TNC021]
+        path, [json.dumps(r) for r in records]
+    )
+
+
+def gated_flush(path, records):  # near-miss: through the gate
+    segments.append_bucket(path, records)
+
+
+def append_bucket_counts(counts):  # near-miss: suffix differs, no call
+    return sum(counts.values())

@@ -1,0 +1,37 @@
+"""Seeds for TNC021's sanctioned-module half: functions here that touch
+the raw segment I/O must prove their lines carry the schema major."""
+
+import json
+
+ROLLUP_SCHEMA_VERSION = 1
+
+
+def rollup_append_lines(path, lines):  # the primitive itself: exempt
+    with open(path, "a", encoding="utf-8") as f:
+        for line in lines:
+            f.write(line + "\n")
+
+
+def rollup_replace_file(path, lines):  # the primitive itself: exempt
+    with open(path + ".tmp", "w", encoding="utf-8") as f:
+        for line in lines:
+            f.write(line + "\n")
+
+
+def stamp_bucket(record):
+    return {"schema": ROLLUP_SCHEMA_VERSION, **record}
+
+
+def append_bucket(path, records):  # near-miss: stamps through the helper
+    rollup_append_lines(
+        path, [json.dumps(stamp_bucket(r)) for r in records]
+    )
+
+
+def compact(path, records):  # near-miss: filters by the schema constant
+    keep = [r for r in records if r.get("schema") == ROLLUP_SCHEMA_VERSION]
+    rollup_replace_file(path, [json.dumps(r) for r in keep])
+
+
+def append_unstamped(path, records):  # EXPECT[TNC021]
+    rollup_append_lines(path, [json.dumps(r) for r in records])

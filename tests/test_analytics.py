@@ -1,0 +1,787 @@
+"""Fleet analytics tier tests: segment store round-trip/compaction
+property test, roll-up == raw-replay equivalence, bounded tail reads,
+changepoint behavior, SLO queries, the checker wiring, and the served
+endpoints.
+
+Property-test style follows tests/test_history_store.py: seeded stdlib
+``random``, no external fuzzing dependency.
+"""
+
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from tpu_node_checker import checker, cli
+from tpu_node_checker.analytics import (
+    CusumFlapDetector,
+    SegmentStore,
+    build_analytics_docs,
+)
+from tpu_node_checker.analytics.queries import replay_raw
+from tpu_node_checker.analytics.segments import (
+    RESOLUTIONS,
+    ROLLUP_SCHEMA_VERSION,
+    bucket_start,
+)
+from tpu_node_checker.history.fsm import HEALTHY, SUSPECT, HealthFSM
+from tpu_node_checker.history.store import read_jsonl_tail
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    checker._ANALYTICS_CACHE["key"] = None
+    checker._ANALYTICS_CACHE["bundle"] = None
+    checker._HISTORY_CACHE["key"] = None
+    checker._HISTORY_CACHE["tracker"] = None
+    yield
+    checker._ANALYTICS_CACHE["key"] = None
+    checker._ANALYTICS_CACHE["bundle"] = None
+    checker._HISTORY_CACHE["key"] = None
+    checker._HISTORY_CACHE["tracker"] = None
+
+
+def _write_history(path, rows):
+    """rows: (node, ts, ok) triples → a --history JSONL file."""
+    with open(path, "w", encoding="utf-8") as f:
+        for node, ts, ok in rows:
+            f.write(json.dumps({
+                "schema": 1, "node": node, "ts": ts, "ok": ok,
+                "causes": [], "state": "HEALTHY" if ok else "SUSPECT",
+                "streak": 1, "flaps": 0, "flaps_total": 0,
+            }) + "\n")
+
+
+def _ingest(store, rows, flush_at=None):
+    """Feed (node, ts, ok) rows through observe with oracle-equivalent
+    flip computation, flushing at ``flush_at`` (default: after last ts +
+    the coarsest resolution, closing every bucket)."""
+    last_ok = {}
+    last_ts = 0.0
+    for node, ts, ok in rows:
+        flipped = node in last_ok and last_ok[node] != ok
+        last_ok[node] = ok
+        last_ts = max(last_ts, ts)
+        store.observe(node, ts, ok, "HEALTHY" if ok else "SUSPECT",
+                      flipped, group={"cluster": "c0"})
+    store.flush(flush_at if flush_at is not None
+                else last_ts + RESOLUTIONS[-1] + 1)
+
+
+def _stats_match(store, oracle):
+    assert sorted(store.node_stats) == sorted(oracle)
+    for node, want in oracle.items():
+        got = store.node_stats[node]
+        for key in ("n", "ok", "flips", "onsets", "repairs",
+                    "first_ts", "last_ts", "last_ok"):
+            assert got[key] == want[key], (node, key, got[key], want[key])
+        assert round(got["repair_s"], 3) == want["repair_s"], node
+
+
+# ---------------------------------------------------------------------------
+# Bounded tail reads (the read_jsonl_tail satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestReadJsonlTail:
+    def test_tail_equals_full_read_suffix(self, tmp_path):
+        p = tmp_path / "h.jsonl"
+        p.write_text("".join(json.dumps({"i": i}) + "\n" for i in range(500)))
+        entries, skipped, offset = read_jsonl_tail(str(p), max_lines=40)
+        assert skipped == 0 and offset == p.stat().st_size
+        assert [e["i"] for e in entries] == list(range(460, 500))
+
+    def test_max_lines_larger_than_file_reads_everything(self, tmp_path):
+        p = tmp_path / "h.jsonl"
+        p.write_text(json.dumps({"a": 1}) + "\n")
+        entries, _, _ = read_jsonl_tail(str(p), max_lines=10_000)
+        assert entries == [{"a": 1}]
+
+    def test_big_log_head_is_never_parsed(self, tmp_path):
+        # The O(file)-RAM regression pin: a huge MALFORMED head would
+        # inflate `skipped` if the loader touched it — a clean tail with
+        # skipped == 0 proves only the tail was parsed.
+        p = tmp_path / "big.jsonl"
+        with open(p, "w") as f:
+            for _ in range(200_000):
+                f.write("not json " * 4 + "\n")
+            for i in range(50):
+                f.write(json.dumps({"i": i}) + "\n")
+        entries, skipped, _ = read_jsonl_tail(str(p), max_lines=50)
+        assert skipped == 0
+        assert [e["i"] for e in entries] == list(range(50))
+
+    def test_offset_resume_sees_only_appended_lines(self, tmp_path):
+        p = tmp_path / "h.jsonl"
+        p.write_text(json.dumps({"i": 0}) + "\n")
+        _, _, offset = read_jsonl_tail(str(p))
+        with open(p, "a") as f:
+            f.write(json.dumps({"i": 1}) + "\n")
+        entries, _, offset2 = read_jsonl_tail(str(p), start_offset=offset)
+        assert [e["i"] for e in entries] == [1]
+        # Nothing new: an empty read, offset stable.
+        entries, _, offset3 = read_jsonl_tail(str(p), start_offset=offset2)
+        assert entries == [] and offset3 == offset2
+
+    def test_shrunk_file_is_reread_from_scratch(self, tmp_path):
+        p = tmp_path / "h.jsonl"
+        p.write_text("".join(json.dumps({"i": i}) + "\n" for i in range(9)))
+        _, _, offset = read_jsonl_tail(str(p))
+        p.write_text(json.dumps({"i": 99}) + "\n")  # compaction rewrite
+        entries, _, _ = read_jsonl_tail(str(p), start_offset=offset)
+        assert [e["i"] for e in entries] == [99]
+
+    def test_partial_tail_consumed_by_default_like_tolerant_loader(
+        self, tmp_path
+    ):
+        p = tmp_path / "h.jsonl"
+        p.write_text(json.dumps({"a": 1}) + "\n" + '{"torn": tru')
+        entries, skipped, _ = read_jsonl_tail(str(p))
+        assert entries == [{"a": 1}] and skipped == 1
+
+    def test_partial_tail_left_for_resume_when_asked(self, tmp_path):
+        p = tmp_path / "h.jsonl"
+        p.write_text(json.dumps({"a": 1}) + "\n" + '{"mid": 1')
+        entries, skipped, offset = read_jsonl_tail(
+            str(p), consume_partial_tail=False
+        )
+        assert entries == [{"a": 1}] and skipped == 0
+        # The writer finishes the line: the resumed read sees it WHOLE.
+        with open(p, "a") as f:
+            f.write(', "write": 2}\n')
+        entries, skipped, _ = read_jsonl_tail(str(p), start_offset=offset)
+        assert entries == [{"mid": 1, "write": 2}] and skipped == 0
+
+    def test_trend_output_byte_identical_under_the_tail_bound(
+        self, tmp_path, capsys
+    ):
+        # The acceptance pin: --trend over the same log must not change
+        # by a byte now that it reads through the bounded tail loader.
+        log = tmp_path / "trend.jsonl"
+        log.write_text("".join(
+            json.dumps({"ts": T0 + 60 * i, "exit_code": 0 if i % 5 else 3,
+                        "total_chips": 8, "ready_chips": 8 if i % 5 else 4})
+            + "\n"
+            for i in range(200)
+        ))
+        unbounded, _, _, _ = checker.compute_trend_summary(
+            str(log), max_lines=10**9
+        )
+        bounded, _, _, _ = checker.compute_trend_summary(str(log))
+        assert json.dumps(unbounded, sort_keys=True) == json.dumps(
+            bounded, sort_keys=True
+        )
+
+
+# ---------------------------------------------------------------------------
+# Segment store: round-trip, equivalence, compaction (seeded property)
+# ---------------------------------------------------------------------------
+
+
+class TestSegmentStore:
+    def test_rollup_equals_raw_replay(self, tmp_path):
+        rows = []
+        rng = random.Random(1)
+        for i in range(300):
+            rows.append((f"n{i % 5}", T0 + 7 * i, rng.random() < 0.7))
+        hist = tmp_path / "h.jsonl"
+        _write_history(str(hist), rows)
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        _ingest(store, rows)
+        _stats_match(store, replay_raw(str(hist)))
+
+    def test_closed_buckets_survive_restart(self, tmp_path):
+        rows = [("n0", T0 + i, i % 3 != 0) for i in range(120)]
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        _ingest(store, rows)
+        assert store.rollup_lines_total > 0
+        fresh = SegmentStore(str(tmp_path / "ana"))
+        fresh.load()
+        assert sorted(fresh.buckets) == sorted(store.buckets)
+        for key, rec in store.buckets.items():
+            got = fresh.buckets[key]
+            for field in ("n", "ok", "flips", "onsets", "repairs",
+                          "dwell", "last_ok", "cluster"):
+                assert got.get(field) == rec.get(field), (key, field)
+
+    def test_torn_final_segment_line_tolerated(self, tmp_path):
+        rows = [("n0", T0 + i, True) for i in range(120)]
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        _ingest(store, rows)
+        shard = store.shard_of("n0")
+        with open(store.segment_path(shard), "a") as f:
+            f.write('{"node": "n0", "res": 60, "bucket"')  # crash mid-append
+        fresh = SegmentStore(str(tmp_path / "ana"))
+        fresh.load()
+        assert fresh.skipped_lines == 1
+        assert len(fresh.buckets) == len(store.buckets)
+
+    def test_future_schema_lines_refused(self, tmp_path):
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        _ingest(store, [("n0", T0 + i, True) for i in range(120)])
+        shard = store.shard_of("n0")
+        with open(store.segment_path(shard), "a") as f:
+            f.write(json.dumps({
+                "schema": ROLLUP_SCHEMA_VERSION + 1, "node": "n0",
+                "res": 60, "bucket": int(T0) + 999_960, "n": 1, "ok": 1,
+            }) + "\n")
+        fresh = SegmentStore(str(tmp_path / "ana"))
+        fresh.load()
+        assert fresh.refused_lines == 1
+        assert ("n0", 60, int(T0) + 999_960) not in fresh.buckets
+
+    def test_sharding_matches_the_federation_ring(self, tmp_path):
+        from tpu_node_checker.federation.endpoints import HashRing
+
+        store = SegmentStore(str(tmp_path / "ana"), shards=8)
+        ring = HashRing(range(8))
+        for name in (f"gke-tpu-{i}" for i in range(50)):
+            assert store.shard_of(name) == ring.assign(name)
+
+    def test_seeded_property_compaction_and_crash(self, tmp_path,
+                                                  monkeypatch):
+        """1k random rounds: roll-up == raw-replay through restarts and
+        compactions, a crash mid-compaction (injected rename failure)
+        never corrupts the store, and compaction changes nothing
+        observable."""
+        rng = random.Random(0xA11A)
+        for case in range(4):
+            root = tmp_path / f"case{case}"
+            nodes = [f"n{i}" for i in range(rng.randint(1, 6))]
+            rows = []
+            ts = T0
+            for _ in range(1000 // max(1, len(nodes))):
+                ts += rng.choice([1.0, 5.0, 30.0])
+                for node in nodes:
+                    if rng.random() < 0.8:
+                        rows.append((node, ts, rng.random() < 0.6))
+            hist = root.with_suffix(".jsonl")
+            _write_history(str(hist), rows)
+            store = SegmentStore(str(root))
+            store.load()
+            last_ok = {}
+            for i, (node, row_ts, ok) in enumerate(rows):
+                flipped = node in last_ok and last_ok[node] != ok
+                last_ok[node] = ok
+                store.observe(node, row_ts, ok,
+                              "HEALTHY" if ok else "SUSPECT", flipped,
+                              group={"cluster": "c0"})
+                if i % 97 == 0:
+                    store.flush(row_ts)
+                if i % 211 == 0:
+                    # Crash mid-compaction: the rename fails once; the
+                    # store must stay readable and correct.
+                    real_replace = os.replace
+
+                    def _boom(src, dst):
+                        raise OSError("injected crash")
+
+                    monkeypatch.setattr(os, "replace", _boom)
+                    for shard in range(store.shards):
+                        store.compact_shard(shard)
+                    monkeypatch.setattr(os, "replace", real_replace)
+            store.flush(ts + RESOLUTIONS[-1] + 1)
+            for shard in range(store.shards):
+                store.compact_shard(shard)
+            # In-session aggregates == the raw-replay oracle.
+            _stats_match(store, replay_raw(str(hist)))
+            # Compaction left no tmp droppings and a reloadable store.
+            for shard in range(store.shards):
+                assert not os.path.exists(
+                    store.segment_path(shard) + ".tmp"
+                )
+            fresh = SegmentStore(str(root))
+            fresh.load()
+            assert fresh.skipped_lines == 0 and fresh.refused_lines == 0
+            for key, rec in fresh.buckets.items():
+                mine = store.buckets[key]
+                assert rec.get("n") == mine.get("n"), key
+                assert rec.get("flips") == mine.get("flips"), key
+
+    def test_restart_refold_stitches_past_fine_retention(self, tmp_path):
+        # 400 one-minute buckets — far past the 1m retention of 120.  The
+        # refold must stitch the coarser resolutions underneath the fine
+        # tail, so a restart keeps the FULL retained horizon instead of
+        # collapsing the aggregates to ~2 hours.
+        rng = random.Random(7)
+        rows = [("n0", T0 + 60.0 * i, rng.random() < 0.8)
+                for i in range(400)]
+        hist = tmp_path / "h.jsonl"
+        _write_history(str(hist), rows)
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        _ingest(store, rows)
+        fresh = SegmentStore(str(tmp_path / "ana"))
+        fresh.load()
+        _stats_match(fresh, replay_raw(str(hist)))
+
+    def test_restart_mid_coarse_window_never_closes_partial(self, tmp_path):
+        # The reviewer-verified scenario: 100 rounds land on disk only as
+        # fine buckets (the open 6h accumulator dies with the process); a
+        # restarted process observes 10 more rounds in the SAME 6h window
+        # and flushes past it.  Without reconstruction the 6h bucket
+        # closes with n=10 and the next refold collapses to ~9% of the
+        # data; with it, every load sees all 110 rounds.
+        rows_a = [("n0", T0 + 60.0 * i, True) for i in range(100)]
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        _ingest(store, rows_a, flush_at=T0 + 60.0 * 100)  # 6h still open
+        run2 = SegmentStore(str(tmp_path / "ana"))
+        run2.load()  # restart: open accumulators were lost…
+        assert run2.node_stats["n0"]["n"] == 100  # …but the refold stitches
+        rows_b = [("n0", T0 + 60.0 * (100 + i), True) for i in range(10)]
+        _ingest(run2, rows_b)  # flushes far past the window: 6h closes
+        assert run2.node_stats["n0"]["n"] == 110
+        run3 = SegmentStore(str(tmp_path / "ana"))
+        run3.load()
+        assert run3.node_stats["n0"]["n"] == 110
+
+    def test_partial_coarse_bucket_on_disk_is_healed(self, tmp_path):
+        # A 6h record that CLOSED partial (written by a pre-fix binary,
+        # or a crash squeezing between reconstruction and compaction) is
+        # replaced at load from the finer evidence and compacted durable.
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        _ingest(store, [("n0", T0 + 60.0 * i, True) for i in range(30)])
+        shard = store.shard_of("n0")
+        window = bucket_start(T0, 21600)
+        with open(store.segment_path(shard), "a") as f:
+            f.write(json.dumps({
+                "schema": ROLLUP_SCHEMA_VERSION, "node": "n0",
+                "res": 21600, "bucket": window, "n": 3, "ok": 3,
+                "flips": 0, "onsets": 0, "repairs": 0, "repair_s": 0.0,
+                "dwell": {"HEALTHY": 3}, "first_ts": T0,
+                "last_ts": T0 + 120.0, "last_ok": True,
+            }) + "\n")
+        fresh = SegmentStore(str(tmp_path / "ana"))
+        fresh.load()
+        assert fresh.buckets[("n0", 21600, window)]["n"] == 30
+        assert fresh.node_stats["n0"]["n"] == 30
+        # The heal is durable: a third load reads the compacted file.
+        third = SegmentStore(str(tmp_path / "ana"))
+        third.load()
+        assert third.node_stats["n0"]["n"] == 30
+
+    def test_restart_mid_failure_never_double_counts_onset(self, tmp_path):
+        rows = [("n0", T0 + 60.0 * i, i < 3) for i in range(6)]  # fails at 3
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        _ingest(store, rows)
+        assert store.node_stats["n0"]["onsets"] == 1
+        fresh = SegmentStore(str(tmp_path / "ana"))
+        fresh.load()
+        # Still failing across the restart: the repair clock is reseeded
+        # (measured from the boundary), and the NEXT bad round must not
+        # mint a second onset.
+        fresh.observe("n0", T0 + 60.0 * 6, False, "FAILED", False)
+        assert fresh.node_stats["n0"]["onsets"] == 1
+        fresh.observe("n0", T0 + 60.0 * 7, True, "RECOVERING", True)
+        assert fresh.node_stats["n0"]["repairs"] == 1
+
+    def test_retention_bounds_buckets(self, tmp_path):
+        from tpu_node_checker.analytics.segments import RETENTION_BUCKETS
+
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        # 400 one-minute buckets: far past the 1m retention of 120.
+        rows = [("n0", T0 + 60.0 * i, True) for i in range(400)]
+        _ingest(store, rows)
+        per_res = {}
+        for (_n, res, _b) in store.buckets:
+            per_res[res] = per_res.get(res, 0) + 1
+        for res, n in per_res.items():
+            assert n <= RETENTION_BUCKETS[res], (res, n)
+
+
+# ---------------------------------------------------------------------------
+# Changepoint detector
+# ---------------------------------------------------------------------------
+
+
+class TestCusumFlapDetector:
+    def _drive(self, det, node, verdicts):
+        fired = []
+        for i, ok in enumerate(verdicts):
+            flipped = det.flip(node, ok)
+            if det.observe(node, flipped, i):
+                fired.append(i)
+        return fired
+
+    def test_steady_node_never_fires(self):
+        det = CusumFlapDetector()
+        assert self._drive(det, "n", [True] * 50) == []
+        assert self._drive(det, "m", [False] * 50) == []
+
+    def test_single_transient_never_fires(self):
+        det = CusumFlapDetector()
+        assert self._drive(det, "n", [True, True, False, True, True]) == []
+
+    def test_two_separated_incidents_never_fire(self):
+        det = CusumFlapDetector()
+        verdicts = [True, False, True, True, True, True, False, True, True]
+        assert self._drive(det, "n", verdicts) == []
+
+    def test_flapper_fires_on_third_flip_once_per_episode(self):
+        det = CusumFlapDetector()
+        verdicts = [True, False, True, False, True, False, True]
+        assert self._drive(det, "n", verdicts) == [3]  # flips at 1,2,3
+        assert det.detections_total == 1
+        assert det.active == {"n": 3}
+
+    def test_episode_rearms_after_decay(self):
+        det = CusumFlapDetector()
+        fired = self._drive(
+            det, "n",
+            [True, False, True, False, True]  # fires at i=3
+            + [True] * 4                      # decays: episode closes
+            + [False, True, False, True],     # flaps again: refires
+        )
+        assert len(fired) == 2 and det.detections_total == 2
+
+    def test_promotion_only_from_healthy_and_never_accelerates(self):
+        fsm = HealthFSM(cordon_after=3)
+        fsm.observe("n", True)
+        assert fsm.promote_suspect("n") == (HEALTHY, SUSPECT)
+        assert fsm.health("n").state == SUSPECT
+        assert fsm.health("n").streak == 0
+        # Already SUSPECT: a second promotion is a no-op.
+        assert fsm.promote_suspect("n") is None
+        # The promoted node still needs the FULL K consecutive bad rounds.
+        fsm.observe("n", False)
+        fsm.observe("n", False)
+        assert fsm.health("n").state == SUSPECT
+        fsm.observe("n", False)
+        assert fsm.health("n").state == "FAILED"
+
+    def test_promotion_unknown_node_is_noop(self):
+        fsm = HealthFSM()
+        assert fsm.promote_suspect("ghost") is None
+        assert "ghost" not in fsm.nodes
+
+    def test_prune_closes_a_departed_nodes_episode(self):
+        det = CusumFlapDetector()
+        self._drive(det, "gone", [True, False, True, False, True])
+        assert "gone" in det.active
+        det.prune({"still-here"})
+        assert det.active == {} and det.active_count() == 0
+        assert det.score("gone") == 0.0
+
+    def test_departed_node_leaves_the_standing_suspect_set(self, tmp_path):
+        nodes_file = tmp_path / "nodes.json"
+        args = cli.parse_args([
+            "--nodes-json", str(nodes_file),
+            "--history", str(tmp_path / "h.jsonl"),
+            "--analytics", str(tmp_path / "ana"),
+            "--json",
+        ])
+        for r in range(5):  # flap until detection
+            nodes_file.write_text(json.dumps(
+                {"items": [_node("flappy", ready=(r % 2 == 0)),
+                           _node("steady")]}
+            ))
+            res = checker.run_check(args)
+        assert res.payload["analytics"]["suspects"] == ["flappy"]
+        # The flapper is deleted from the cluster: the next round's
+        # standing set must not carry the ghost forever.
+        nodes_file.write_text(json.dumps({"items": [_node("steady")]}))
+        res = checker.run_check(args)
+        assert res.payload["analytics"]["suspects"] == []
+
+
+# ---------------------------------------------------------------------------
+# Queries
+# ---------------------------------------------------------------------------
+
+
+class TestQueries:
+    def _store(self, tmp_path):
+        store = SegmentStore(str(tmp_path / "ana"))
+        store.load()
+        rows = []
+        for i in range(200):
+            rows.append(("good", T0 + 30 * i, True))
+            rows.append(("bad", T0 + 30 * i, i % 4 != 0))
+        _ingest(store, rows)
+        store.node_groups["good"] = {"cluster": "c0", "slice": "s0"}
+        store.node_groups["bad"] = {"cluster": "c0", "slice": "s1"}
+        return store
+
+    def test_docs_shape_and_grouping(self, tmp_path):
+        docs = build_analytics_docs(self._store(tmp_path))
+        slo = docs["slo"]
+        assert slo["fleet"]["nodes"] == 2
+        assert slo["source"] == "rollups"
+        kinds = {(g["kind"], g["group"]) for g in slo["groups"]}
+        assert ("cluster", "c0") in kinds
+        assert ("slice", "s0") in kinds and ("slice", "s1") in kinds
+        cluster = next(g for g in slo["groups"]
+                       if (g["kind"], g["group"]) == ("cluster", "c0"))
+        assert cluster["nodes"] == 2
+        assert cluster["availability_pct"]["p50"] is not None
+
+    def test_offenders_rank_worst_first(self, tmp_path):
+        docs = build_analytics_docs(self._store(tmp_path))
+        names = [o["node"] for o in docs["offenders"]["offenders"]]
+        assert names == ["bad", "good"]
+        assert docs["offenders"]["nodes_total"] == 2
+
+    def test_flaps_doc_carries_detector_state(self, tmp_path):
+        det = CusumFlapDetector()
+        for i in range(5):
+            ok = i % 2 == 0
+            det.observe("bad", det.flip("bad", ok), i)
+        docs = build_analytics_docs(self._store(tmp_path), detector=det,
+                                    predictions=[{"node": "bad"}])
+        flaps = docs["flaps"]
+        bad = next(n for n in flaps["nodes"] if n["node"] == "bad")
+        assert bad["predicted"] is True and bad["cusum"] is not None
+        assert bad["recent_buckets"], "closed 1m buckets expected"
+        assert flaps["predictions"] == [{"node": "bad"}]
+        assert flaps["predictions_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Checker wiring + served endpoints
+# ---------------------------------------------------------------------------
+
+
+def _node(name, ready=True):
+    return {
+        "metadata": {"name": name, "labels": {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+            "cloud.google.com/gke-nodepool": "pool-0",
+        }},
+        "spec": {},
+        "status": {
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}
+            ],
+            "allocatable": {"google.com/tpu": "4"},
+        },
+    }
+
+
+class TestCheckerWiring:
+    def _args(self, tmp_path, nodes_file):
+        return cli.parse_args([
+            "--nodes-json", str(nodes_file),
+            "--history", str(tmp_path / "h.jsonl"),
+            "--analytics", str(tmp_path / "ana"),
+            "--json", "--cluster-name", "c0",
+        ])
+
+    def _run_flapping(self, tmp_path, rounds=6):
+        nodes_file = tmp_path / "nodes.json"
+        results = []
+        args = self._args(tmp_path, nodes_file)
+        for r in range(rounds):
+            doc = {"items": [_node("flappy", ready=(r % 2 == 0)),
+                             _node("steady")]}
+            nodes_file.write_text(json.dumps(doc))
+            results.append(checker.run_check(args))
+        return results
+
+    def test_detection_promotes_and_surfaces(self, tmp_path):
+        results = self._run_flapping(tmp_path)
+        detected = [
+            (i, p)
+            for i, res in enumerate(results)
+            for p in res.payload["analytics"]["predictions"]
+        ]
+        assert detected, "flapping node never detected"
+        round_i, pred = detected[0]
+        assert pred["node"] == "flappy" and round_i == 3
+        # Standing episode rides every later payload.
+        assert results[-1].payload["analytics"]["suspects"] == ["flappy"]
+        # The steady node never contributes a prediction.
+        assert all(p["node"] == "flappy" for _, p in detected)
+
+    def test_docs_built_and_payload_block_stable_fields(self, tmp_path):
+        res = self._run_flapping(tmp_path)[-1]
+        assert set(res.analytics_docs) == {"slo", "offenders", "flaps"}
+        block = res.payload["analytics"]
+        assert set(block) == {
+            "predictions", "predictions_total", "suspects", "buckets",
+            "rollup_lines_total", "compactions_total",
+        }
+
+    def test_no_flag_payload_untouched(self, tmp_path):
+        nodes_file = tmp_path / "nodes.json"
+        nodes_file.write_text(json.dumps({"items": [_node("n0")]}))
+        args = cli.parse_args([
+            "--nodes-json", str(nodes_file),
+            "--history", str(tmp_path / "h.jsonl"), "--json",
+        ])
+        res = checker.run_check(args)
+        assert "analytics" not in res.payload
+        assert res.analytics_docs is None
+
+    def test_metrics_families_emitted(self, tmp_path):
+        from tpu_node_checker.metrics import render_metrics
+
+        res = self._run_flapping(tmp_path)[-1]
+        text = render_metrics(res)
+        for family in (
+            "tpu_node_checker_analytics_predictions_total",
+            "tpu_node_checker_analytics_suspects",
+            "tpu_node_checker_analytics_rollup_lines_total",
+            "tpu_node_checker_analytics_compactions_total",
+        ):
+            assert f"# TYPE {family}" in text, family
+        # Explicit --cluster-name labels every round family.
+        assert 'tpu_node_checker_analytics_suspects{cluster="c0"} 1' in text
+
+    def test_prediction_feeds_budget_view(self, tmp_path):
+        nodes_file = tmp_path / "nodes.json"
+        reports = tmp_path / "probes"
+        reports.mkdir()
+        args = cli.parse_args([
+            "--nodes-json", str(nodes_file),
+            "--history", str(tmp_path / "h.jsonl"),
+            "--analytics", str(tmp_path / "ana"),
+            "--probe-results", str(reports),
+            "--cordon-failed", "--cordon-dry-run",
+            "--cordon-after", "3",
+            "--disruption-budget", "2",
+            "--json", "--cluster-name", "c0",
+        ])
+        import time as _time
+
+        checker._REMEDIATION_CACHE["key"] = None
+        checker._REMEDIATION_CACHE["bundle"] = None
+        res = None
+        for r in range(6):
+            ok = r % 2 == 0
+            nodes_file.write_text(json.dumps(
+                {"items": [_node("flappy"), _node("steady")]}
+            ))
+            for name, verdict in (("flappy", ok), ("steady", True)):
+                (reports / f"{name}.json").write_text(json.dumps({
+                    "ok": verdict, "level": "compute", "hostname": name,
+                    "written_at": _time.time(),
+                }))
+            res = checker.run_check(args)
+        prediction = res.payload["remediation"]["prediction"]
+        assert prediction["suspects"] == ["flappy"]
+        assert prediction["domains"] == ["pool-0/tpu-v5-lite-podslice/4x4"]
+
+    def test_served_endpoints(self, tmp_path):
+        import http.client
+
+        from tpu_node_checker.server.app import FleetStateServer
+
+        res = self._run_flapping(tmp_path)[-1]
+        srv = FleetStateServer(0, host="127.0.0.1")
+        try:
+            srv.publish(res)
+            srv.publish_analytics(res.analytics_docs)
+
+            def get(path):
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=10
+                )
+                try:
+                    conn.request("GET", path)
+                    r = conn.getresponse()
+                    return r.status, r.read()
+                finally:
+                    conn.close()
+
+            for key in ("slo", "offenders", "flaps"):
+                status, body = get(f"/api/v1/analytics/{key}")
+                assert status == 200, key
+                json.loads(body)
+            status, body = get("/api/v1/analytics/flaps")
+            doc = json.loads(body)
+            assert any(n["node"] == "flappy" for n in doc["nodes"])
+            # Clearing swaps back to the helpful 404.
+            srv.publish_analytics(None)
+            status, body = get("/api/v1/analytics/slo")
+            assert status == 404 and b"--analytics" in body
+        finally:
+            srv.close()
+
+    def test_endpoint_reads_race_free_under_swaps(self, tmp_path):
+        """16 readers across live publish_analytics swaps: every response
+        is a complete, parseable document (the TNC011 atomic-swap rule
+        applied to the analytics entities)."""
+        import http.client
+
+        from tpu_node_checker.server.app import FleetStateServer
+
+        res = self._run_flapping(tmp_path)[-1]
+        srv = FleetStateServer(0, host="127.0.0.1")
+        try:
+            srv.publish(res)
+            srv.publish_analytics(res.analytics_docs)
+            stop = threading.Event()
+            errors = []
+
+            def hammer():
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=10
+                )
+                try:
+                    while not stop.is_set():
+                        conn.request("GET", "/api/v1/analytics/slo")
+                        r = conn.getresponse()
+                        body = r.read()
+                        if r.status != 200:
+                            errors.append(r.status)
+                        else:
+                            json.loads(body)
+                except Exception as exc:  # pragma: no cover - surfaced below
+                    errors.append(repr(exc))
+                finally:
+                    conn.close()
+
+            threads = [
+                threading.Thread(target=hammer, name=f"tnc-ana-hammer-{i}",
+                                 daemon=True)
+                for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for _ in range(25):
+                srv.publish_analytics(res.analytics_docs)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert errors == []
+        finally:
+            srv.close()
+
+
+class TestCliValidation:
+    def test_analytics_requires_history(self):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--analytics", "d"])
+
+    def test_analytics_rejected_with_watch_stream(self):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--watch", "5", "--watch-stream",
+                            "--history", "h", "--analytics", "d"])
+
+    def test_analytics_rejected_with_emit_probe(self):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--emit-probe", "out.json",
+                            "--history", "h", "--analytics", "d"])
+
+    def test_analytics_rejected_standalone_serve(self):
+        with pytest.raises(SystemExit):
+            cli.parse_args(["--serve", "0", "--history", "h",
+                            "--analytics", "d"])
+
+    def test_analytics_accepted_with_watch_serve(self):
+        args = cli.parse_args(["--watch", "5", "--serve", "0",
+                               "--history", "h", "--analytics", "d"])
+        assert args.analytics == "d"

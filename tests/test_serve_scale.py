@@ -421,15 +421,15 @@ class TestTrendSWR:
         self, tmp_path, monkeypatch
     ):
         cache, log, release, builds = self._cache(tmp_path, monkeypatch)
-        first = cache.entity(1)  # first build: synchronous
+        first = cache.entity()  # first build: synchronous
         assert cache.rebuilds == 1 and len(builds) == 1
-        assert cache.entity(1) is first  # steady state: cache hit
+        assert cache.entity() is first  # steady state: cache hit
         with open(log, "a") as f:
             f.write(json.dumps({"ts": 1_700_000_060.0, "exit_code": 3}) + "\n")
         # Signature moved: readers get the STALE entity immediately while
         # the one rebuild blocks on the gate.
         for _ in range(3):
-            assert cache.entity(1) is first
+            assert cache.entity() is first
         assert cache.stale_served == 3
         assert len(builds) == 2  # exactly one background rebuild spawned
         release.set()
@@ -437,20 +437,25 @@ class TestTrendSWR:
         while cache.rebuilds < 2 and time.monotonic() < deadline:
             time.sleep(0.005)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL tnc-trend-swr thread to commit its entity)
         assert cache.rebuilds == 2
-        fresh = cache.entity(1)
+        fresh = cache.entity()
         assert fresh is not first
         assert json.loads(fresh.raw)["rounds"] == 2
         assert len(builds) == 2  # the fresh entity is a cache hit, no rebuild
 
-    def test_seq_move_also_revalidates_async(self, tmp_path, monkeypatch):
+    def test_seq_move_with_unchanged_log_never_rebuilds(
+        self, tmp_path, monkeypatch
+    ):
+        # The ISSUE 15 satellite pin, from the SWR side: the cache keys on
+        # the trend-relevant content digest now, so a publication seq
+        # advancing over an unmoved log is a pure cache hit — the old
+        # (seq, signature) key spawned a full rebuild here every round.
         cache, _log, release, builds = self._cache(tmp_path, monkeypatch)
         release.set()
-        first = cache.entity(1)
-        assert cache.entity(2) is first  # stale on seq move, rebuild spawned
-        deadline = time.monotonic() + 10
-        while cache.rebuilds < 2 and time.monotonic() < deadline:
-            time.sleep(0.005)  # tnc: allow-test-wall-clock(bounded 10s poll for the REAL tnc-trend-swr thread to commit its entity)
-        assert cache.rebuilds == 2 and len(builds) == 2
+        first = cache.entity()
+        for _ in range(6):  # one request per would-be publish
+            assert cache.entity() is first
+        assert cache.rebuilds == 1 and len(builds) == 1
+        assert cache.stale_served == 0
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ timed; a leaked sleep or a wedged handler fails the suite, not just slows it.
 import gzip
 import http.client
 import json
+import os
 import threading
 import time
 
@@ -831,15 +832,110 @@ class TestTrendCache:
         finally:
             srv.close()
 
-    def test_new_round_invalidates_even_with_same_file(self, tmp_path):
+    def test_noop_publish_never_rebuilds(self, tmp_path):
+        # The regression pin (ISSUE 15 satellite): the cache used to key
+        # on (seq, file_signature), so EVERY publish re-read and
+        # re-summarized an unchanged trend log.  The rebuild key is now
+        # the trend-relevant content digest: a moving seq over an
+        # unmoving log costs nothing.
         path = self._log(tmp_path)
         srv = FleetStateServer(0, host="127.0.0.1", trend_path=str(path))
         try:
             srv.publish(_result([_tpu_node()]))
             _req(srv.port, "GET", "/api/v1/trend")
-            srv.publish(_result([_tpu_node()]))  # seq moves, file does not
-            _req(srv.port, "GET", "/api/v1/trend")  # stale + async rebuild
+            assert srv._trend.rebuilds == 1
+            for _ in range(5):
+                srv.publish(_result([_tpu_node()]))  # seq moves, file not
+                _req(srv.port, "GET", "/api/v1/trend")
+            assert srv._trend.rebuilds == 1
+            assert srv._trend.stale_served == 0
+        finally:
+            srv.close()
+
+    def test_touched_or_non_trend_rewrite_never_rebuilds(self, tmp_path):
+        path = self._log(tmp_path)
+        srv = FleetStateServer(0, host="127.0.0.1", trend_path=str(path))
+        try:
+            srv.publish(_result([_tpu_node()]))
+            _req(srv.port, "GET", "/api/v1/trend")
+            assert srv._trend.rebuilds == 1
+            # mtime moves, content does not: the signature check misses
+            # but the digest holds — no rebuild, no stale serve.
+            os.utime(path, (1_700_100_000, 1_700_100_000))
+            _req(srv.port, "GET", "/api/v1/trend")
+            # A rewrite that changes only NON-trend fields of existing
+            # lines (a post-processor annotating the log): full rescan,
+            # identical projections — digest holds, zero rebuilds.
+            lines = [json.loads(line)
+                     for line in path.read_text().splitlines()]
+            path.write_text("".join(
+                json.dumps({**entry, "annotated_by": "logtool"}) + "\n"
+                for entry in lines
+            ))
+            _req(srv.port, "GET", "/api/v1/trend")
+            assert srv._trend.rebuilds == 1
+            assert srv._trend.stale_served == 0
+            # A REAL round line moves the digest → exactly one rebuild.
+            with open(path, "a") as f:
+                f.write(json.dumps(
+                    {"ts": 1_700_000_300.0, "exit_code": 3}) + "\n")
+            _req(srv.port, "GET", "/api/v1/trend")
             self._await_rebuilds(srv._trend, 2)
+            _, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            assert json.loads(body)["rounds"] == 4
+        finally:
+            srv.close()
+
+    def test_non_trend_append_still_moves_skipped_lines(self, tmp_path):
+        # A valid-JSON line with no trend field moves the summary's
+        # skipped_lines count, so it IS trend-relevant: the served body
+        # must agree with what --trend computes over the same log.
+        path = self._log(tmp_path)
+        srv = FleetStateServer(0, host="127.0.0.1", trend_path=str(path))
+        try:
+            srv.publish(_result([_tpu_node()]))
+            _req(srv.port, "GET", "/api/v1/trend")
+            with open(path, "a") as f:
+                f.write(json.dumps({"note": "rotated certs"}) + "\n")
+            _req(srv.port, "GET", "/api/v1/trend")
+            self._await_rebuilds(srv._trend, 2)
+            _, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            doc = json.loads(body)
+            assert doc["rounds"] == 3 and doc["skipped_lines"] == 1
+        finally:
+            srv.close()
+
+    def test_transient_read_failure_does_not_skip_appended_bytes(
+        self, tmp_path, monkeypatch
+    ):
+        # A failed digest scan must NOT commit the new file signature:
+        # otherwise the sig==sig fast path would serve the pre-append
+        # entity forever (until the log happens to move again).
+        from tpu_node_checker.history import store as store_mod
+
+        path = self._log(tmp_path)
+        srv = FleetStateServer(0, host="127.0.0.1", trend_path=str(path))
+        try:
+            srv.publish(_result([_tpu_node()]))
+            _req(srv.port, "GET", "/api/v1/trend")
+            assert srv._trend.rebuilds == 1
+            with open(path, "a") as f:
+                f.write(json.dumps(
+                    {"ts": 1_700_000_300.0, "exit_code": 3}) + "\n")
+            real_tail = store_mod.read_jsonl_tail
+
+            def boom(*a, **kw):
+                raise OSError("transient rotation race")
+
+            monkeypatch.setattr(store_mod, "read_jsonl_tail", boom)
+            _, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            assert json.loads(body)["rounds"] == 3  # old entity, no crash
+            monkeypatch.setattr(store_mod, "read_jsonl_tail", real_tail)
+            # The next request retries the scan and sees the append.
+            _req(srv.port, "GET", "/api/v1/trend")
+            self._await_rebuilds(srv._trend, 2)
+            _, _, body = _req(srv.port, "GET", "/api/v1/trend")
+            assert json.loads(body)["rounds"] == 4
         finally:
             srv.close()
 

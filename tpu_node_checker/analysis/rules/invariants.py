@@ -510,6 +510,85 @@ class ActuatorGate(Rule):
                 )
 
 
+class RollupWriteGate(Rule):
+    slug = "rollup-write-gate"
+    code = "TNC021"
+    doc = ("analytics roll-up bytes reach disk only through "
+           "``segments.append_bucket`` (or compaction's schema-checked "
+           "rewrite): the raw segment I/O primitives "
+           "(``rollup_append_lines``/``rollup_replace_file``) may be "
+           "called only inside analytics/segments.py, and every caller "
+           "there must reference ``ROLLUP_SCHEMA_VERSION`` — the proof "
+           "its lines are schema-stamped (the TNC019 actuator-gate "
+           "pattern, applied to the store)")
+
+    _PRIMITIVES = ("rollup_append_lines", "rollup_replace_file")
+    _SANCTIONED = "tpu_node_checker/analytics/segments.py"
+    _SCHEMA_CONST = "ROLLUP_SCHEMA_VERSION"
+
+    def _primitive_calls(self, tree: ast.AST):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if (name is not None
+                        and name.split(".")[-1] in self._PRIMITIVES):
+                    yield node, name
+
+    @classmethod
+    def _references_schema(cls, func: ast.FunctionDef) -> bool:
+        # Either the constant itself, or a call to the stamp helper that
+        # applies it (stamp_bucket) — both prove the lines carry the
+        # major.
+        for node in walk_skipping_nested_functions(func):
+            if isinstance(node, ast.Name) and node.id == cls._SCHEMA_CONST:
+                return True
+            if (isinstance(node, ast.Attribute)
+                    and node.attr == cls._SCHEMA_CONST):
+                return True
+            if (isinstance(node, ast.Call)
+                    and (name := call_name(node)) is not None
+                    and name.split(".")[-1] == "stamp_bucket"):
+                return True
+        return False
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_package():
+            return
+        if ctx.path != self._SANCTIONED:
+            for node, name in self._primitive_calls(ctx.tree):
+                yield self.finding(
+                    ctx.path, node,
+                    f"raw segment write {name}() outside the gated "
+                    "segments module — route roll-up writes through "
+                    "segments.append_bucket so the schema stamp and the "
+                    "append-only/compaction discipline cannot be skipped",
+                )
+            return
+        # Inside the sanctioned module: every function touching the raw
+        # I/O must reference the schema major — unstamped lines would be
+        # refused by the next load (the history store's version rule).
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, ast.FunctionDef):
+                continue
+            if func.name in self._PRIMITIVES:
+                continue  # the primitives themselves only do I/O
+            calls = [
+                name
+                for node in walk_skipping_nested_functions(func)
+                if isinstance(node, ast.Call)
+                and (name := call_name(node)) is not None
+                and name.split(".")[-1] in self._PRIMITIVES
+            ]
+            if calls and not self._references_schema(func):
+                yield self.finding(
+                    ctx.path, func,
+                    f"{func.name}() writes segment lines ({calls[0]}) "
+                    f"without referencing {self._SCHEMA_CONST} — roll-up "
+                    "lines must be schema-stamped (append_bucket is the "
+                    "gate; compaction must filter/stamp by the major)",
+                )
+
+
 class SimDeterminism(Rule):
     slug = "sim-determinism"
     code = "TNC020"
@@ -625,6 +704,7 @@ RULES: List[Rule] = [
     ObsDiscipline(),
     ListHotPathDecode(),
     ActuatorGate(),
+    RollupWriteGate(),
     SimDeterminism(),
     TestWallClock(),
 ]

@@ -1,0 +1,42 @@
+"""Fleet analytics tier: a partitioned, downsampling time-series store
+over the health-history stream, an SLO query engine, and online flap
+prediction (DESIGN.md §19, ROADMAP item 5).
+
+Three pieces:
+
+* :mod:`~tpu_node_checker.analytics.segments` — the storage layer: the
+  per-node verdict stream is folded into time-bucketed roll-ups (1m/15m/6h
+  resolutions) sharded across per-shard segment files by the SAME
+  consistent-hash ring the federation tier assigns clusters with
+  (:class:`~tpu_node_checker.federation.endpoints.HashRing`), appended
+  via the ONE gated write entry (``append_bucket`` — tnc-lint TNC021) and
+  compacted in place with the history store's atomic tmp+rename and
+  torn-line-tolerant read discipline.  The raw ``--history`` JSONL tail
+  stays authoritative: ``--trend`` / ``--trend-nodes`` never read
+  segments, so their output is byte-identical with or without analytics;
+* :mod:`~tpu_node_checker.analytics.queries` — the query engine:
+  availability/MTBF/MTTR percentiles grouped by cluster, slice (the
+  grading's own ``slice_group_key``) and topology, plus worst-offender
+  rankings and flap-rate views — computed from roll-ups and running
+  per-node aggregates, NEVER by replaying raw history for closed buckets;
+* :mod:`~tpu_node_checker.analytics.changepoint` — prediction: an online
+  CUSUM detector over per-node flip rates that promotes a still-HEALTHY
+  flapper to SUSPECT through the FSM's own transition log *before* the
+  hysteresis machine sees a hard failure, and feeds the prediction set to
+  the remediation budget engine.
+
+Served from the fleet API as ``GET /api/v1/analytics/{slo,offenders,
+flaps}`` — pre-serialized snapshot entities swapped atomically per round,
+so the TNC011 lock-free read-path rules hold with zero new waivers.
+"""
+
+from tpu_node_checker.analytics.changepoint import CusumFlapDetector
+from tpu_node_checker.analytics.segments import SegmentStore, append_bucket
+from tpu_node_checker.analytics.queries import build_analytics_docs
+
+__all__ = [
+    "CusumFlapDetector",
+    "SegmentStore",
+    "append_bucket",
+    "build_analytics_docs",
+]

@@ -1,0 +1,125 @@
+"""Online flap-rate changepoint detection: CUSUM over per-node verdict
+flips, promoting a flapper to SUSPECT *before* the hysteresis FSM sees a
+hard failure.
+
+The statistic: per evidence round, each node contributes one flip sample
+``x ∈ {0, 1}`` (did this round's verdict differ from the last one) — the
+round-rate sample of the bucket flip rates the segment store rolls up.
+The one-sided CUSUM score accumulates excess over an allowed drift::
+
+    S ← max(0, S + x − DRIFT)        detection when S ≥ THRESHOLD
+
+With ``DRIFT = 0.5`` and ``THRESHOLD = 1.5`` a detection needs **three
+net flips above drift** in a tight window:
+
+* a steady node contributes nothing (``x = 0`` decays the score);
+* one transient failure-and-recovery is exactly two adjacent flips —
+  peak score 1.0, below threshold: isolated incidents never fire;
+* two incidents separated by ≥2 quiet rounds decay back to 0 between
+  them: repeated-but-rare trouble never fires either;
+* a real flapper's sustained flips cross 1.5 on the third net flip —
+  typically one to several rounds before the FSM's flap window
+  (``--flap-threshold``, default 4 flips) traps it CHRONIC and well
+  before a decaying flapper strings ``--cordon-after`` consecutive bad
+  rounds into FAILED.
+
+Detection is an *early-warning*, never an accelerant: the promotion seam
+(:meth:`~tpu_node_checker.history.fsm.HealthFSM.promote_suspect`) only
+moves HEALTHY → SUSPECT with a zeroed streak, so a promoted node still
+needs the full ``--cordon-after`` consecutive bad rounds before any
+cordon is eligible.  The detector is pure arithmetic — no clock, no RNG —
+so ``tnc simulate`` replays byte-identically (TNC020's contract holds by
+construction).
+
+Each node's detection is one EPISODE: after firing, the detector re-arms
+only once the score has decayed back to zero, so a standing flapper is
+one prediction, not one per round.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# Allowed flip drift per round and the episode threshold; see module doc.
+CUSUM_DRIFT = 0.5
+CUSUM_THRESHOLD = 1.5
+
+
+class CusumFlapDetector:
+    """Per-node one-sided CUSUM over verdict flips; see the module doc."""
+
+    def __init__(self, drift: float = CUSUM_DRIFT,
+                 threshold: float = CUSUM_THRESHOLD):
+        self.drift = float(drift)
+        self.threshold = float(threshold)
+        self._score: Dict[str, float] = {}
+        self._last_ok: Dict[str, bool] = {}
+        self._armed: Dict[str, bool] = {}  # False while an episode stands
+        self.detections_total = 0
+        # node -> round_seq of the episode's first firing (current episode
+        # only; cleared when the score decays and the episode closes).
+        self.active: Dict[str, int] = {}
+
+    def flip(self, node: str, ok: bool) -> bool:
+        """Record one verdict; True when it flipped vs the previous one."""
+        prev = self._last_ok.get(node)
+        self._last_ok[node] = ok
+        return prev is not None and prev != ok
+
+    def observe(self, node: str, flipped: bool,
+                round_seq: int = 0) -> bool:
+        """Advance the node's CUSUM by one round's flip sample.
+
+        Returns True exactly once per episode — on the round the score
+        first crosses the threshold.
+        """
+        score = max(
+            0.0,
+            self._score.get(node, 0.0)
+            + (1.0 if flipped else 0.0)
+            - self.drift,
+        )
+        self._score[node] = score
+        if score <= 0.0 and not self._armed.get(node, True):
+            # Episode over: the flapping stopped long enough for the
+            # score to drain — re-arm for the next one.
+            self._armed[node] = True
+            self.active.pop(node, None)
+        if score >= self.threshold and self._armed.get(node, True):
+            self._armed[node] = False
+            self.active[node] = round_seq
+            self.detections_total += 1
+            return True
+        return False
+
+    def score(self, node: str) -> float:
+        return self._score.get(node, 0.0)
+
+    def active_count(self) -> int:
+        return len(self.active)
+
+    def forget(self, node: str) -> None:
+        """Drop a departed node's state so the dicts track the fleet."""
+        for d in (self._score, self._last_ok, self._armed, self.active):
+            d.pop(node, None)
+
+    def prune(self, fleet: set) -> None:
+        """Forget every node outside ``fleet`` — called once per round so
+        a deleted/renamed node cannot sit in the standing suspect set
+        forever (its score could never drain: observe() only runs for
+        nodes the round saw).  Same policy as the FSM state gauges: the
+        standing sets cover THIS round's fleet."""
+        for node in set(self._last_ok) - fleet:
+            self.forget(node)
+
+    def snapshot(self) -> List[dict]:
+        """Deterministic per-node view for the flaps query doc."""
+        return [
+            {
+                "node": node,
+                "score": round(self._score.get(node, 0.0), 3),
+                "active": node in self.active,
+            }
+            for node in sorted(self._score)
+            if self._score.get(node, 0.0) > 0.0 or node in self.active
+        ]

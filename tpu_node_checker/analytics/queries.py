@@ -1,0 +1,257 @@
+"""The SLO query engine: availability/MTBF/MTTR percentiles, offender
+rankings and flap views — computed from roll-ups, never from raw replay.
+
+Three documents, each pre-serialized into a snapshot entity and swapped
+atomically by the fleet API (``GET /api/v1/analytics/{slo,offenders,
+flaps}``):
+
+* **slo** — availability / MTBF / MTTR percentiles (p50/p90/p99) across
+  nodes, grouped by cluster, slice (the grading's own
+  ``slice_group_key`` naming, shared with the remediation budget's
+  failure domains) and topology label;
+* **offenders** — the repair queue: nodes ranked worst-first by
+  availability, then flip count;
+* **flaps** — per-node flip totals, recent per-bucket flip rates at the
+  finest resolution, and the changepoint detector's live scores and
+  active predictions.
+
+Inputs are the segment store's running per-node aggregates (O(nodes)) and
+its retained closed buckets (O(buckets), bounded by retention) — a
+100k-round history answers in milliseconds because closed rounds were
+folded when they closed, not when the query arrived
+(``bench.py trend_100k_rounds_p50_ms`` pins the ≥10× margin over raw
+replay).  :func:`replay_raw` is the raw-replay oracle: the same node
+statistics computed the pre-analytics way — O(all rounds ever) — kept as
+the equivalence check's ground truth and the bench's comparison leg.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tpu_node_checker.analytics.segments import (
+    RESOLUTIONS,
+    SegmentStore,
+)
+
+# Worst-offender list depth (the --trend-nodes convention).
+OFFENDERS_CAP = 10
+
+# Closed 1m buckets per node in the flaps view: ~half an hour of rate.
+FLAP_VIEW_BUCKETS = 30
+
+_PCTLS = (50, 90, 99)
+
+
+def _pctl(sorted_values: List[float], pct: int) -> Optional[float]:
+    if not sorted_values:
+        return None
+    idx = max(0, min(len(sorted_values) - 1,
+                     int(len(sorted_values) * pct / 100.0 + 0.5) - 1))
+    return sorted_values[idx]
+
+
+def _percentiles(values: List[float]) -> Optional[dict]:
+    if not values:
+        return None
+    values = sorted(values)
+    return {f"p{p}": round(_pctl(values, p), 2) for p in _PCTLS}
+
+
+def node_stats_view(store: SegmentStore) -> Dict[str, dict]:
+    """Per-node SLO numbers from the store's running aggregates."""
+    out: Dict[str, dict] = {}
+    for node, s in sorted(store.node_stats.items()):
+        n = s["n"]
+        span = (
+            (s["last_ts"] - s["first_ts"])
+            if s["first_ts"] is not None and s["last_ts"] is not None
+            else 0.0
+        )
+        out[node] = {
+            "rounds": n,
+            "availability_pct": (
+                round(100.0 * s["ok"] / n, 2) if n else None
+            ),
+            "failures": s["onsets"],
+            "flips": s["flips"],
+            # Mean seconds between failure onsets over the observed span.
+            "mtbf_s": (
+                round(span / s["onsets"], 1) if s["onsets"] >= 2 and span > 0
+                else None
+            ),
+            "mttr_s": (
+                round(s["repair_s"] / s["repairs"], 1)
+                if s["repairs"] else None
+            ),
+            "last_ok": s["last_ok"],
+        }
+    return out
+
+
+def _group_keys(store: SegmentStore, node: str) -> List[Tuple[str, str]]:
+    group = store.node_groups.get(node) or {}
+    keys = []
+    for kind in ("cluster", "slice", "topology"):
+        value = group.get(kind)
+        if value:
+            keys.append((kind, value))
+    return keys
+
+
+def build_analytics_docs(store: SegmentStore, detector=None,
+                         predictions: Optional[List[dict]] = None) -> dict:
+    """→ ``{"slo": …, "offenders": …, "flaps": …}`` (plain data; the
+    server serializes each into one snapshot entity)."""
+    nodes = node_stats_view(store)
+
+    # -- slo: percentiles per (kind, group) ---------------------------------
+    grouped: Dict[Tuple[str, str], dict] = {}
+    fleet = {"availability": [], "mtbf": [], "mttr": [], "nodes": 0}
+    for node, v in nodes.items():
+        targets = [fleet]
+        for key in _group_keys(store, node):
+            g = grouped.get(key)
+            if g is None:
+                g = grouped[key] = {
+                    "availability": [], "mtbf": [], "mttr": [], "nodes": 0,
+                }
+            targets.append(g)
+        for g in targets:
+            g["nodes"] += 1
+            if v["availability_pct"] is not None:
+                g["availability"].append(v["availability_pct"])
+            if v["mtbf_s"] is not None:
+                g["mtbf"].append(v["mtbf_s"])
+            if v["mttr_s"] is not None:
+                g["mttr"].append(v["mttr_s"])
+
+    def _slo_entry(g: dict) -> dict:
+        return {
+            "nodes": g["nodes"],
+            "availability_pct": _percentiles(g["availability"]),
+            "mtbf_s": _percentiles(g["mtbf"]),
+            "mttr_s": _percentiles(g["mttr"]),
+        }
+
+    slo = {
+        "fleet": _slo_entry(fleet),
+        "groups": [
+            {"kind": kind, "group": name, **_slo_entry(g)}
+            for (kind, name), g in sorted(grouped.items())
+        ],
+        "source": "rollups",
+    }
+
+    # -- offenders: worst-first repair queue --------------------------------
+    ranked = sorted(
+        nodes,
+        key=lambda n: (
+            nodes[n]["availability_pct"]
+            if nodes[n]["availability_pct"] is not None
+            else 100.0,
+            -nodes[n]["flips"],
+            n,
+        ),
+    )
+    offenders = {
+        "offenders": [
+            {"node": n, **nodes[n], "group": store.node_groups.get(n) or {}}
+            for n in ranked[:OFFENDERS_CAP]
+        ],
+        "nodes_total": len(nodes),
+    }
+
+    # -- flaps: rates + changepoint state -----------------------------------
+    finest = RESOLUTIONS[0]
+    # Filter to the finest resolution BEFORE sorting: at fleet scale the
+    # bucket dict is dominated by the coarser resolutions this view never
+    # reads, and sorting the whole dict per round would be O(B log B) of
+    # wasted work on the round path.
+    recent: Dict[str, List[dict]] = {}
+    for (node, res, bucket), e in sorted(
+        item for item in store.buckets.items() if item[0][1] == finest
+    ):
+        recent.setdefault(node, []).append(
+            {"bucket": bucket, "n": e.get("n") or 0,
+             "flips": e.get("flips") or 0}
+        )
+    flap_nodes = []
+    for node in sorted(nodes):
+        buckets = recent.get(node, [])[-FLAP_VIEW_BUCKETS:]
+        flap_nodes.append({
+            "node": node,
+            "flips_total": nodes[node]["flips"],
+            "recent_buckets": buckets,
+            "cusum": (
+                round(detector.score(node), 3) if detector is not None
+                else None
+            ),
+            "predicted": (
+                node in detector.active if detector is not None else False
+            ),
+        })
+    flaps = {
+        "nodes": flap_nodes,
+        "predictions": list(predictions or []),
+        "predictions_total": (
+            detector.detections_total if detector is not None else 0
+        ),
+        "bucket_resolution_s": finest,
+    }
+    return {"slo": slo, "offenders": offenders, "flaps": flaps}
+
+
+def replay_raw(path: str) -> Dict[str, dict]:
+    """The raw-replay oracle: per-node stats straight from the history
+    JSONL — O(every round ever written).
+
+    This is the cost model the roll-up path replaces; it stays as (a) the
+    property test's equivalence ground truth and (b) the bench's raw leg.
+    Uses the same torn-line-tolerant loader as every JSONL surface.
+    """
+    from tpu_node_checker.history.store import (
+        HISTORY_SCHEMA_VERSION,
+        read_jsonl_tolerant,
+    )
+
+    entries, _skipped = read_jsonl_tolerant(path)
+    out: Dict[str, dict] = {}
+    failing: Dict[str, float] = {}
+    last_ok: Dict[str, bool] = {}
+    for e in entries:
+        schema = e.get("schema")
+        node = e.get("node")
+        ok = e.get("ok")
+        ts = e.get("ts")
+        if (
+            (schema is not None and schema != HISTORY_SCHEMA_VERSION)
+            or not isinstance(node, str) or not node
+            or not isinstance(ok, bool)
+            or not isinstance(ts, (int, float))
+        ):
+            continue
+        s = out.setdefault(node, {
+            "n": 0, "ok": 0, "flips": 0, "onsets": 0, "repairs": 0,
+            "repair_s": 0.0, "first_ts": None, "last_ts": None,
+            "last_ok": None,
+        })
+        s["n"] += 1
+        s["ok"] += 1 if ok else 0
+        prev = last_ok.get(node)
+        if prev is not None and prev != ok:
+            s["flips"] += 1
+        last_ok[node] = ok
+        if not ok and node not in failing:
+            failing[node] = float(ts)
+            s["onsets"] += 1
+        elif ok and node in failing:
+            s["repairs"] += 1
+            s["repair_s"] += max(0.0, float(ts) - failing.pop(node))
+        if s["first_ts"] is None:
+            s["first_ts"] = float(ts)
+        s["last_ts"] = float(ts)
+        s["last_ok"] = ok
+    for s in out.values():
+        s["repair_s"] = round(s["repair_s"], 3)
+    return out

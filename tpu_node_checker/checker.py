@@ -76,6 +76,10 @@ class CheckResult:
     multislices: List = field(default_factory=list)
     payload: dict = field(default_factory=dict)
     local_probe: Optional[dict] = None
+    # --analytics: the SLO/offenders/flaps query documents this round
+    # computed from roll-ups (served by FleetStateServer.publish_analytics;
+    # never part of the payload — they are a serving surface).
+    analytics_docs: Optional[dict] = None
 
 
 def _registry_from_args(args) -> ResourceRegistry:
@@ -557,6 +561,12 @@ def _resolve_client(args, client):
 # (tests, embedders) rebuilds instead of riding a mis-tuned FSM.
 _HISTORY_CACHE: dict = {"key": None, "tracker": None}
 
+# Analytics bundle (segment store + changepoint detector), cached across
+# rounds like the history tracker: the roll-up store's open buckets and
+# the CUSUM scores are cross-round state — a per-round rebuild would
+# re-read every segment file each interval and reset every episode.
+_ANALYTICS_CACHE: dict = {"key": None, "bundle": None}
+
 # Remediation bundle (budget engine + lease client + repair tracker),
 # cached across rounds for the same reason: the sliding-window actuation
 # ledger, the lifetime denied/action counters, and the last-leased fleet
@@ -713,6 +723,45 @@ def _build_history(args):
     return tracker
 
 
+def _build_analytics(args):
+    """``--analytics DIR`` → ``{"store", "detector"}`` (None when off).
+
+    The segment store loads its shard files once per process and then
+    rides in memory; the detector's CUSUM scores persist across rounds
+    (an episode spans rounds by definition).  Keyed by the directory so
+    two embedded runs (tests) never share buckets.
+    """
+    path = getattr(args, "analytics", None)
+    if not path:
+        return None
+    from tpu_node_checker.analytics import CusumFlapDetector, SegmentStore
+
+    key = os.path.abspath(path)
+    if _ANALYTICS_CACHE["key"] == key:
+        return _ANALYTICS_CACHE["bundle"]
+    store = SegmentStore(key)
+    store.load()
+    bundle = {"store": store, "detector": CusumFlapDetector()}
+    _ANALYTICS_CACHE["key"], _ANALYTICS_CACHE["bundle"] = key, bundle
+    return bundle
+
+
+def _node_group_labels(args, n: NodeInfo, cluster: Optional[str]) -> dict:
+    """The (cluster, slice, topology) labels one node's roll-up buckets
+    carry — slice named exactly like the remediation budget's failure
+    domains (one definition, so analytics groupings and budget domains
+    can never disagree)."""
+    from tpu_node_checker.detect import slice_group_key
+    from tpu_node_checker.remediation.budget import _domain_name
+
+    key = slice_group_key(n)
+    return {
+        "cluster": cluster,
+        "slice": _domain_name(key) if key is not None else None,
+        "topology": n.tpu_topology,
+    }
+
+
 def _node_round_causes(n: NodeInfo) -> List[str]:
     """Compact cause tokens for one node's round, recorded in the history
     store (the per-node twin of the trend log's ``causes``)."""
@@ -728,8 +777,18 @@ def _node_round_causes(n: NodeInfo) -> List[str]:
     return causes
 
 
-def _update_history(history: dict, accel: List[NodeInfo]) -> None:
+def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
+                    args=None, events=None, trace_id=None,
+                    round_seq: int = 0) -> List[dict]:
     """Feed this round's verdicts through the FSM and queue store lines.
+
+    With an ``analytics`` bundle (``--analytics``), every boolean verdict
+    is ALSO folded into the segment store's roll-up buckets and the CUSUM
+    flap detector — a detection on a still-HEALTHY node promotes it to
+    SUSPECT through :meth:`HealthFSM.promote_suspect` (the prediction
+    seam) BEFORE the store line and payload are stamped, so the persisted
+    round and the served state agree.  Returns the round's prediction
+    records (empty without analytics).
 
     Verdict rules:
 
@@ -754,6 +813,14 @@ def _update_history(history: dict, accel: List[NodeInfo]) -> None:
 
     fsm, store = history["fsm"], history["store"]
     now = round(_time.time(), 3)
+    predictions: List[dict] = []
+    cluster = None
+    if analytics is not None and args is not None:
+        name, source = resolve_cluster_name(args)
+        # Same policy as the metrics label: only an EXPLICIT identity
+        # groups analytics — inferred hostnames would mint per-restart
+        # groups.
+        cluster = name if source in ("flag", "env") else None
     for n in accel:
         verdict: Optional[bool] = n.effectively_ready
         if n.quarantined_by_us and n.probe is None:
@@ -779,6 +846,29 @@ def _update_history(history: dict, accel: List[NodeInfo]) -> None:
             verdict,
             uncordoned_out_of_band=out_of_band,
         )
+        if analytics is not None and isinstance(verdict, bool):
+            detector, seg_store = analytics["detector"], analytics["store"]
+            flipped = detector.flip(n.name, verdict)
+            if detector.observe(n.name, flipped, round_seq):
+                promoted = fsm.promote_suspect(n.name)
+                prediction = {
+                    "node": n.name,
+                    "score": round(detector.score(n.name), 3),
+                    "promoted": promoted is not None,
+                }
+                predictions.append(prediction)
+                if events is not None:
+                    events.emit(
+                        "analytics-prediction",
+                        trace_id=trace_id,
+                        **prediction,
+                    )
+            # AFTER any promotion: the bucket's dwell and the store line
+            # below must both carry the state this round ends in.
+            seg_store.observe(
+                n.name, now, verdict, fsm.health(n.name).state, flipped,
+                group=_node_group_labels(args, n, cluster),
+            )
         h = fsm.health(n.name)
         n.health = {"state": h.state, "streak": h.streak, "flaps": h.flaps}
         store.record(
@@ -793,6 +883,17 @@ def _update_history(history: dict, accel: List[NodeInfo]) -> None:
                 "flaps_total": h.flaps_total,
             }
         )
+    if analytics is not None:
+        # A departed node's episode could never close on its own (no
+        # more observes drain its score): the standing prediction set
+        # tracks THIS round's fleet, like the FSM state gauges.  The
+        # store's lifetime aggregates deliberately keep departed nodes
+        # (the flaps_total-counter policy) until retention ages them out.
+        analytics["detector"].prune({n.name for n in accel})
+        # Close+append buckets whose window passed; compaction rides the
+        # same call when a shard outgrew its live set.
+        analytics["store"].flush(now)
+    return predictions
 
 
 def _history_payload(history: dict, accel: List[NodeInfo]) -> dict:
@@ -1318,9 +1419,16 @@ def run_check(args, nodes: Optional[List[dict]] = None,
     # consults the debounced states.  None when the flag is off, and then
     # nothing below changes behavior or payload by a single byte.
     history = _build_history(args)
+    analytics = _build_analytics(args) if history is not None else None
+    predictions: List[dict] = []
     if history is not None:
         with timer.phase("history"):
-            _update_history(history, accel)
+            predictions = _update_history(
+                history, accel, analytics=analytics, args=args,
+                events=_round_events(args, events) if analytics else None,
+                trace_id=timer.trace_id,
+                round_seq=getattr(timer, "round_seq", 0) or 0,
+            )
 
     # Effective readiness: kubelet Ready minus unschedulable/probe-failed hosts.
     effective_ready = [n for n in ready if n.effectively_ready]
@@ -1349,7 +1457,15 @@ def run_check(args, nodes: Optional[List[dict]] = None,
         # trace.
         remediation = _build_remediation(args, history, events)
         engine, audit = remediation["engine"], remediation["events"]
-        engine.begin_round(accel, trace_id=timer.trace_id)
+        engine.begin_round(
+            accel, trace_id=timer.trace_id,
+            # The STANDING prediction set (active changepoint episodes),
+            # not just this round's new detections: the budget view and
+            # the repair scheduler want every node currently flagged.
+            predictions=(
+                set(analytics["detector"].active) if analytics else None
+            ),
+        )
         fsm = history["fsm"] if history is not None else None
         with timer.phase("cordon"):
             if getattr(args, "uncordon_recovered", False):
@@ -1469,6 +1585,21 @@ def run_check(args, nodes: Optional[List[dict]] = None,
             # (NodeInfo.health); this is the fleet roll-up plus the round's
             # transition log — what Slack and the metrics families consume.
             payload["history"] = _history_payload(history, accel)
+        if analytics is not None:
+            # The analytics roll-up block (--analytics): this round's
+            # predictions plus store telemetry — what the
+            # tpu_node_checker_analytics_* families render.  The full SLO
+            # documents ride result.analytics_docs (below), not the
+            # payload: they are a serving surface, not round state.
+            detector, seg_store = analytics["detector"], analytics["store"]
+            payload["analytics"] = {
+                "predictions": predictions,
+                "predictions_total": detector.detections_total,
+                "suspects": sorted(detector.active),
+                "buckets": seg_store.bucket_counts(),
+                "rollup_lines_total": seg_store.rollup_lines_total,
+                "compactions_total": seg_store.compactions_total,
+            }
         for phase_name, rep in (("cordon", cordon_report),
                                 ("uncordon", uncordon_report),
                                 ("drain", drain_report),
@@ -1500,6 +1631,17 @@ def run_check(args, nodes: Optional[List[dict]] = None,
         stamp_cluster_identity(payload, args, live_client)
         payload["trace_id"] = timer.trace_id
         payload["exit_code"] = result.exit_code
+    if analytics is not None:
+        # Query documents for GET /api/v1/analytics/* — computed from
+        # roll-ups (never raw replay), serialized once by the server's
+        # publish_analytics, served as atomically-swapped entities.
+        from tpu_node_checker.analytics import build_analytics_docs
+
+        with timer.phase("analytics-query"):
+            result.analytics_docs = build_analytics_docs(
+                analytics["store"], detector=analytics["detector"],
+                predictions=predictions,
+            )
     payload["timings_ms"] = timer.as_dict()
     result.payload = payload
     if tracer is None and getattr(args, "trace", None):
@@ -2680,6 +2822,9 @@ def watch(args) -> int:
                     fleet_server.publish_remediation(
                         result.payload.get("remediation")
                     )
+                    # The analytics view (GET /api/v1/analytics/*): same
+                    # swap discipline; absent docs clear it back to 404.
+                    fleet_server.publish_analytics(result.analytics_docs)
                 sick = _round_sick_set(result)
                 denials = _round_denials_fp(result)
                 # Change fingerprint = exit code + sick-node set: a node
@@ -2873,7 +3018,7 @@ def _cause_class(cause: str) -> str:
     return head if sep else cause[:40]
 
 
-def compute_trend_summary(path: str):
+def compute_trend_summary(path: str, max_lines: Optional[int] = None):
     """The ``--trend`` analysis as data: ``(summary, reason, rounds, skipped)``.
 
     ``summary`` is the machine-readable object ``--trend --json`` prints
@@ -2882,12 +3027,23 @@ def compute_trend_summary(path: str):
     list the human renderer formats timestamps from.  Shared by the CLI
     wrapper (:func:`trend_summary`) and the fleet API's ``/api/v1/trend``
     snapshot cache, so both surfaces compute one set of numbers.
-    """
-    from tpu_node_checker.history.store import read_jsonl_tolerant
 
+    Both callers pass ``max_lines`` (default
+    ``store.DEFAULT_TREND_TAIL_LINES``): the log is read through the
+    bounded TAIL loader, so a multi-GB runaway log costs O(bound) memory
+    per query instead of O(file) — and any log inside the bound (every
+    realistic one) summarizes byte-identically to the unbounded read.
+    """
+    from tpu_node_checker.history.store import (
+        DEFAULT_TREND_TAIL_LINES,
+        read_jsonl_tail,
+    )
+
+    if max_lines is None:
+        max_lines = DEFAULT_TREND_TAIL_LINES
     skipped = 0
     try:
-        entries, skipped = read_jsonl_tolerant(path)
+        entries, skipped, _offset = read_jsonl_tail(path, max_lines=max_lines)
     except OSError as exc:
         return None, f"unreadable: {exc}", [], skipped
     rounds = []

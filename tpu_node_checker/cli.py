@@ -341,6 +341,19 @@ def build_parser() -> argparse.ArgumentParser:
     history.add_argument("--flap-window", type=int, default=None, metavar="W",
                          help="with --history: sliding window (rounds) the "
                          "flap detector counts flips over (default 10)")
+    history.add_argument("--analytics", metavar="DIR",
+                         help="with --history: maintain the fleet "
+                         "analytics tier in DIR — the per-node verdict "
+                         "stream is downsampled into 1m/15m/6h roll-up "
+                         "buckets sharded across per-shard segment files "
+                         "(append-only, atomically compacted; the raw "
+                         "history JSONL stays authoritative), SLO/"
+                         "offender/flap-rate queries are served from "
+                         "GET /api/v1/analytics/{slo,offenders,flaps} "
+                         "under --serve, and an online CUSUM changepoint "
+                         "detector promotes flappers to SUSPECT before "
+                         "the FSM sees a hard failure (predictions feed "
+                         "the remediation budget view)")
     history.add_argument("--trend-nodes", metavar="FILE",
                          help="summarize a --history store per node: "
                          "availability, MTBF/MTTR, flap counts, current "
@@ -505,12 +518,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             ("--probe", args.probe),
             ("--probe-results", args.probe_results),
             ("--node-events", args.node_events),
+            ("--analytics", args.analytics),
         ):
             if val:
                 # Silent-no-op rule: these surfaces gather evidence OUTSIDE
                 # the node-object stream, which the incremental tick does
                 # not re-poll — accepting them would quietly grade on stale
                 # probe/event data the operator thinks is fresh.
+                # (--analytics rides the probe-verdict history stream, so
+                # it waits for the same stream-mode evidence story.)
                 p.error(f"{flag} is not supported with --watch-stream yet "
                         "(use poll-mode --watch)")
     if args.serve_token and args.serve is None:
@@ -560,6 +576,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             ("--selftest", args.selftest),
             ("--calibrate", args.calibrate is not None),
             ("--history", args.history),
+            ("--analytics", args.analytics),
             ("--trend", args.trend),
             ("--trend-nodes", args.trend_nodes),
             ("--log-jsonl", args.log_jsonl),
@@ -652,6 +669,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         # check/emit/notify/quarantine flags the operator thinks ran.
         p.error("--trend-nodes runs alone (only --json may accompany it)")
     for flag, val in (
+        ("--analytics", args.analytics),
         ("--history-max-rounds", args.history_max_rounds),
         ("--cordon-after", args.cordon_after),
         ("--uncordon-after", args.uncordon_after),
@@ -859,6 +877,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             p.error(f"{flag} cannot be combined with --emit-probe")
     if args.emit_probe:
         for flag, on in (
+            # The emitter loop runs no fleet rounds: there is no verdict
+            # stream to roll up or predict over.
+            ("--analytics", args.analytics),
             ("--repair-cmd", args.repair_cmd),
             ("--repair-webhook", args.repair_webhook),
             ("--disruption-budget", args.disruption_budget),
@@ -960,6 +981,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                 ("--probe", args.probe),
                 ("--probe-results", args.probe_results),
                 ("--node-events", args.node_events),
+                ("--analytics", args.analytics),
                 ("--cordon-failed", args.cordon_failed),
                 ("--uncordon-recovered", args.uncordon_recovered),
                 ("--drain-failed", args.drain_failed),

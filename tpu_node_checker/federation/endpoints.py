@@ -110,6 +110,36 @@ def _hash_point(key: str) -> int:
     return int.from_bytes(hashlib.sha256(key.encode("utf-8")).digest()[:8], "big")
 
 
+class HashRing:
+    """The ONE consistent-hash ring: virtual points per slot, keys mapped
+    to the next point clockwise.
+
+    Shared by :func:`shard_clusters` (cluster → fetcher-thread assignment)
+    and the analytics tier's segment store (node → shard-file assignment,
+    :mod:`~tpu_node_checker.analytics.segments`), so shard keys federate:
+    the same key lands on the same slot whichever tier asks, assignments
+    are stable under key churn, and resizing the slot set moves only the
+    keys nearest the new/removed slots' ring points (~1/W of them).
+    """
+
+    def __init__(self, slots, points_per_slot: int = _RING_POINTS_PER_SLOT):
+        self._ring: List[tuple] = sorted(
+            (_hash_point(f"slot-{slot}#{point}"), slot)
+            for slot in slots
+            for point in range(points_per_slot)
+        )
+        if not self._ring:
+            raise ValueError("HashRing needs at least one slot")
+        self._points = [p for p, _ in self._ring]
+
+    def assign(self, key: str):
+        """The slot ``key`` lives on (deterministic across processes)."""
+        idx = bisect.bisect_right(self._points, _hash_point(key)) % len(
+            self._ring
+        )
+        return self._ring[idx][1]
+
+
 def shard_clusters(names: List[str], workers: int) -> Dict[int, List[str]]:
     """Consistent-hash assignment: cluster name → worker slot.
 
@@ -121,14 +151,8 @@ def shard_clusters(names: List[str], workers: int) -> Dict[int, List[str]]:
     workers = max(1, int(workers))
     if workers == 1:
         return {0: list(names)}
-    ring: List[tuple] = sorted(
-        (_hash_point(f"slot-{slot}#{point}"), slot)
-        for slot in range(workers)
-        for point in range(_RING_POINTS_PER_SLOT)
-    )
-    points = [p for p, _ in ring]
+    ring = HashRing(range(workers))
     shards: Dict[int, List[str]] = {}
     for name in names:
-        idx = bisect.bisect_right(points, _hash_point(name)) % len(ring)
-        shards.setdefault(ring[idx][1], []).append(name)
+        shards.setdefault(ring.assign(name), []).append(name)
     return shards

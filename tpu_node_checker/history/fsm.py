@@ -213,6 +213,24 @@ class HealthFSM:
                 h.state = FAILED
         self._clamp(h)
 
+    def promote_suspect(self, node: str) -> Optional[Tuple[str, str]]:
+        """Prediction seam: the analytics changepoint detector flags a
+        flapper *before* the machine condemns it.
+
+        Only a HEALTHY node moves (→ SUSPECT, a legal observe edge,
+        recorded in the same per-round transition log) and its streak is
+        ZEROED: a promoted node still needs the full ``--cordon-after``
+        consecutive bad rounds before any cordon is eligible — prediction
+        is early warning, never an accelerant.  Any other state returns
+        ``None``: the machine already knows at least this much.
+        """
+        h = self.nodes.get(node)
+        if h is None or h.state != HEALTHY:
+            return None
+        h.state = SUSPECT
+        h.streak = 0
+        return self._transitioned(node, HEALTHY, SUSPECT)
+
     @staticmethod
     def _clamp(h: NodeHealth) -> None:
         # Streaks only need to clear thresholds; unbounded growth would

@@ -31,7 +31,7 @@ from __future__ import annotations
 import json
 import os
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 # Major version of the store's line contract.  Bump when a field changes
 # meaning or type; readers refuse lines from majors they do not speak.
@@ -86,6 +86,91 @@ def read_jsonl_tolerant(path: str) -> Tuple[List[dict], int]:
                 continue
             entries.append(entry)
     return entries, skipped
+
+
+# Tail bound the --trend CLI (and the fleet API's trend cache) read with:
+# far past any test or bench log, so output stays byte-identical there,
+# while a multi-GB runaway log costs O(bound) memory instead of O(file).
+DEFAULT_TREND_TAIL_LINES = 500_000
+
+# Backward block size for the tail scan: big enough that even long lines
+# need few reads, small enough that a tiny tail never pays a large read.
+_TAIL_BLOCK = 1 << 16
+
+
+def read_jsonl_tail(
+    path: str,
+    max_lines: Optional[int] = None,
+    start_offset: int = 0,
+    consume_partial_tail: bool = True,
+):
+    """Bounded/resumable variant of :func:`read_jsonl_tolerant`.
+
+    Returns ``(entries, skipped, end_offset)`` with the same tolerance
+    rules, reading only what the caller asked for:
+
+    * ``max_lines`` (with ``start_offset == 0``) — parse only the LAST
+      ``max_lines`` lines, found by scanning backward from EOF in blocks:
+      a multi-GB log costs O(tail), not O(file), in both I/O and RAM;
+    * ``start_offset`` — resume a previous read: parse only bytes appended
+      since ``end_offset`` was last returned.  A file that SHRANK below
+      the offset was rewritten (compaction): the whole file is re-read;
+    * ``consume_partial_tail=False`` — an unterminated final chunk (a
+      writer mid-append) is left UNCONSUMED: ``end_offset`` stops after
+      the last complete line, so the resumed read sees the finished line
+      once, whole.  The default matches :func:`read_jsonl_tolerant`: the
+      final chunk is parsed (and a torn one counted skipped).
+
+    ``end_offset`` is the byte position the next resume should start from.
+    Raises ``OSError`` exactly like the unbounded loader.
+    """
+    entries: List[dict] = []
+    skipped = 0
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if start_offset > size:
+            start_offset = 0  # rewritten underneath us: re-read from scratch
+        offset = start_offset
+        if max_lines is not None and max_lines >= 0 and start_offset == 0:
+            # Backward block scan: stop once the window holds > max_lines
+            # newlines (the extra one marks the boundary line's start).
+            pos, newlines = size, 0
+            while pos > 0 and newlines <= max_lines:
+                step = min(_TAIL_BLOCK, pos)
+                pos -= step
+                f.seek(pos)
+                newlines += f.read(step).count(b"\n")
+            if newlines > max_lines:
+                f.seek(pos)
+                # Skip forward past (newlines - max_lines) line ends; the
+                # remainder is exactly the last max_lines lines (plus any
+                # unterminated tail chunk).
+                for _ in range(newlines - max_lines):
+                    buf = f.readline()
+                    pos += len(buf)
+            offset = pos
+        f.seek(offset)
+        while True:
+            raw = f.readline()
+            if not raw:
+                break
+            if not raw.endswith(b"\n") and not consume_partial_tail:
+                break  # mid-append: leave it for the resumed read
+            offset += len(raw)
+            line = raw.decode("utf-8", errors="replace")
+            if not line.strip():
+                continue
+            try:
+                entry = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if not isinstance(entry, dict):
+                skipped += 1
+                continue
+            entries.append(entry)
+    return entries, skipped, offset
 
 
 class HistoryStore:

@@ -123,6 +123,17 @@ Exported metric families:
   ``tpu_node_checker_remediation_repair_age_seconds`` — age of the
   OLDEST repair still without a terminal state (the stuck-repair alert's
   input; 0 when none are in flight);
+* ``tpu_node_checker_analytics_predictions_total`` — changepoint
+  detections (CUSUM flap episodes, ``--analytics``): each one promoted a
+  still-HEALTHY flapper to SUSPECT before the FSM saw a hard failure;
+* ``tpu_node_checker_analytics_suspects`` — nodes currently inside an
+  active changepoint episode (the standing prediction set);
+* ``tpu_node_checker_analytics_buckets{res}`` — closed roll-up buckets
+  retained in the analytics segment store, by resolution (60/900/21600 s);
+* ``tpu_node_checker_analytics_rollup_lines_total`` /
+  ``tpu_node_checker_analytics_compactions_total`` — segment-store write
+  telemetry: lines appended through the ``append_bucket`` gate, and
+  atomic tmp+rename shard compactions;
 * ``tpu_node_checker_federation_lease_total{result}`` /
   ``tpu_node_checker_federation_fleet_budget_remaining`` — the
   ``--federate`` aggregator's disruption-lease traffic (granted permits
@@ -657,6 +668,49 @@ def render_metrics(
             "store — a rising rate is quarantine churn in progress even "
             "while every round's aggregate grade stays green.",
             [({}, float(history.get("flaps_total", 0)))],
+        )
+    analytics = payload.get("analytics")
+    if analytics is not None:
+        # Fleet analytics tier (--analytics): prediction and roll-up
+        # telemetry.  Gauges cover the standing state; counters are
+        # lifetime (the store/detector persist across watch rounds).
+        family(
+            "tpu_node_checker_analytics_predictions_total",
+            "counter",
+            "Changepoint detections (CUSUM flap episodes opened) — each "
+            "one promoted a still-HEALTHY flapper to SUSPECT ahead of "
+            "the FSM's own evidence.",
+            [({}, float(analytics.get("predictions_total", 0)))],
+        )
+        family(
+            "tpu_node_checker_analytics_suspects",
+            "gauge",
+            "Nodes currently inside an active changepoint episode (the "
+            "standing prediction set the remediation budget view "
+            "surfaces).",
+            [({}, float(len(analytics.get("suspects") or ())))],
+        )
+        family(
+            "tpu_node_checker_analytics_buckets",
+            "gauge",
+            "Closed roll-up buckets retained in the segment store, by "
+            "resolution (seconds).",
+            [({"res": res}, float(n))
+             for res, n in sorted((analytics.get("buckets") or {}).items())],
+        )
+        family(
+            "tpu_node_checker_analytics_rollup_lines_total",
+            "counter",
+            "Roll-up lines appended to segment files through the "
+            "append_bucket gate (lifetime).",
+            [({}, float(analytics.get("rollup_lines_total", 0)))],
+        )
+        family(
+            "tpu_node_checker_analytics_compactions_total",
+            "counter",
+            "Atomic segment-file compactions (tmp+rename rewrites of a "
+            "shard's live bucket set).",
+            [({}, float(analytics.get("compactions_total", 0)))],
         )
     transport = payload.get("api_transport")
     if transport:

@@ -212,13 +212,22 @@ class BudgetEngine:
         self._round_denials: List[dict] = []
         self._round_budget_used = 0  # disruptive grants this round
         self._round_granted: set = set()  # node names granted cordon/drain
+        self._predictions: set = set()  # analytics changepoint suspects
         self.repairs: Optional[dict] = None  # repair.py stamps its roll-up
 
     # -- round lifecycle -----------------------------------------------------
 
-    def begin_round(self, accel: List, trace_id: Optional[str] = None) -> None:
+    def begin_round(self, accel: List, trace_id: Optional[str] = None,
+                    predictions: Optional[set] = None) -> None:
+        """``predictions`` (the analytics tier's standing changepoint
+        set, ``--analytics``) is the budget view's early-warning input:
+        surfaced per domain in :meth:`payload_block` so the repair
+        scheduler sees which domains are PREDICTED to degrade before the
+        FSM condemns a single node in them.  It never relaxes a refusal
+        and never grants anything — prediction informs, evidence gates."""
         self._accel = list(accel)
         self._trace_id = trace_id
+        self._predictions = set(predictions or ())
         self._round_denials = []
         self._round_budget_used = 0
         self._round_granted = set()
@@ -416,6 +425,20 @@ class BudgetEngine:
             "denials": self.denials(),
             "domains": {"total": len(self._domains), "at_floor": at_floor},
         }
+        if self._predictions:
+            # The prediction input (--analytics): standing changepoint
+            # suspects, plus the domains they would degrade — what a
+            # slice-aware repair scheduler reads to stage work BEFORE the
+            # FSM condemns anything.
+            predicted_domains = sorted({
+                d for n in self._accel
+                if n.name in self._predictions
+                and (d := self.domain_of(n)) is not None
+            })
+            block["prediction"] = {
+                "suspects": sorted(self._predictions),
+                "domains": predicted_domains,
+            }
         if self.slice_floor_pct is not None:
             block["slice_floor_pct"] = self.slice_floor_pct
         if self.budget is not None:

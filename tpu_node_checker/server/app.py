@@ -289,6 +289,10 @@ class FleetStateServer:
         # pre-serialized Entity swapped per round by publish_remediation —
         # request threads only ever negotiate an immutable reference.
         self._remediation = None
+        # The analytics tier's view (GET /api/v1/analytics/{slo,offenders,
+        # flaps}): dict of pre-serialized Entities, swapped as ONE
+        # reference per round — same lock-free read discipline (TNC011).
+        self._analytics: Optional[Dict[str, object]] = None
         self._global = None  # merge.GlobalSnapshot, swapped atomically
         self._seq = 0
         self._breaker: Optional[dict] = None
@@ -327,6 +331,9 @@ class FleetStateServer:
         router.add("GET", "/api/v1/nodes/{name}", self._get_node)
         router.add("GET", "/api/v1/trend", self._get_trend)
         router.add("GET", "/api/v1/remediation", self._get_remediation)
+        for key in ("slo", "offenders", "flaps"):
+            router.add("GET", f"/api/v1/analytics/{key}",
+                       self._get_analytics(key))
         router.add("POST", "/api/v1/global/disruption-lease",
                    self._post_lease)
         router.add("GET", "/api/v1/debug/rounds", self._get_debug_rounds)
@@ -515,6 +522,19 @@ class FleetStateServer:
             body, "application/json; charset=utf-8"
         )
 
+    def publish_analytics(self, docs: Optional[dict]) -> None:
+        """Swap the analytics query documents one round computed from its
+        roll-ups (None clears back to 404).  Each doc is serialized ONCE
+        here; request threads only negotiate immutable entities."""
+        if docs is None:
+            self._analytics = None
+            return
+        from tpu_node_checker.server.snapshot import json_entity
+
+        self._analytics = {
+            key: json_entity(doc) for key, doc in sorted(docs.items())
+        }
+
     def refresh_metrics(self, result, breaker: Optional[dict] = None) -> None:
         """A steady watch-stream tick: served content is unchanged (no
         snapshot swap, every poller's ETag keeps 304-ing) but the scrape
@@ -680,10 +700,10 @@ class FleetStateServer:
             return json_response(
                 404, {"error": "no trend log configured (--log-jsonl)"}
             )
-        snap = self._current()
-        return negotiate(
-            self._trend.entity(snap.seq if snap else 0), req.headers
-        )
+        # _current() runs for its standalone-mode refresh side effect; the
+        # cache keys purely on the log's content digest (never the seq).
+        self._current()
+        return negotiate(self._trend.entity(), req.headers)
 
     def _get_remediation(self, req: Request) -> Response:
         entity = self._remediation
@@ -695,6 +715,21 @@ class FleetStateServer:
                           "ran this round"},
             )
         return negotiate(entity, req.headers)
+
+    def _get_analytics(self, key: str):
+        def handler(req: Request) -> Response:
+            entities = self._analytics
+            entity = entities.get(key) if entities is not None else None
+            if entity is None:
+                return json_response(
+                    404,
+                    {"error": "analytics is not active on this checker: "
+                              "run with --analytics DIR (requires "
+                              "--history) to build the roll-up store"},
+                )
+            return negotiate(entity, req.headers)
+
+        return handler
 
     def _get_healthz(self, req: Request) -> Response:
         return json_response(200, {"ok": True})

@@ -24,6 +24,7 @@ from __future__ import annotations
 import gzip
 import hashlib
 import json
+import os
 import threading
 from typing import Dict, Optional
 
@@ -464,42 +465,163 @@ def build_trendlog_snapshot(path: str, seq: int, ts: float) -> FleetSnapshot:
 
 class TrendCache:
     """``/api/v1/trend`` cache over a ``--log-jsonl`` trend log —
-    **stale-while-revalidate**.
+    **stale-while-revalidate**, keyed by the TREND-RELEVANT content
+    digest.
 
-    Steady state is a stat per request.  When the cache key moves (the
-    publication seq — a new round in THIS process — or the file's
-    mtime/size signature — a store written by another process), the reader
-    is served the PREVIOUS entity immediately and ONE rebuild runs on a
-    background thread; the fresh entity swaps in when it lands.  A
-    trend-log rewrite therefore never stalls a reader behind the JSONL
-    re-read + summary math.  Only the very first build (nothing stale to
-    serve yet) blocks the requester, exactly as before SWR.
+    Steady state is a stat per request.  When the file's mtime/size
+    signature moves, only the APPENDED bytes are parsed (byte-offset
+    resume through the history store's tail loader; a shrink or rewrite —
+    compaction — re-reads from scratch) and each new entry's projection
+    onto the fields the trend math actually consumes is folded into a
+    running digest.  Only a digest MOVE triggers a rebuild: a publication
+    seq advancing over an unchanged log (the steady watch round), a
+    touched-but-identical file, or appended lines carrying no
+    trend-relevant fields all cost zero rebuilds — the regression this
+    class used to have (one full JSONL re-read + summary per ``(seq,
+    signature)`` move, trend-relevant or not) is pinned away by
+    ``tests/test_server.py::TestTrendCache``.
+
+    On a digest move the reader is served the PREVIOUS entity immediately
+    and ONE rebuild runs on a background thread (SWR); only the very
+    first build (nothing stale to serve yet) blocks the requester.
     """
+
+    # The fields compute_trend_summary reads: lines differing only in
+    # OTHER fields must not move the digest.
+    _TREND_FIELDS = (
+        "ts", "exit_code", "causes", "error", "planned", "ready_chips",
+        "total_chips", "slices", "slices_complete", "chronic",
+    )
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
-        self._key = None
+        self._key = None  # the digest hex the served entity was built from
         self._pending = None  # key a background rebuild is running for
         self._entity: Optional[Entity] = None
+        self._sig = None  # (mtime_ns, size) of the last scanned file state
+        self._offset = 0  # resume point for the incremental scan
+        self._suffix = b""  # last bytes before _offset: rewrite detector
+        self._hasher = hashlib.sha256()
         self.rebuilds = 0  # observability + test seam
         self.stale_served = 0  # → ..._swr_stale_served_total
 
-    def _signature(self, seq: int):
-        from tpu_node_checker.history.store import file_signature
+    # tnc: allow-transitive-blocking(the digest scan reads only the bytes APPENDED since the last request — it runs solely when the file signature already moved, replacing the full JSONL re-read + summary rebuild the old (seq,signature) key paid on every publish; the steady path above it is one stat)
+    def _advance_digest(self) -> Optional[str]:
+        """Fold bytes appended since the last scan into the running
+        digest (full re-read after a shrink/rewrite); returns the digest
+        hex — the cache key — or ``None`` on a TRANSIENT read failure (an
+        external rotation racing the stat): the caller must then NOT
+        commit the new signature, so the missed bytes are re-scanned on
+        the next request instead of being skipped forever.  A missing
+        file is not transient — it digests as empty, matching the
+        summary's machine-readable empty-log answer."""
+        from tpu_node_checker.history.store import read_jsonl_tail
 
-        return (seq, file_signature(self.path))
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            size = 0
+        if size < self._offset or not self._check_suffix():
+            # Shrunk, or the bytes before our resume point changed (an
+            # in-place rewrite that GREW the file — mtime/size alone
+            # cannot tell it from an append): the running digest no
+            # longer describes the file — start over.
+            self._offset = 0
+            self._suffix = b""
+            self._hasher = hashlib.sha256()
+        try:
+            # max_lines applies only to from-scratch scans (offset 0 —
+            # first request, or a shrink/rewrite): the digest guard must
+            # never cost more than the rebuild it guards, and the rebuild
+            # itself reads at most DEFAULT_TREND_TAIL_LINES.  Resumed
+            # scans parse only the appended bytes regardless.
+            from tpu_node_checker.history.store import (
+                DEFAULT_TREND_TAIL_LINES,
+            )
+
+            entries, skipped, self._offset = read_jsonl_tail(
+                self.path, max_lines=DEFAULT_TREND_TAIL_LINES,
+                start_offset=self._offset,
+                consume_partial_tail=False,
+            )
+        except FileNotFoundError:
+            return self._hasher.hexdigest()
+        except OSError:
+            return None
+        for e in entries:
+            projection = {
+                k: e[k] for k in self._TREND_FIELDS if k in e
+            }
+            if projection:
+                self._hasher.update(
+                    json.dumps(projection, sort_keys=True,
+                               ensure_ascii=False).encode("utf-8")
+                )
+            else:
+                # A valid line with no trend field still moves the
+                # summary's skipped_lines count (the trend math cannot
+                # read it), so it is trend-relevant after all — the true
+                # digest-holds case is a REWRITE that only changed
+                # non-trend FIELDS of existing lines.
+                skipped += 1
+        if skipped:
+            # Malformed lines surface in the summary's skipped count, so
+            # they are trend-relevant too.
+            self._hasher.update(b"skip:%d" % skipped)
+        self._suffix = self._read_suffix()
+        return self._hasher.hexdigest()
+
+    _SUFFIX_LEN = 64
+
+    def _check_suffix(self) -> bool:
+        """True when the bytes immediately before the resume offset still
+        match what the last scan saw — the append-vs-rewrite test."""
+        if self._offset == 0:
+            return True
+        return self._read_suffix() == self._suffix
+
+    def _read_suffix(self) -> bytes:
+        start = max(0, self._offset - self._SUFFIX_LEN)
+        try:
+            with open(self.path, "rb") as f:  # tnc: allow-blocking-read-path(one ≤64-byte pread under _advance_digest's sanctioned signature-moved scan; the steady read path never reaches it)
+                f.seek(start)
+                return f.read(self._offset - start)
+        except OSError:
+            return b""
 
     # tnc: allow-transitive-blocking(the SWR first build is the one sanctioned synchronous store read — once per process, before any stale entity exists to serve; every later rebuild runs on the tnc-trend-swr thread, per the TNC011 exception annotated on the lock below)
-    def entity(self, seq: int) -> Entity:
-        key = self._signature(seq)
-        # tnc: allow-blocking-read-path(the sanctioned exception — DESIGN §10/§13: one stat per request; the lock guards flag flips and the FIRST build only, every later rebuild runs on a tnc-trend-swr thread while readers get the stale entity)
+    def entity(self) -> Entity:
+        from tpu_node_checker.history.store import file_signature
+
+        # tnc: allow-blocking-read-path(the sanctioned exception — DESIGN §10/§13: one stat per request (plus a parse of only the APPENDED bytes when the signature moved); the lock guards flag flips and the FIRST build only, every later rebuild runs on a tnc-trend-swr thread while readers get the stale entity)
         with self._lock:
-            if key == self._key and self._entity is not None:
+            sig = file_signature(self.path)
+            if sig == self._sig and self._entity is not None:
+                # The file did not move: whatever seq did, the summary
+                # cannot have changed (the no-op-publish fast path).  A
+                # rebuild still in flight means the served entity is
+                # stale — the SWR counter must say so.
+                if self._pending is not None:
+                    self.stale_served += 1
                 return self._entity
+            key = self._advance_digest()
+            if key is None:
+                # Transient read failure: keep the old signature so the
+                # next request retries the scan; serve what we have.
+                if self._entity is not None:
+                    return self._entity
+                key = self._hasher.hexdigest()
+            else:
+                # Commit the signature only AFTER the scan succeeded — a
+                # failed read must not let sig==self._sig fast-path past
+                # the bytes it never digested.
+                self._sig = sig
+            if key == self._key and self._entity is not None:
+                return self._entity  # touched, or non-trend bytes only
             if self._entity is not None:
                 # Stale-while-revalidate: serve what we have NOW; exactly
-                # one rebuild per key change runs off-thread.
+                # one rebuild per digest change runs off-thread.
                 if self._pending != key:
                     self._pending = key
                     threading.Thread(

@@ -133,6 +133,11 @@ class SimWorld:
     def history_path(self, cluster: str) -> str:
         return os.path.join(self.tmpdir, f"history-{cluster}.jsonl")
 
+    def analytics_dir(self, cluster: str) -> str:
+        path = os.path.join(self.tmpdir, f"analytics-{cluster}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
     # -- driving the real checker --------------------------------------------
 
     def checker_round(self, argv: List[str], round_i: int,
@@ -183,6 +188,17 @@ class SimWorld:
                 for t in ((result.payload.get("history") or {})
                           .get("transitions") or [])
             ]
+            predictions = sorted(
+                p["node"]
+                for p in (result.payload.get("analytics") or {}).get(
+                    "predictions"
+                ) or []
+            )
+            if predictions:
+                # Node names only: scores are deterministic too, but the
+                # record keeps the minimal ground truth the invariant
+                # reads (bucket/timestamp fields must never leak in).
+                record["predictions"] = predictions
             record["trace_ok"] = bool(
                 result.payload.get("trace_id") == tracer.trace_id
                 and "detect" in tracer.as_dict()
@@ -205,7 +221,8 @@ class SimWorld:
         ]
         if record.get("error"):
             parts.append(f"error={record['error']}")
-        for key in ("sick", "denials", "transitions", "patches"):
+        for key in ("sick", "denials", "transitions", "predictions",
+                    "patches"):
             values = record.get(key)
             if values:
                 parts.append(f"{key}={','.join(values)}")
@@ -275,6 +292,8 @@ def _reset_checker_state() -> None:
     checker._HISTORY_CACHE["tracker"] = None
     checker._REMEDIATION_CACHE["key"] = None
     checker._REMEDIATION_CACHE["bundle"] = None
+    checker._ANALYTICS_CACHE["key"] = None
+    checker._ANALYTICS_CACHE["bundle"] = None
 
 
 def run_scenario(name: str, seed: int, clusters: Optional[int] = None,

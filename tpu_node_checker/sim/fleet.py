@@ -13,6 +13,10 @@ Failure programs (per node):
 * ``("steady",)`` — healthy every round (the default);
 * ``("flap", phase, period)`` — verdict False on rounds where
   ``(round + phase) % period == 0`` (the chronic flapper);
+* ``("flap-until", phase, period, die_at)`` — flaps like ``flap`` until
+  round ``die_at``, then failed forever: the DECAYING part — flapping is
+  the prodrome of a hard failure, exactly the shape the analytics
+  changepoint detector exists to predict;
 * ``("fail-at", r)`` — healthy until round ``r``, then failed forever
   (mass storms, staggered slow-drains);
 * ``("kubelet-down-at", r)`` — the NODE goes NotReady at round ``r``
@@ -105,6 +109,11 @@ class SimCluster:
             if prog[0] == "flap":
                 _, phase, period = prog
                 out[name] = (round_i + phase) % period != 0
+            elif prog[0] == "flap-until":
+                _, phase, period, die_at = prog
+                out[name] = (
+                    round_i < die_at and (round_i + phase) % period != 0
+                )
             elif prog[0] == "fail-at":
                 out[name] = round_i < prog[1]
             else:

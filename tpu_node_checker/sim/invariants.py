@@ -250,6 +250,48 @@ def check_staleness_labels(timeline: Sequence[dict], dead_cluster: str,
                      "staleness monotone, fleet healthy throughout")
 
 
+def check_prediction_precedes_failure(records: Sequence[dict],
+                                      flappers: Sequence[str]) -> Verdict:
+    """Prediction beats the FSM: every ground-truth flapper is flagged by
+    the changepoint detector, and the flagging round strictly precedes
+    the node's first FSM FAILED **and** first CHRONIC (when either
+    happens at all) — SUSPECT-by-prediction must land ≥1 round before any
+    condemnation the hysteresis machine reaches on its own evidence."""
+    name = "prediction-precedes-failure"
+    detected: Dict[str, int] = {}
+    condemned: Dict[str, Dict[str, int]] = {}
+    for r in records:
+        for node in r.get("predictions") or ():
+            detected.setdefault(node, r["round"])
+        for t in r.get("transitions") or ():
+            node, _, edge = t.partition(":")
+            _src, _, dst = edge.partition(">")
+            if dst in (FAILED, CHRONIC):
+                condemned.setdefault(node, {}).setdefault(dst, r["round"])
+    timeline = {}
+    for node in flappers:
+        d = detected.get(node)
+        if d is None:
+            return _fail(name, f"flapper {node} was never flagged by the "
+                               "changepoint detector")
+        for dst, c in sorted(condemned.get(node, {}).items()):
+            if d >= c:
+                return _fail(name, f"flapper {node} flagged round {d}, "
+                                   f"but first {dst} was round {c} — "
+                                   "prediction must lead by ≥1 round")
+        timeline[node] = (d, condemned.get(node, {}))
+    lead = [
+        min(c for c in cond.values()) - d
+        for d, cond in timeline.values() if cond
+    ]
+    if not lead:
+        return _fail(name, "no flapper was ever condemned (FAILED or "
+                           "CHRONIC): the scenario graded nothing")
+    return _ok(name, f"{len(flappers)} flappers flagged ahead of "
+                     f"condemnation (lead rounds: min {min(lead)}, "
+                     f"max {max(lead)})")
+
+
 def check_trace_completeness(records: Sequence[dict]) -> Verdict:
     """Every completed round ran under a tracer: the payload carries the
     round's trace_id and the trace recorded the detect phase (exit-1

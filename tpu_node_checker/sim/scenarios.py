@@ -96,8 +96,18 @@ def _run_flap_storm(world: SimWorld) -> None:
     cluster = synth_cluster("sim-c0", p["nodes_per_cluster"], min_slices=2)
     flappers = cluster.assign(world.rng, lambda i: ("flap", 1, 2),
                               per_slice=1)
+    # Decayers: flapping as the PRODROME of a hard failure — period-3
+    # flaps until round 6, then failed forever.  The changepoint detector
+    # must fire on the flapping (round 5, a GOOD round, so the promotion
+    # seam moves HEALTHY→SUSPECT) before --cordon-after 3 consecutive bad
+    # rounds condemn the node FAILED at round 8 — the
+    # prediction-precedes-failure invariant.
+    decayers = cluster.assign(world.rng,
+                              lambda i: ("flap-until", 2, 3, 6),
+                              per_slice=1)
     world.event(f"fleet slices={len(cluster.by_slice)} "
-                f"flappers={','.join(sorted(flappers))}")
+                f"flappers={','.join(sorted(flappers))} "
+                f"decayers={','.join(sorted(decayers))}")
     server, state = fx.storm_apiserver(cluster.nodes())
     world.on_cleanup(server.shutdown)
     kc = world.kubeconfig(server.server_address[1], "c0")
@@ -118,10 +128,16 @@ def _run_flap_storm(world: SimWorld) -> None:
         _result, rec = world.checker_round(_base_argv(
             kc, reports,
             "--history", world.history_path("c0"),
+            "--analytics", world.analytics_dir("c0"),
             # --cordon-after 3: a period-2 flapper can never string 3 bad
             # rounds together, so quarantine comes from the CHRONIC flap
             # trap — the layer this scenario exists to exercise.
             "--cordon-after", "3",
+            # --flap-threshold 6: the period-2 flappers still trip CHRONIC
+            # (6 flips by round 6) while the decayers' 5 in-window flips
+            # stay below it — their condemnation must come from FAILED,
+            # the edge the prediction invariant measures against.
+            "--flap-threshold", "6",
             "--cordon-failed", "--cordon-max", "8",
             "--slice-floor-pct", "50", "--disruption-budget", "2",
         ), r, "sim-c0")
@@ -137,8 +153,12 @@ def _run_flap_storm(world: SimWorld) -> None:
     world.grade(inv.check_slice_floor(floor_timeline, floor_chips))
     world.grade(inv.check_fsm_legality(world.records))
     # The flap-proof-quarantine payoff: the debounced fingerprint moves
-    # ONCE (the CHRONIC promotion), not once per flap.
+    # TWICE (the CHRONIC promotion, then the decayers' FAILED), never
+    # once per flap.
     world.grade(inv.check_slack_dedup(world.records, max_alerts=3))
+    world.grade(inv.check_prediction_precedes_failure(
+        world.records, sorted(flappers) + sorted(decayers)
+    ))
     world.grade(inv.check_trace_completeness(world.records))
 
 
@@ -640,12 +660,14 @@ SCENARIOS: Dict[str, Scenario] = {
     for s in (
         Scenario(
             name="flap-storm",
-            title="Chronic flappers debounced into CHRONIC quarantine",
+            title="Chronic flappers debounced into CHRONIC quarantine; "
+                  "decaying flappers predicted before FAILED",
             runner=_run_flap_storm,
-            defaults={"clusters": 1, "nodes_per_cluster": 8, "rounds": 8,
-                      "min_rounds": 6},
+            defaults={"clusters": 1, "nodes_per_cluster": 8, "rounds": 10,
+                      "min_rounds": 10},
             invariants=("exit-code-contract", "disruption-budget",
                         "slice-floor", "fsm-legality", "slack-dedup",
+                        "prediction-precedes-failure",
                         "trace-completeness"),
         ),
         Scenario(
