@@ -1,0 +1,33 @@
+"""Seeds for the stream-consumer (feed-reader) thread shape the
+streaming-federation tier introduced: a daemon thread long-polls an
+upstream watch feed and folds frames into state the engine's round
+thread drains.  The spawn makes the reader its OWN lock domain while
+``seed_view`` keeps the main path writing too, so TNC112 must judge
+every ``FeedTable`` write across BOTH domains: the locked fold in
+``feedstate.py`` is the near-miss (quiet), the bare cursor reset below
+— the tempting "just drop the cursor on error" move — is the race."""
+
+import threading
+
+from tpu_node_checker.federation.feedstate import FeedTable
+
+
+def start_reader(table: 'FeedTable'):
+    threading.Thread(
+        target=_consume, args=(table,), name="tnc-feed-reader", daemon=True
+    ).start()
+
+
+def seed_view(table: 'FeedTable', entries):
+    # main-path writer: the same locked fold the reader uses, so the
+    # table genuinely spans two thread domains.
+    table.apply(entries)
+
+
+def _consume(table: 'FeedTable'):
+    table.apply({"tpu-00": {"ready": True}})  # near-miss: the locked fold
+    table.cursor = ""  # EXPECT[TNC112]
+
+
+def peek(table: 'FeedTable'):
+    return table.cursor  # near-miss: a read is not a write-write race

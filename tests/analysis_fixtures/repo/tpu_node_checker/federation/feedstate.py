@@ -1,0 +1,18 @@
+"""Shared-state side of the feed-reader seeds (TNC112): a cursor + entry
+table guarded by one lock, folded from a consumer thread in
+``feedreader.py``.  ``apply`` is the clean shape — every write under the
+lock — that the reader-side bare write races against."""
+
+import threading
+
+
+class FeedTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cursor = ""
+        self.entries = {}
+
+    def apply(self, frame):
+        with self._lock:
+            self.entries.update(frame)
+            self.cursor = "verified"

@@ -34,6 +34,7 @@ from tpu_node_checker.sim.fixtures import (  # noqa: F401
     FaultSchedule,
     StormSchedule,
     WatchScript,
+    churn_flips,
     fault_scheduled_handler,
     make_node,
     node_list,

@@ -808,6 +808,376 @@ class TestFetchTierHardening:
 
 
 # ---------------------------------------------------------------------------
+# Streaming federation (--federate-feed): the push-delta stream fetcher
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingFederation:
+    """Stream mode consumes each upstream's ``/api/v1/watch`` feed the way
+    ``watchstream.py`` consumes k8s events: the poll round is the relist,
+    state then arrives as pushed frames, and a steady round costs ZERO
+    upstream requests.  Poll mode stays the byte-identical fallback."""
+
+    def _fleet(self, tmp_path, specs, feed=True):
+        servers = []
+        for name, n in specs:
+            if feed:
+                srv = _fixture_cluster(name, n)
+            else:
+                srv = FleetStateServer(0, host="127.0.0.1", feed=False)
+                payload = _round_payload(name, n)
+                srv.publish(_Round(payload, payload["exit_code"]))
+            servers.append((name, srv))
+        endpoints = tmp_path / "endpoints.json"
+        _write_endpoints(endpoints, servers)
+        return dict(servers), endpoints
+
+    def _feed_args(self, path, extra=()):
+        return _args(path, extra=("--federate-feed", *extra))
+
+    @staticmethod
+    def _wait_streams(engine):
+        """Bounded wait for every upstream stream to be open with
+        digest-verified state (the poll-round relist seeds the cursor, so
+        this is normally immediate)."""
+        deadline = time.perf_counter() + 10.0
+        while True:
+            clients = dict(engine._feeds)
+            if len(clients) == len(engine.views) and all(
+                c._state is not None for c in clients.values()
+            ):
+                return
+            assert time.perf_counter() < deadline, (
+                f"streams never opened: {len(clients)}/{len(engine.views)}"
+            )
+            time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s wait for REAL stream threads to verify their seeded state)
+
+    @staticmethod
+    def _wait_applied(client, target_etag, what="frame"):
+        """Bounded wait for the client's APPLIED cursor to reach the
+        just-published etag — the state the next round will drain."""
+        deadline = time.perf_counter() + 10.0
+        while True:
+            with client._lock:
+                state = client._state
+            if state is not None and state[0] == target_etag:
+                return
+            assert time.perf_counter() < deadline, f"{what} never applied"
+            time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s wait for a REAL pushed frame to fold and digest-verify)
+
+    def test_steady_stream_round_costs_zero_fetches(self, tmp_path):
+        servers, endpoints = self._fleet(
+            tmp_path, [("us-a", 4), ("eu-b", 3)]
+        )
+        engine = FederationEngine(self._feed_args(endpoints))
+        try:
+            first = engine.round()  # the relist: polls, then opens streams
+            self._wait_streams(engine)
+            before = {n: dict(srv.stats.requests)
+                      for n, srv in servers.items()}
+            second = engine.round()
+            third = engine.round()
+            for name, srv in servers.items():
+                delta = {
+                    k: n - before[name].get(k, 0)
+                    for k, n in srv.stats.requests.items()
+                    if n != before[name].get(k, 0)
+                }
+                # Fixture-side ground truth: steady stream rounds issue NO
+                # conditional GETs — the only upstream traffic is the
+                # stream's own long-poll.
+                assert set(delta) <= {("GET", "/api/v1/watch", 200)}, (
+                    name, delta
+                )
+            assert second.entity("global/nodes") is first.entity("global/nodes")
+            assert third.entity("global/nodes") is first.entity("global/nodes")
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_stream_view_is_byte_identical_to_poll_view(self, tmp_path):
+        """The acceptance pin: a federated view built from delta frames is
+        byte-identical to one built from full conditional GETs — same
+        entry bytes, same upstream validators, same merged block."""
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 6)])
+        stream = FederationEngine(self._feed_args(endpoints))
+        poll = FederationEngine(_args(endpoints))
+        try:
+            stream.round()
+            poll.round()
+            self._wait_streams(stream)
+            payload = _round_payload("us-a", 6, healthy=False)
+            servers["us-a"].publish(_Round(payload, payload["exit_code"]))
+            self._wait_applied(
+                stream._feeds["us-a"],
+                servers["us-a"]._snap.entities["nodes"].etag,
+            )
+            stream_snap = stream.round()   # zero-fetch: folds the frame
+            poll_snap = poll.round()       # fresh conditional GETs
+            sv, pv = stream.views["us-a"], poll.views["us-a"]
+            assert sv.nodes_entries == pv.nodes_entries  # exact bytes
+            assert sv.nodes_etag == pv.nodes_etag
+            assert sv.summary_doc == pv.summary_doc
+            # The merged global bodies agree on everything but the merge
+            # stamp (each engine's own round counter/clock).
+            s_doc = json.loads(stream_snap.entity("global/nodes").raw)
+            p_doc = json.loads(poll_snap.entity("global/nodes").raw)
+            for doc in (s_doc, p_doc):
+                doc.pop("ts", None)
+                doc.pop("round", None)
+            assert s_doc == p_doc
+            assert sv.block() == pv.block()  # the spliced block bytes
+        finally:
+            stream.close()
+            poll.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_feedless_upstream_silently_falls_back_to_polling(
+        self, tmp_path, capsys
+    ):
+        """The acceptance pin: ``--federate-feed`` against an upstream
+        without the watch endpoint (older build, ``feed=False``) degrades
+        that cluster to conditional-GET polling — silently, permanently,
+        with exactly one probe."""
+        servers, endpoints = self._fleet(
+            tmp_path, [("us-a", 3)], feed=False
+        )
+        engine = FederationEngine(self._feed_args(endpoints))
+        try:
+            engine.round()
+            # The probe thread dies on the 404; consume it deterministically
+            # by waiting for the unsupported mark.
+            deadline = time.perf_counter() + 10.0
+            while "us-a" not in engine._feed_unsupported:
+                engine.round()
+                assert time.perf_counter() < deadline, "404 probe never landed"
+                time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s wait for the REAL probe thread's 404 exit)
+            before = dict(servers["us-a"].stats.requests)
+            engine.round()
+            engine.round()
+            after = servers["us-a"].stats.requests
+            delta = {k: n - before.get(k, 0) for k, n in after.items()
+                     if n != before.get(k, 0)}
+            # Pure poll mode from here on: one 304 per endpoint per round,
+            # no further watch probes.
+            assert delta == {
+                ("GET", "/api/v1/summary", 304): 2,
+                ("GET", "/api/v1/nodes", 304): 2,
+            }, delta
+            assert engine._feeds == {}
+            assert not engine.views["us-a"].stale
+            # Silent: no feed-lost event for a merely feed-less upstream.
+            assert "feed-lost" not in capsys.readouterr().err
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_dead_feed_degrades_only_its_shard(self, tmp_path, capsys):
+        """Shard-degraded-never-fleet, one tier up: a dying stream fails
+        over to the poll ladder for ITS cluster only — the shard-mate's
+        stream keeps serving zero-fetch rounds and the fleet keeps
+        answering."""
+        servers, endpoints = self._fleet(
+            tmp_path, [("us-a", 4), ("eu-b", 3)]
+        )
+        engine = FederationEngine(self._feed_args(endpoints))
+        agg = FleetStateServer(0, host="127.0.0.1", federation=True,
+                               readiness=engine.readiness)
+        try:
+            engine.round(agg)
+            self._wait_streams(engine)
+            mate_before = dict(servers["us-a"].stats.requests)
+            dead_client = engine._feeds["eu-b"]
+            servers["eu-b"].close()
+            dead_client.thread.join(timeout=10)
+            assert not dead_client.thread.is_alive(), "stream outlived server"
+            engine.round(agg)  # consumes the death, falls back to polling
+            err = capsys.readouterr().err
+            event = json.loads(
+                [l for l in err.splitlines() if '"feed-lost"' in l][0]
+            )
+            assert event["cluster"] == "eu-b"
+            assert "us-a" not in err
+            assert engine.views["eu-b"].stale
+            assert not engine.views["us-a"].stale
+            mate_delta = {
+                k: n - mate_before.get(k, 0)
+                for k, n in servers["us-a"].stats.requests.items()
+                if n != mate_before.get(k, 0)
+            }
+            assert set(mate_delta) <= {("GET", "/api/v1/watch", 200)}
+            # The fleet keeps serving, eu-b labeled degraded.
+            _, _, body = _req(agg.port, "GET", "/api/v1/global/summary")
+            summary = json.loads(body)
+            assert summary["degraded_clusters"] == ["eu-b"]
+            assert summary["total_nodes"] == 7  # last-known still counted
+        finally:
+            agg.close()
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_restart_resumes_at_cursor_without_resync(self, tmp_path):
+        """Satellite: an aggregator restart mid-stream seeds the new feed
+        client from its first poll round and resumes AT the verified
+        cursor — the upstream never serves it a resync frame."""
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 5)])
+        first = FederationEngine(self._feed_args(endpoints))
+        try:
+            first.round()
+            self._wait_streams(first)
+            payload = _round_payload("us-a", 5, healthy=False)
+            servers["us-a"].publish(_Round(payload, payload["exit_code"]))
+            self._wait_applied(
+                first._feeds["us-a"],
+                servers["us-a"]._snap.entities["nodes"].etag,
+            )
+            first.round()
+        finally:
+            first.close()
+        restarted = FederationEngine(self._feed_args(endpoints))
+        try:
+            resyncs_before = servers["us-a"]._feed.stats()[1]
+            restarted.round()  # relist: fresh GETs seed the view…
+            self._wait_streams(restarted)  # …and the stream resumes parked
+            assert servers["us-a"]._feed.stats()[1] == resyncs_before, (
+                "restart cost a resync frame instead of a cursor resume"
+            )
+            # The resumed stream is live: the next churn arrives as a
+            # pushed delta and the round folds it with zero fetches.
+            before = dict(servers["us-a"].stats.requests)
+            servers["us-a"].publish(_Round(_round_payload("us-a", 5)))
+            self._wait_applied(
+                restarted._feeds["us-a"],
+                servers["us-a"]._snap.entities["nodes"].etag,
+            )
+            snap = restarted.round()
+            assert json.loads(
+                snap.entity("global/summary").raw
+            )["healthy"] is True
+            delta = {
+                k: n - before.get(k, 0)
+                for k, n in servers["us-a"].stats.requests.items()
+                if n != before.get(k, 0)
+            }
+            assert set(delta) <= {("GET", "/api/v1/watch", 200)}, delta
+        finally:
+            restarted.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_aggregator_of_aggregators_stacks_by_construction(self, tmp_path):
+        """Tier test: because the aggregator serves the same API it
+        consumes, a top engine federates MID aggregators exactly like a
+        mid federates checkers — tier discovered, entries keyed by
+        cluster block, leaf churn visible at the top within 2 intervals
+        (one mid round + one top round)."""
+        servers, endpoints = self._fleet(
+            tmp_path, [("leaf-a", 3), ("leaf-b", 2)]
+        )
+        mid_engine = FederationEngine(self._feed_args(endpoints))
+        mid_srv = FleetStateServer(0, host="127.0.0.1", federation=True,
+                                   readiness=mid_engine.readiness)
+        top_ep = tmp_path / "top.endpoints.json"
+        top_ep.write_text(json.dumps({"clusters": [
+            {"name": "mid-0", "url": f"http://127.0.0.1:{mid_srv.port}"}
+        ]}))
+        top_engine = FederationEngine(self._feed_args(top_ep))
+        try:
+            mid_engine.round(mid_srv)
+            top_snap = top_engine.round()
+            view = top_engine.views["mid-0"]
+            assert view.tier == "aggregator"
+            assert view.entries_key == "clusters"
+            summary = json.loads(top_snap.entity("global/summary").raw)
+            assert summary["total_nodes"] == 5
+            self._wait_streams(mid_engine)
+            self._wait_streams(top_engine)
+            # Leaf churn crosses both tiers as pushed frames.
+            payload = _round_payload("leaf-a", 3, healthy=False)
+            servers["leaf-a"].publish(_Round(payload, payload["exit_code"]))
+            self._wait_applied(
+                mid_engine._feeds["leaf-a"],
+                servers["leaf-a"]._snap.entities["nodes"].etag,
+                what="leaf delta",
+            )
+            mid_snap = mid_engine.round(mid_srv)   # interval 1
+            self._wait_applied(
+                top_engine._feeds["mid-0"],
+                mid_snap.entity("global/nodes").etag,
+                what="mid delta",
+            )
+            top_snap = top_engine.round()          # interval 2
+            nodes = json.loads(top_snap.entity("global/nodes").raw)
+            mid_block = next(c for c in nodes["clusters"]
+                             if c["cluster"] == "mid-0")
+            # An aggregator's entries are CLUSTER blocks, so the stacked
+            # body nests clusters-within-clusters, leaves' nodes inside.
+            leaf_a = next(c for c in mid_block["clusters"]
+                          if c["cluster"] == "leaf-a")
+            assert all(n["ready"] is False for n in leaf_a["nodes"])
+            assert json.loads(top_snap.entity("global/summary").raw)[
+                "total_nodes"] == 5
+        finally:
+            top_engine.close()
+            mid_srv.close()
+            mid_engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_feed_metric_families(self, tmp_path):
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 3)])
+        engine = FederationEngine(self._feed_args(endpoints))
+        agg = FleetStateServer(0, host="127.0.0.1", federation=True,
+                               readiness=engine.readiness)
+        try:
+            engine.round(agg)
+            self._wait_streams(engine)
+            servers["us-a"].publish(_Round(_round_payload("us-a", 3)))
+            self._wait_applied(
+                engine._feeds["us-a"],
+                servers["us-a"]._snap.entities["nodes"].etag,
+            )
+            engine.round(agg)
+            _, _, body = _req(agg.port, "GET", "/metrics")
+            text = body.decode()
+            assert ('tpu_node_checker_federation_feed_frames_total'
+                    '{cluster="us-a",kind="delta"} 1') in text
+            assert ('tpu_node_checker_federation_feed_frames_total'
+                    '{cluster="us-a",kind="resync"} 0') in text
+            assert "tpu_node_checker_federation_feed_resyncs_total" in text
+            assert ('tpu_node_checker_federation_feed_lag_seconds'
+                    '{cluster="us-a"}') in text
+        finally:
+            agg.close()
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_poll_mode_without_flag_never_touches_watch(self, tmp_path):
+        """The no-flag regression pin: ``--federate`` alone is exactly
+        yesterday's poll loop — no stream threads, no watch requests."""
+        servers, endpoints = self._fleet(tmp_path, [("us-a", 2)])
+        engine = FederationEngine(_args(endpoints))
+        try:
+            engine.round()
+            engine.round()
+            assert engine.feed_mode is False
+            assert engine._feeds == {}
+            assert not any(
+                path == "/api/v1/watch"
+                for (_m, path, _s) in servers["us-a"].stats.requests
+            )
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+
+# ---------------------------------------------------------------------------
 # CLI validation
 # ---------------------------------------------------------------------------
 
@@ -843,6 +1213,7 @@ class TestFederateCliValidation:
     @pytest.mark.parametrize("extra", [
         ["--federate-interval", "5"],
         ["--federate-workers", "2"],
+        ["--federate-feed"],
     ])
     def test_federate_knobs_require_federate(self, extra):
         with pytest.raises(SystemExit):
@@ -861,11 +1232,13 @@ class TestFederateCliValidation:
         args = cli.parse_args(
             ["--federate", "eps.json", "--serve", "8080",
              "--federate-interval", "5", "--federate-workers", "8",
-             "--serve-workers", "2", "--retry-budget", "3"]
+             "--federate-feed", "--serve-workers", "2",
+             "--retry-budget", "3"]
         )
         assert args.federate == "eps.json"
         assert args.federate_interval == 5.0
         assert args.federate_workers == 8
+        assert args.federate_feed is True
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,487 @@
+"""Push-delta watch feed: the ``GET /api/v1/watch`` frame contract.
+
+The wire under test (DESIGN.md §20):
+
+* one JSON frame per request — ``delta`` (only the CHANGED entries, as
+  the server's exact cached byte fragments), ``resync`` (every entry),
+  or ``heartbeat`` (liveness, no entries);
+* the cursor IS the collection entity's strong ETag: folding a frame
+  into a cached entry table reproduces the ``/api/v1/nodes`` body
+  byte-for-byte, verified against ``to``;
+* a stale/evicted ``since`` gets a full-resync frame, never a 404, and
+  the resync is served EXACTLY ONCE per stale reconnect — pinned
+  fixture-side through :meth:`FeedState.stats`, the same way PR 6's
+  relist-exactly-once test pinned the k8s watch fallback;
+* named side-channel blocks (summary, remediation budget, analytics
+  SLO) ride every frame, so budgets propagate at delta speed;
+* 16-client hammer: concurrent feed consumers fold live publishes with
+  zero torn frames while the poll surface keeps its 200/304 contract.
+
+Wall-clock guard: same policy as tests/test_server.py — long-poll waits
+are bounded by explicit ``timeout=`` windows, never real sleeps.
+"""
+
+import gzip
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker.server.app import FleetStateServer
+from tpu_node_checker.server.feed import FeedState
+from tpu_node_checker.server.snapshot import (
+    Entity,
+    build_fragment,
+    joined_prefix,
+)
+
+WALL_CLOCK_BUDGET_S = 20.0
+
+
+@pytest.fixture(autouse=True)
+def _wall_clock_guard():
+    t0 = time.perf_counter()
+    yield
+    elapsed = time.perf_counter() - t0
+    assert elapsed < WALL_CLOCK_BUDGET_S, (
+        f"feed test burned {elapsed:.1f}s of wall-clock — a real sleep or "
+        "a wedged long-poll leaked in"
+    )
+
+
+class _Round:
+    def __init__(self, payload, exit_code=0):
+        self.payload = payload
+        self.exit_code = exit_code
+
+
+def _payload(n=4, flip=(), drop=()):
+    nodes = [
+        {"name": f"tpu-{i:02d}", "ready": i not in flip, "accelerators": 4}
+        for i in range(n)
+        if i not in drop
+    ]
+    ready = sum(1 for nd in nodes if nd["ready"])
+    return {
+        "total_nodes": len(nodes), "ready_nodes": ready,
+        "total_chips": len(nodes) * 4, "ready_chips": ready * 4,
+        "nodes": nodes, "slices": [], "cluster": "us-a",
+        "cluster_source": "flag",
+        "exit_code": 0 if ready == len(nodes) else 3,
+    }
+
+
+def _req(port, path, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.headers.items()), resp.read()
+    finally:
+        conn.close()
+
+
+def _watch(port, since="", timeout=None, headers=None):
+    query = {"since": since} if since else {}
+    if timeout is not None:
+        query["timeout"] = f"{timeout:g}"
+    path = "/api/v1/watch"
+    if query:
+        path += "?" + urllib.parse.urlencode(query)
+    status, resp_headers, body = _req(port, path, headers=headers)
+    frame = json.loads(body) if status == 200 else None
+    return status, resp_headers, frame
+
+
+def _wait_parked(server):
+    """Block (bounded) until a watch request is parked in the feed's
+    Condition — the deterministic 'consumer is long-polling' observation
+    the wake tests need before they trigger a publish."""
+    deadline = time.perf_counter() + 5.0
+    while not server._feed._cond._waiters:
+        assert time.perf_counter() < deadline, "consumer never parked"
+        time.sleep(0.005)  # tnc: allow-test-wall-clock(bounded 5s poll observing a REAL request thread park in the feed Condition)
+
+
+def _splice(frame):
+    """Reproduce the collection body a frame's entries describe — the
+    client-side fold's final step, relying on nothing but the frame."""
+    key = frame["key"]
+    frags = [build_fragment(e) for e in frame[key]]
+    return joined_prefix(frame["head"], key) + b", ".join(frags) + b"]}\n"
+
+
+class _Fold:
+    """A minimal feed consumer: cursor + entry table, digest-verified."""
+
+    def __init__(self):
+        self.cursor = ""
+        self.table = {}
+        self.head = None
+        self.key = "nodes"
+        self.blocks = {}
+
+    def apply(self, frame):
+        kind = frame["kind"]
+        assert kind in ("delta", "resync", "heartbeat"), kind
+        self.blocks = frame["blocks"]
+        if kind == "heartbeat":
+            assert frame["to"] == frame["from"]
+            return
+        if kind == "resync":
+            self.table = {}
+        elif (frame.get("from") or "") != self.cursor:
+            self.cursor = ""  # dropped frame: resync on the next request
+            return
+        self.key = frame["key"]
+        name_key = frame["name_key"]
+        for name in frame["removed"]:
+            self.table.pop(name, None)
+        for entry in frame[self.key]:
+            self.table[entry[name_key]] = entry
+        self.head = frame["head"]
+        body = (
+            joined_prefix(self.head, self.key)
+            + b", ".join(build_fragment(e) for e in self.table.values())
+            + b"]}\n"
+        )
+        assert Entity(body).etag == frame["to"], "folded body digest mismatch"
+        self.cursor = frame["to"]
+        self.body = body
+
+
+# ---------------------------------------------------------------------------
+# FeedState units (ring fold, blocks, lifecycle)
+# ---------------------------------------------------------------------------
+
+
+class TestFeedStateUnits:
+    def _publish(self, fs, etag, changed, removed=(), names=None):
+        frags = {n: f'{{"name": "{n}"}}'.encode() for n in (names or changed)}
+        fs.publish(etag, 1, 1.0, {"count": len(frags)}, "nodes",
+                   frags, None, changed, removed)
+
+    def test_ring_eviction_resyncs_not_unbounded_delta(self):
+        fs = FeedState(ring_size=3)
+        self._publish(fs, '"e0"', None, names=["a"])
+        for i in range(1, 6):
+            self._publish(fs, f'"e{i}"', ["a"])
+        # "e2" is still ringed (last 3 transitions: e2→e3→e4→e5) …
+        frame = json.loads(fs.frame('"e2"', 0).raw)
+        assert frame["kind"] == "delta" and frame["to"] == '"e5"'
+        # … while "e0" fell off the ring: full resync, reason recorded.
+        frame = json.loads(fs.frame('"e0"', 0).raw)
+        assert frame["kind"] == "resync"
+        assert frame["reason"] == "stale-cursor"
+        assert fs.stats()[1] == {"stale-cursor": 1}
+
+    def test_fold_cancels_changed_against_removed(self):
+        fs = FeedState()
+        self._publish(fs, '"e0"', None, names=["a", "b"])
+        self._publish(fs, '"e1"', ["b"], names=["a", "b"])   # b changes…
+        self._publish(fs, '"e2"', [], removed=["b"], names=["a"])  # …then goes
+        frame = json.loads(fs.frame('"e0"', 0).raw)
+        assert frame["kind"] == "delta"
+        assert [e["name"] for e in frame["nodes"]] == []
+        assert frame["removed"] == ["b"]
+
+    def test_undiffable_publish_clears_feed_then_recovers(self):
+        fs = FeedState()
+        self._publish(fs, '"e0"', None, names=["a"])
+        fs.clear()
+        assert fs.frame("", 0) is None  # the handler's 503 path
+        self._publish(fs, '"e1"', None, names=["a"])
+        assert json.loads(fs.frame('"e0"', 0).raw)["kind"] == "resync"
+
+    def test_blocks_merge_copy_on_write(self):
+        fs = FeedState()
+        frags = {"a": b'{"name": "a"}'}
+        fs.publish('"e0"', 1, 1.0, {}, "nodes", frags, None, None, (),
+                   blocks={"summary": {"healthy": True}})
+        fs.update_blocks("remediation", {"budget": 3})
+        held = json.loads(fs.frame("", 0).raw)["blocks"]
+        # A later round publish carrying only the summary must not drop
+        # the previously published remediation block.
+        fs.publish('"e1"', 2, 2.0, {}, "nodes", frags, None, ["a"], (),
+                   blocks={"summary": {"healthy": False}})
+        merged = json.loads(fs.frame("", 0).raw)["blocks"]
+        assert merged == {"summary": {"healthy": False},
+                          "remediation": {"budget": 3}}
+        assert held["summary"] == {"healthy": True}  # copy-on-write held
+
+
+# ---------------------------------------------------------------------------
+# The HTTP frame contract
+# ---------------------------------------------------------------------------
+
+
+class TestWatchFrames:
+    @pytest.fixture
+    def server(self):
+        srv = FleetStateServer(0, host="127.0.0.1")
+        yield srv
+        srv.close()
+
+    def test_first_request_resyncs_byte_identical(self, server):
+        server.publish(_Round(_payload()))
+        _, headers, nodes_body = _req(server.port, "/api/v1/nodes")
+        status, _, frame = _watch(server.port)
+        assert status == 200
+        assert frame["kind"] == "resync" and frame["reason"] == "requested"
+        assert frame["from"] is None
+        assert frame["to"] == headers["ETag"]
+        assert frame["name_key"] == "name"
+        # The frame's entries splice back into the EXACT collection body —
+        # the byte-identity the cursor (the entity's own ETag) certifies.
+        assert _splice(frame) == nodes_body
+        assert frame["blocks"]["summary"]["total_nodes"] == 4
+
+    def test_delta_carries_only_changed_entries(self, server):
+        server.publish(_Round(_payload()))
+        fold = _Fold()
+        fold.apply(_watch(server.port)[2])
+        payload = _payload(flip={1})
+        server.publish(_Round(payload, payload["exit_code"]))
+        status, _, frame = _watch(server.port, since=fold.cursor)
+        assert status == 200
+        assert frame["kind"] == "delta" and frame["from"] == fold.cursor
+        assert [e["name"] for e in frame["nodes"]] == ["tpu-01"]
+        assert frame["removed"] == []
+        fold.apply(frame)  # digest-verifies the folded body against `to`
+        assert fold.body == _req(server.port, "/api/v1/nodes")[2]
+
+    def test_removed_node_is_named_not_reencoded(self, server):
+        server.publish(_Round(_payload()))
+        fold = _Fold()
+        fold.apply(_watch(server.port)[2])
+        server.publish(_Round(_payload(drop={3})))
+        _, _, frame = _watch(server.port, since=fold.cursor)
+        assert frame["kind"] == "delta"
+        assert frame["removed"] == ["tpu-03"]
+        assert [e["name"] for e in frame["nodes"]] == []
+        fold.apply(frame)
+        assert fold.body == _req(server.port, "/api/v1/nodes")[2]
+
+    def test_stale_cursor_resyncs_exactly_once_never_404(self, server):
+        """Satellite 2: the resync-exactly-once contract.  A consumer
+        reconnecting with an evicted/unknown cursor pays ONE full-resync
+        frame — never a 404 — and rides deltas from there on."""
+        server.publish(_Round(_payload()))
+        status, _, frame = _watch(server.port, since='"cursor-from-a-past-life"')
+        assert status == 200, "a stale cursor must never 404"
+        assert frame["kind"] == "resync" and frame["reason"] == "stale-cursor"
+        fold = _Fold()
+        fold.apply(frame)
+        # Fixture-side pin (the FeedState.stats seam): exactly one resync.
+        assert server._feed.stats()[1] == {"stale-cursor": 1}
+        server.publish(_Round(_payload(flip={0})))
+        _, _, frame = _watch(server.port, since=fold.cursor)
+        assert frame["kind"] == "delta"  # resumed on deltas, no second resync
+        assert server._feed.stats()[1] == {"stale-cursor": 1}
+
+    def test_heartbeat_on_quiet_window(self, server):
+        server.publish(_Round(_payload()))
+        cursor = _watch(server.port)[2]["to"]
+        status, _, frame = _watch(server.port, since=cursor, timeout=0.05)
+        assert status == 200
+        assert frame["kind"] == "heartbeat"
+        assert frame["from"] == cursor and frame["to"] == cursor
+        assert frame["nodes"] == []
+        assert frame["blocks"]["summary"]["total_nodes"] == 4
+
+    def test_long_poll_wakes_on_publish(self, server):
+        server.publish(_Round(_payload()))
+        cursor = _watch(server.port)[2]["to"]
+        got = {}
+        parked = threading.Event()
+
+        def consumer():
+            parked.set()
+            got["frame"] = _watch(server.port, since=cursor, timeout=10)[2]
+
+        t = threading.Thread(target=consumer, name="tnc-test-feed-consumer",
+                             daemon=True)
+        t.start()
+        parked.wait(timeout=10)
+        _wait_parked(server)
+        server.publish(_Round(_payload(flip={2})))
+        t.join(timeout=10)
+        assert not t.is_alive(), "long-poll never woke on publish"
+        assert got["frame"]["kind"] == "delta"
+        assert [e["name"] for e in got["frame"]["nodes"]] == ["tpu-02"]
+
+    def test_budget_and_slo_blocks_ride_at_delta_speed(self, server):
+        """The remediation lease budget (PR 11) and analytics SLO doc
+        (PR 15) propagate between publishes as named blocks — a parked
+        consumer wakes on the block update alone (from == to, no
+        entries)."""
+        server.publish(_Round(_payload()))
+        cursor = _watch(server.port)[2]["to"]
+        got = {}
+        parked = threading.Event()
+
+        def consumer():
+            parked.set()
+            got["frame"] = _watch(server.port, since=cursor, timeout=10)[2]
+
+        t = threading.Thread(target=consumer, name="tnc-test-feed-blocks",
+                             daemon=True)
+        t.start()
+        parked.wait(timeout=10)
+        _wait_parked(server)
+        server.publish_remediation({"budget": {"max_per_round": 2}})
+        t.join(timeout=10)
+        assert not t.is_alive(), "block update never woke the consumer"
+        frame = got["frame"]
+        # A blocks-only wake: from == to (the collection never moved), no
+        # entries, just the named block — lease arithmetic at frame speed.
+        assert frame["kind"] == "delta"
+        assert frame["from"] == cursor and frame["to"] == cursor
+        assert frame["nodes"] == []
+        assert frame["blocks"]["remediation"] == {
+            "budget": {"max_per_round": 2}
+        }
+        # Blocks ride EVERY frame: a late-arriving consumer sees the SLO
+        # doc (and the withdrawn budget) on its next heartbeat, no park
+        # choreography needed.
+        server.publish_analytics({"slo": {"ready_p50": 0.99}})
+        _, _, frame = _watch(server.port, since=cursor, timeout=0.05)
+        assert frame["blocks"]["analytics_slo"] == {"ready_p50": 0.99}
+        server.publish_remediation(None)
+        _, _, frame = _watch(server.port, since=cursor, timeout=0.05)
+        assert "remediation" not in frame["blocks"]
+
+    def test_gzip_negotiated_frame_decompresses_identical(self, server):
+        server.publish(_Round(_payload(n=64)))
+        status, headers, raw = _req(server.port, "/api/v1/watch")
+        status, gz_headers, gz_body = _req(
+            server.port, "/api/v1/watch",
+            headers={"Accept-Encoding": "gzip"},
+        )
+        assert gz_headers.get("Content-Encoding") == "gzip"
+        assert gzip.decompress(gz_body) == raw
+
+    def test_watch_before_first_round_is_503(self, server):
+        status, _, body = _req(server.port, "/api/v1/watch")
+        assert status == 503
+        assert json.loads(body)["ready"] is False
+
+    def test_bad_timeout_is_400(self, server):
+        server.publish(_Round(_payload()))
+        status, _, body = _req(server.port, "/api/v1/watch?timeout=soon")
+        assert status == 400
+        assert b"timeout" in body
+
+    def test_feed_disabled_is_404(self):
+        srv = FleetStateServer(0, host="127.0.0.1", feed=False)
+        try:
+            srv.publish(_Round(_payload()))
+            status, _, body = _req(srv.port, "/api/v1/watch")
+            assert status == 404  # no feed → no route; never a hung poll
+        finally:
+            srv.close()
+
+    def test_server_close_releases_parked_consumers(self, server):
+        server.publish(_Round(_payload()))
+        cursor = _watch(server.port)[2]["to"]
+        results = []
+        parked = threading.Event()
+
+        def consumer():
+            parked.set()
+            try:
+                results.append(_watch(server.port, since=cursor, timeout=25))
+            except (OSError, http.client.HTTPException):
+                results.append(("torn", None, None))
+
+        t = threading.Thread(target=consumer, name="tnc-test-feed-close",
+                             daemon=True)
+        t.start()
+        parked.wait(timeout=10)
+        _wait_parked(server)
+        server.close()
+        t.join(timeout=10)
+        assert not t.is_alive(), "close left a consumer parked"
+
+
+# ---------------------------------------------------------------------------
+# Feed lifecycle under the 16-client hammer (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestFeedUnderHammer:
+    def test_concurrent_consumers_fold_live_publishes_untorn(self):
+        """16 poll clients + 4 feed consumers against 30 live publishes:
+        every frame parses, every fold digest-verifies against ``to`` (no
+        torn reads), and the poll surface keeps its 200/304 bijection."""
+        srv = FleetStateServer(0, host="127.0.0.1")
+        srv.publish(_Round(_payload(n=32)))
+        stop = threading.Event()
+        folds = [_Fold() for _ in range(4)]
+        consumer_errors = []
+        frames_seen = [0] * len(folds)
+
+        def consume(slot):
+            fold = folds[slot]
+            try:
+                while not stop.is_set():
+                    status, _, frame = _watch(
+                        srv.port, since=fold.cursor, timeout=0.2
+                    )
+                    assert status == 200, status
+                    fold.apply(frame)  # parses + digest-verifies every frame
+                    frames_seen[slot] += 1
+            except Exception as exc:  # noqa: BLE001 — surfaced as a failure below
+                consumer_errors.append(f"consumer {slot}: {exc!r}")
+
+        consumers = [
+            threading.Thread(target=consume, args=(i,),
+                             name=f"tnc-test-feed-hammer-{i}", daemon=True)
+            for i in range(len(folds))
+        ]
+        for t in consumers:
+            t.start()
+
+        # Seeded churn plan (sim load generation): ~3 nodes flip per
+        # round, replayable by seed if a torn frame ever surfaces.
+        churn_plan = fx.churn_flips(seed=16, nodes=32, rounds=30,
+                                    fraction=0.1)
+
+        def swaps():
+            for flips in churn_plan:
+                srv.publish(_Round(_payload(n=32, flip=flips)))
+
+        try:
+            flat = fx.hammer_fleet_api(
+                srv.port, ["/api/v1/nodes", "/api/v1/summary"], swaps,
+                clients=16,
+            )
+            stop.set()
+            for t in consumers:
+                t.join(timeout=10)
+                assert not t.is_alive(), "feed consumer wedged"
+            assert not consumer_errors, consumer_errors
+            fx.assert_poll_contract(flat)
+            final_body = _req(srv.port, "/api/v1/nodes")[2]
+            final_etag = Entity(final_body).etag
+            for slot, fold in enumerate(folds):
+                assert frames_seen[slot] > 0, f"consumer {slot} starved"
+                # Drain to head: at most one resync (if a frame was
+                # dropped mid-churn), then byte-identity with the final
+                # polled body.
+                while fold.cursor != final_etag:
+                    status, _, frame = _watch(
+                        srv.port, since=fold.cursor, timeout=0.05
+                    )
+                    fold.apply(frame)
+                    if frame["kind"] == "heartbeat":
+                        break
+                assert fold.cursor == final_etag
+                assert fold.body == final_body
+        finally:
+            stop.set()
+            srv.close()

@@ -217,6 +217,15 @@ def build_parser() -> argparse.ArgumentParser:
                           "4); assignments are stable under cluster churn, "
                           "so each worker's keep-alive connections stay "
                           "warm")
+    federate.add_argument("--federate-feed", action="store_true",
+                          help="with --federate: stream mode — consume each "
+                          "upstream's GET /api/v1/watch push-delta feed "
+                          "instead of re-polling unchanged state (a steady "
+                          "round costs zero upstream requests, churn costs "
+                          "one delta frame of only the changed entries); an "
+                          "upstream without the feed (older build) silently "
+                          "degrades to conditional-GET polling, and a dead "
+                          "stream degrades only its shard")
 
     probe = p.add_argument_group("Chip probe (data-plane liveness)")
     probe.add_argument("--probe", action="store_true",
@@ -608,6 +617,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         for flag, val in (
             ("--federate-interval", args.federate_interval),
             ("--federate-workers", args.federate_workers),
+            ("--federate-feed", args.federate_feed or None),
         ):
             if val is not None:
                 p.error(f"{flag} requires --federate")

@@ -40,14 +40,20 @@ from tpu_node_checker.federation.merge import (
     ClusterView,
     GlobalSnapshot,
     build_global_snapshot,
-    extract_node_entries,
+    extract_entries,
 )
+from tpu_node_checker.server.snapshot import (build_fragment, entity_tag,
+                                              joined_prefix)
 
 DEFAULT_INTERVAL_S = 10.0
 DEFAULT_WORKERS = 4
 # Bound on any single upstream request (dial + head + body); retries on
 # top ride the per-round policy budget.
 FETCH_TIMEOUT_S = 10.0
+# Stream mode (--federate-feed): the long-poll window a feed consumer
+# asks its upstream for — capped below the server's 30 s ceiling so the
+# socket timeout (FETCH_TIMEOUT_S on top) stays the tighter bound.
+FEED_WAIT_CAP_S = 25.0
 # Per-cluster fetch breaker (the WatchBreaker cadence, one tier up): after
 # BREAKER_THRESHOLD consecutive failures, attempts widen to every 2nd,
 # 4th, then every BREAKER_MAX_EVERY'th round.  A black-holed upstream
@@ -82,6 +88,269 @@ def _fetch_entity(session, view: ClusterView, base_headers: dict,
         raise FetchError(f"{path}: HTTP {resp.status_code}")
     view.fetch_fresh += 1
     return resp, resp.headers.get("etag")
+
+
+class _FeedClient:
+    """Stream-mode fetcher for ONE upstream: a long-poll consumer of its
+    ``GET /api/v1/watch`` feed, consumed exactly like ``watchstream.py``
+    consumes k8s events — deltas are folded into a cached fragment table,
+    and today's conditional GET is the relist (the engine keeps polling
+    until the client has verified state, and falls back to polling the
+    moment the stream dies).
+
+    The worker thread owns the HTTP loop; the engine's fetcher thread
+    reads verified state through :meth:`apply_to` each round.  Everything
+    shared crosses ``self._lock``.  Every applied frame is verified by
+    reconstructing the full collection body from the fragment table and
+    checking its sha256 against the frame's ``to`` cursor — a mismatch
+    clears the cursor so the next poll resyncs (self-healing, no torn
+    state can ever reach the merge).
+    """
+
+    def __init__(self, view: ClusterView, token: Optional[str],
+                 poll_timeout: float):
+        self.name = view.name
+        self.url = view.url
+        self._headers = {"Authorization": f"Bearer {token}"} if token else {}
+        self._poll_timeout = poll_timeout
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._exit_reason: Optional[str] = None
+        self._cursor = ""
+        self._key = view.entries_key
+        self._fragments: Optional[Dict[str, bytes]] = None
+        self._head: Optional[dict] = None
+        self._blocks: dict = {}
+        # Latest VERIFIED state: (etag, head, key, entries_run, count,
+        # round, reported_cluster) — swapped whole, read by apply_to().
+        self._state: Optional[tuple] = None
+        self._frames = {"delta": 0, "resync": 0, "heartbeat": 0}
+        self._resyncs: Dict[str, int] = {}
+        self._last_frame_wall: Optional[float] = None
+        self._seed_from_view(view)
+        from tpu_node_checker.cluster import _StdlibSession
+
+        self._session = _StdlibSession()
+        self.thread = threading.Thread(
+            target=self._run, name=f"tnc-feed-{view.name}", daemon=True
+        )
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def stop(self) -> None:
+        # No join: a parked long-poll drains within its window; the thread
+        # is a daemon and touches only its own state after this.
+        self._stop.set()
+
+    def exit_reason(self) -> Optional[str]:
+        with self._lock:
+            return self._exit_reason
+
+    def stats(self) -> tuple:
+        """→ (frames-by-kind, resyncs-by-reason, last-frame-walltime)."""
+        with self._lock:
+            return dict(self._frames), dict(self._resyncs), \
+                self._last_frame_wall
+
+    # -- seeding ---------------------------------------------------------------
+
+    def _seed_from_view(self, view: ClusterView) -> None:
+        """Resume from the last applied state: if the view already holds a
+        verified entries run (a restart after polling, or a predecessor
+        client's work), rebuild the fragment table from it and open the
+        stream AT that cursor — the upstream answers a delta, not a full
+        resync.  Any doubt → empty cursor → one resync frame."""
+        import json
+
+        head = view.nodes_head
+        if not view.nodes_etag or view.nodes_entries is None \
+                or not isinstance(head, dict):
+            return
+        try:
+            entries = json.loads(b"[" + view.nodes_entries + b"]")
+        except ValueError:
+            return
+        name_key = "cluster" if view.entries_key == "clusters" else "name"
+        table: Dict[str, bytes] = {}
+        for entry in entries:
+            nm = entry.get(name_key) if isinstance(entry, dict) else None
+            if not isinstance(nm, str) or nm in table:
+                return
+            table[nm] = build_fragment(entry)
+        prefix = joined_prefix(head, view.entries_key)
+        body = prefix + b", ".join(table.values()) + b"]}\n"
+        digest = entity_tag(body)
+        if digest != view.nodes_etag:
+            # Poll-side bytes don't round-trip (foreign producer): start
+            # from scratch rather than fold deltas onto a wrong base.
+            return
+        with self._lock:
+            self._cursor = view.nodes_etag
+            self._key = view.entries_key
+            self._fragments = table
+            self._head = head
+            self._blocks = dict(view.feed_blocks or {})
+            # The view's poll-fetched state just digest-verified against
+            # the cursor: install it as this client's first verified
+            # state, so the engine stops polling immediately and the
+            # stream opens PARKED at the cursor (a restart resumes from
+            # the last applied delta — no resync frame, no re-fetch).
+            self._state = (
+                view.nodes_etag, head, view.entries_key,
+                view.nodes_entries, view.nodes_count, view.nodes_round,
+                view.reported_cluster,
+            )
+
+    # -- the stream loop -------------------------------------------------------
+
+    def _run(self) -> None:
+        import urllib.parse
+
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    cursor = self._cursor
+                query = urllib.parse.urlencode(
+                    {"since": cursor, "timeout": f"{self._poll_timeout:g}"}
+                )
+                resp = self._session.get(
+                    f"{self.url}/api/v1/watch?{query}",
+                    headers=dict(self._headers),
+                    # The read must outlive a full long-poll window.
+                    timeout=FETCH_TIMEOUT_S + self._poll_timeout,
+                )
+                if resp.status_code == 404:
+                    # Feed-less upstream (older build, feed disabled):
+                    # permanent fallback to conditional-GET polling.
+                    self._exit("unsupported")
+                    return
+                if resp.status_code != 200:
+                    self._exit(f"HTTP {resp.status_code}")
+                    return
+                frame = resp.json()
+                if not isinstance(frame, dict) or "kind" not in frame:
+                    raise FetchError("watch: response is not a feed frame")
+                self._apply(frame)
+        except Exception as exc:  # tnc: allow-broad-except(any stream failure — socket loss, long-poll timeout, torn frame — is the ONE feed-degraded outcome; the engine falls back to conditional-GET polling and restarts the stream)
+            self._exit(f"{type(exc).__name__}: {exc}")
+        finally:
+            self._session.close()
+
+    def _exit(self, reason: str) -> None:
+        with self._lock:
+            if self._exit_reason is None:
+                self._exit_reason = reason
+
+    def _apply(self, frame: dict) -> None:
+        kind = frame.get("kind")
+        if kind not in ("delta", "resync", "heartbeat"):
+            raise FetchError(f"watch: unknown frame kind {kind!r}")
+        to = frame.get("to")
+        blocks = frame.get("blocks")
+        # Counters bump only once a frame is fully APPLIED (state
+        # installed) — they are the "this much is visible" signal the
+        # metrics and tests read, not a receipt log.
+        with self._lock:
+            self._last_frame_wall = time.time()
+            reason = frame.get("reason")
+            if kind == "resync" and isinstance(reason, str):
+                self._resyncs[reason] = self._resyncs.get(reason, 0) + 1
+            if isinstance(blocks, dict):
+                self._blocks = blocks
+        if kind == "heartbeat":
+            with self._lock:
+                self._frames["heartbeat"] += 1
+            return
+        key = frame.get("key") or self._key
+        name_key = frame.get("name_key") or (
+            "cluster" if key == "clusters" else "name"
+        )
+        head = frame.get("head")
+        if not isinstance(head, dict) or not isinstance(to, str):
+            raise FetchError("watch: frame lacks head/to")
+        if kind == "resync":
+            table = {}
+        else:
+            with self._lock:
+                base = self._fragments
+                cursor = self._cursor
+            if base is None or (frame.get("from") or "") != cursor:
+                # A delta we have no base for (should not happen — the
+                # server resyncs unknown cursors): drop the cursor and let
+                # the next poll resync rather than fold onto a wrong base.
+                with self._lock:
+                    self._cursor = ""
+                return
+            table = dict(base)
+            for nm in frame.get("removed") or ():
+                table.pop(nm, None)
+        for entry in frame.get(key) or ():
+            nm = entry.get(name_key) if isinstance(entry, dict) else None
+            if not isinstance(nm, str):
+                raise FetchError(f"watch: entry lacks a {name_key!r} name")
+            # Replace-in-place keeps body order for known names; brand-new
+            # names append — if the upstream ordered them elsewhere, the
+            # digest check below catches it and forces a resync.
+            table[nm] = build_fragment(entry)
+        prefix = joined_prefix(head, key)
+        body = prefix + b", ".join(table.values()) + b"]}\n"
+        digest = entity_tag(body)
+        if digest != to:
+            with self._lock:
+                self._cursor = ""
+                self._resyncs["digest-mismatch"] = (
+                    self._resyncs.get("digest-mismatch", 0) + 1
+                )
+            return
+        count = head.get("count")
+        reported = head.get("cluster")
+        state = (
+            to, head, key, body[len(prefix):-3],
+            count if isinstance(count, int) else 0,
+            head.get("round"),
+            reported if isinstance(reported, str) else None,
+        )
+        with self._lock:
+            self._cursor = to
+            self._key = key
+            self._fragments = table
+            self._head = head
+            self._state = state
+            self._frames[kind] += 1
+
+    # -- the engine-side drain -------------------------------------------------
+
+    def apply_to(self, view: ClusterView) -> bool:
+        """Install the latest verified stream state into the view (False =
+        nothing verified yet: the engine polls this round too).  The bytes
+        installed are EXACTLY what a conditional GET would have fetched —
+        digest-checked against the collection ETag — so the merge cannot
+        tell stream mode from poll mode."""
+        with self._lock:
+            state = self._state
+            blocks = self._blocks
+        if state is None:
+            return False
+        etag, head, key, entries, count, rnd, reported = state
+        view.nodes_entries = entries
+        view.nodes_etag = etag
+        view.nodes_fp = etag
+        view.nodes_head = head
+        view.entries_key = key
+        if key == "clusters":
+            # The feed outed this upstream as an aggregator — the poll
+            # fallback must use its /global surface too.
+            view.tier = "aggregator"
+        view.nodes_count = count
+        view.nodes_round = rnd
+        view.reported_cluster = reported
+        summary = blocks.get("summary")
+        if isinstance(summary, dict):
+            view.summary_doc = summary
+        view.feed_blocks = blocks or None
+        view.record_success()
+        return True
 
 
 class FederationEngine:
@@ -133,6 +402,16 @@ class FederationEngine:
         # (ok, reason, detail) swapped whole per round — the /readyz seam.
         self._ready: Optional[tuple] = None
         self.last_round_ms = 0.0
+        # Stream mode (--federate-feed): one _FeedClient per upstream that
+        # serves /api/v1/watch.  The dict is touched only by the fetcher
+        # thread that OWNS the cluster's shard (and by close/_apply_
+        # endpoints between rounds) — same ownership rule as the views.
+        self.feed_mode = bool(getattr(args, "federate_feed", False))
+        self._feeds: Dict[str, _FeedClient] = {}
+        # Upstreams whose watch endpoint answered 404 (feed-less builds):
+        # silently degraded to conditional-GET polling, re-probed only
+        # when the endpoint moves.
+        self._feed_unsupported: set = set()
         # Startup is fail-fast: a malformed endpoints file is a config
         # error the operator must see now, not a silently empty fleet.
         from tpu_node_checker.history.store import file_signature
@@ -151,10 +430,20 @@ class FederationEngine:
                 # New cluster — or a moved URL, whose cached ETags/bytes
                 # describe the OLD endpoint and must not validate the new.
                 view = ClusterView(ep.name, ep.url)
+                # Any stream consumer follows the OLD socket: drop it and
+                # re-probe feed support at the new address.
+                old = self._feeds.pop(ep.name, None)
+                if old is not None:
+                    old.stop()
+                self._feed_unsupported.discard(ep.name)
             fresh[ep.name] = view
             self._tokens[ep.name] = ep.token
         for name in set(self._tokens) - set(fresh):
             self._tokens.pop(name, None)
+            old = self._feeds.pop(name, None)
+            if old is not None:
+                old.stop()
+            self._feed_unsupported.discard(name)
         self.views = fresh
 
     def _maybe_reload(self) -> None:
@@ -213,46 +502,23 @@ class FederationEngine:
         t0 = time.monotonic()
         try:
             with tracer.span("fetch", cluster=view.name):
-                resp, etag = _fetch_entity(
-                    session, view, base_headers, "/api/v1/summary",
-                    view.summary_etag,
-                )
-                if resp is not None:
-                    doc = resp.json()
-                    if not isinstance(doc, dict):
-                        raise FetchError("/api/v1/summary: not a JSON object")
-                    view.summary_doc = doc
-                # The ETag lands only AFTER the body validated: a mangled
-                # 200 must not leave the view holding the NEW validator
-                # with the OLD data — the next round's 304 would launder
-                # stale state as fresh indefinitely.
-                view.summary_etag = etag
-                resp, etag = _fetch_entity(
-                    session, view, base_headers, "/api/v1/nodes",
-                    view.nodes_etag,
-                )
-                if resp is not None:
-                    entries, head = extract_node_entries(resp.content)
-                    view.nodes_entries = entries
-                    # Merge-cache identity for these bytes.  An upstream
-                    # behind a validator-stripping proxy sends no ETag —
-                    # every round is a fresh 200, and without a content key
-                    # the merge would keep serving its first-cached block
-                    # forever.
-                    view.nodes_fp = etag or (
-                        "sha256:" + hashlib.sha256(entries).hexdigest()
-                    )
-                    count = head.get("count")
-                    view.nodes_count = count if isinstance(count, int) else 0
-                    view.nodes_round = head.get("round")
-                    reported = head.get("cluster")
-                    view.reported_cluster = (
-                        reported if isinstance(reported, str) else None
-                    )
-                    self._stitch_upstream_trace(
-                        session, view, base_headers, resp
-                    )
-                view.nodes_etag = etag
+                try:
+                    self._fetch_view(session, view, base_headers)
+                except FetchError as exc:
+                    if view.tier is None and str(exc).startswith(
+                            "/api/v1/summary: HTTP 404"):
+                        # Tier discovery: an upstream without the per-
+                        # cluster surface but reachable is itself an
+                        # aggregator — retry one tier up, at its /global
+                        # endpoints.  The pin survives on success only.
+                        view.tier = "aggregator"
+                        try:
+                            self._fetch_view(session, view, base_headers)
+                        except Exception:
+                            view.tier = None
+                            raise
+                    else:
+                        raise
         except Exception as exc:  # tnc: allow-broad-except(any fetch failure — refused dial, timeout, bad body, HTTP error — is the ONE shard-degraded outcome; the shard is labeled stale and the fleet keeps serving)
             view.record_failure(f"{type(exc).__name__}: {exc}")
             view.fetch_errors += 1
@@ -273,6 +539,60 @@ class FederationEngine:
             self._obs.federation_fetch.record(
                 (time.monotonic() - t0) * 1e3, view.name
             )
+
+    def _fetch_view(self, session, view: ClusterView,
+                    base_headers: dict) -> None:
+        """The two conditional GETs against this upstream's tier surface:
+        the per-cluster paths for a checker, ``/api/v1/global/*`` when the
+        upstream has been discovered to be an aggregator itself."""
+        base = ("/api/v1/global" if view.tier == "aggregator"
+                else "/api/v1")
+        resp, etag = _fetch_entity(
+            session, view, base_headers, base + "/summary",
+            view.summary_etag,
+        )
+        if resp is not None:
+            doc = resp.json()
+            if not isinstance(doc, dict):
+                raise FetchError(base + "/summary: not a JSON object")
+            view.summary_doc = doc
+        # The ETag lands only AFTER the body validated: a mangled
+        # 200 must not leave the view holding the NEW validator
+        # with the OLD data — the next round's 304 would launder
+        # stale state as fresh indefinitely.
+        view.summary_etag = etag
+        resp, etag = _fetch_entity(
+            session, view, base_headers, base + "/nodes",
+            view.nodes_etag,
+        )
+        if resp is not None:
+            entries, head, key = extract_entries(resp.content)
+            view.nodes_entries = entries
+            # What the entries ARE ("nodes" from a checker, "clusters"
+            # from an aggregator) — the block head splices it back in.
+            view.entries_key = key
+            view.nodes_head = head
+            if key == "clusters":
+                view.tier = "aggregator"
+            # Merge-cache identity for these bytes.  An upstream
+            # behind a validator-stripping proxy sends no ETag —
+            # every round is a fresh 200, and without a content key
+            # the merge would keep serving its first-cached block
+            # forever.
+            view.nodes_fp = etag or (
+                "sha256:" + hashlib.sha256(entries).hexdigest()
+            )
+            count = head.get("count")
+            view.nodes_count = count if isinstance(count, int) else 0
+            view.nodes_round = head.get("round")
+            reported = head.get("cluster")
+            view.reported_cluster = (
+                reported if isinstance(reported, str) else None
+            )
+            self._stitch_upstream_trace(
+                session, view, base_headers, resp
+            )
+        view.nodes_etag = etag
 
     def _stitch_upstream_trace(self, session, view: ClusterView,
                                base_headers: dict, resp) -> None:
@@ -307,6 +627,10 @@ class FederationEngine:
             view = self.views.get(name)
             if view is None:
                 continue
+            if self.feed_mode and self._feed_tick(view):
+                # A live stream with verified state fed this cluster: no
+                # dial at all this round — O(changed nodes), not O(nodes).
+                continue
             if view.backoff_skip > 0:
                 # Breaker open: no dial this round.  Staleness still
                 # advances — the skipped shard stays honestly labeled.
@@ -314,6 +638,48 @@ class FederationEngine:
                 view.rounds_behind += 1
                 continue
             self._fetch_cluster(session, view, tracer)
+            if (self.feed_mode
+                    and view.consecutive_failures == 0
+                    and name not in self._feeds
+                    and name not in self._feed_unsupported):
+                # The upstream polls fine: (re)open its stream.  Until the
+                # stream verifies its first frame, polling continues — the
+                # relist IS today's conditional GET.
+                self._feed_start(view)
+
+    def _feed_tick(self, view: ClusterView) -> bool:
+        """Stream-mode step for one cluster; True = this round's state came
+        off the feed and the poll is skipped.  A dead stream is consumed
+        exactly once (404 → permanent silent poll fallback; anything else →
+        poll now, reopen the stream once polling succeeds) — the per-
+        cluster fetch breaker and staleness labels stay untouched."""
+        client = self._feeds.get(view.name)
+        if client is None:
+            return False
+        if client.thread.is_alive():
+            # Alive but not yet verified → poll this round too (warm-up).
+            return client.apply_to(view)
+        self._feeds.pop(view.name, None)
+        reason = client.exit_reason()
+        client.stop()
+        if reason == "unsupported":
+            self._feed_unsupported.add(view.name)
+        else:
+            self._events.emit(
+                "feed-lost",
+                cluster=view.name,
+                error=reason or "stream ended",
+                detail="falling back to conditional-GET polling",
+            )
+        return False
+
+    def _feed_start(self, view: ClusterView) -> None:
+        poll_timeout = min(max(self.interval, 1.0), FEED_WAIT_CAP_S)
+        client = _FeedClient(
+            view, self._tokens.get(view.name), poll_timeout
+        )
+        self._feeds[view.name] = client
+        client.start()
 
     # -- the round -------------------------------------------------------------
 
@@ -524,6 +890,56 @@ class FederationEngine:
                     _line("tpu_node_checker_federation_fetch_total", float(n),
                           {"cluster": v.name, "result": result})
                 )
+        if self.feed_mode:
+            # Stream-mode telemetry: per-client counters reset when a
+            # stream reopens — that's a normal Prometheus counter reset,
+            # rate() absorbs it.
+            now = time.time()
+            lines += [
+                "# HELP tpu_node_checker_federation_feed_frames_total Watch-"
+                "feed frames applied per upstream, by kind (delta / resync "
+                "/ heartbeat).",
+                "# TYPE tpu_node_checker_federation_feed_frames_total "
+                "counter",
+            ]
+            stats = {
+                name: client.stats()
+                for name, client in sorted(self._feeds.items())
+            }
+            for name, (frames, _, _) in stats.items():
+                for kind in ("delta", "heartbeat", "resync"):
+                    lines.append(_line(
+                        "tpu_node_checker_federation_feed_frames_total",
+                        float(frames.get(kind, 0)),
+                        {"cluster": name, "kind": kind},
+                    ))
+            lines += [
+                "# HELP tpu_node_checker_federation_feed_resyncs_total Full-"
+                "resync frames per upstream, by reason (requested = cold "
+                "start, stale-cursor = evicted from the upstream's ring, "
+                "digest-mismatch = client-side reconstruction failed).",
+                "# TYPE tpu_node_checker_federation_feed_resyncs_total "
+                "counter",
+            ]
+            for name, (_, resyncs, _) in stats.items():
+                for reason, n in sorted(resyncs.items()):
+                    lines.append(_line(
+                        "tpu_node_checker_federation_feed_resyncs_total",
+                        float(n), {"cluster": name, "reason": reason},
+                    ))
+            lines += [
+                "# HELP tpu_node_checker_federation_feed_lag_seconds Seconds "
+                "since the last frame arrived on the upstream's stream "
+                "(heartbeats bound this at the long-poll window).",
+                "# TYPE tpu_node_checker_federation_feed_lag_seconds gauge",
+            ]
+            for name, (_, _, last_wall) in stats.items():
+                if last_wall is not None:
+                    lines.append(_line(
+                        "tpu_node_checker_federation_feed_lag_seconds",
+                        round(max(0.0, now - last_wall), 3),
+                        {"cluster": name},
+                    ))
         with_data = [v for v in views if v.has_data]
         lines += [
             "# HELP tpu_node_checker_federation_nodes Nodes in the merged "
@@ -575,6 +991,9 @@ class FederationEngine:
         return "\n".join(lines) + "\n"
 
     def close(self) -> None:
+        for client in self._feeds.values():
+            client.stop()
+        self._feeds = {}
         for session in self._sessions.values():
             session.close()
         self._sessions = {}

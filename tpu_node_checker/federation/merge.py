@@ -43,24 +43,52 @@ from tpu_node_checker.server.snapshot import (
 )
 
 _NODES_MARKER = b'"nodes": ['
+_CLUSTERS_MARKER = b'"clusters": ['
 
 
-def extract_node_entries(body: bytes) -> Tuple[bytes, dict]:
-    """One upstream ``/api/v1/nodes`` body → ``(entries bytes, head dict)``.
+def extract_entries(body: bytes) -> Tuple[bytes, dict, str]:
+    """One upstream collection body → ``(entries bytes, head dict, key)``.
 
     The head (round/ts/count/cluster) is parsed from the bytes BEFORE the
     marker — never the entries themselves, so a 5k-node body costs a find
-    and a tiny ``json.loads``, not a 5k-entry parse.  Raises ``ValueError``
-    when the body does not carry the fleet API's joined-collection shape.
+    and a tiny ``json.loads``, not a 5k-entry parse.  The EARLIEST of the
+    two collection markers decides the key: a checker's body opens
+    ``"nodes": [``, an aggregator's ``/api/v1/global/nodes`` body opens
+    ``"clusters": [`` (any nested ``"nodes": [`` lives inside the entries
+    and comes later) — which is what lets an aggregator consume another
+    aggregator the same way it consumes a checker.  Raises ``ValueError``
+    when the body carries neither joined-collection shape.
     """
-    i = body.find(_NODES_MARKER)
-    if i == -1:
+    candidates = [
+        (i, marker, key)
+        for i, marker, key in (
+            (body.find(_NODES_MARKER), _NODES_MARKER, "nodes"),
+            (body.find(_CLUSTERS_MARKER), _CLUSTERS_MARKER, "clusters"),
+        )
+        if i != -1
+    ]
+    if not candidates:
         raise ValueError("no \"nodes\" array in body")
-    head = json.loads(body[:i] + _NODES_MARKER + b"]}")
+    i, marker, key = min(candidates)
+    head = json.loads(body[:i] + marker + b"]}")
+    # The parse above closes the collection as an empty array; drop it so
+    # the head is exactly the dict ``joined_prefix(head, key)`` re-splices
+    # the body from (the byte-exact reconstruction contract).
+    head.pop(key, None)
     tail = body.rstrip()
     if not tail.endswith(b"]}"):
-        raise ValueError("body does not close a joined nodes collection")
-    entries = tail[i + len(_NODES_MARKER):-2]
+        raise ValueError("body does not close a joined collection")
+    entries = tail[i + len(marker):-2]
+    return entries, head, key
+
+
+def extract_node_entries(body: bytes) -> Tuple[bytes, dict]:
+    """Checker-tier shape of :func:`extract_entries` (the original API:
+    callers that only ever see ``"nodes": [`` bodies keep their contract,
+    error message included)."""
+    entries, head, key = extract_entries(body)
+    if key != "nodes":
+        raise ValueError("no \"nodes\" array in body")
     return entries, head
 
 
@@ -80,7 +108,7 @@ class ClusterView:
         "name", "url",
         "summary_doc", "summary_etag",
         "nodes_entries", "nodes_etag", "nodes_fp", "nodes_count",
-        "nodes_round",
+        "nodes_round", "nodes_head", "entries_key", "tier", "feed_blocks",
         "reported_cluster",
         "upstream_trace", "upstream_trace_events",
         "consecutive_failures", "rounds_behind", "last_success_wall",
@@ -96,6 +124,22 @@ class ClusterView:
         self.summary_etag: Optional[str] = None
         self.nodes_entries: Optional[bytes] = None
         self.nodes_etag: Optional[str] = None
+        # The upstream collection head these entries were spliced out of —
+        # what a restarted feed client needs to reconstruct the exact body
+        # (and so resume its stream AT the cached cursor).
+        self.nodes_head: Optional[dict] = None
+        # What the entries ARE: "nodes" (a checker upstream) or "clusters"
+        # (an aggregator upstream — tier stacking).  Pinned by the first
+        # successful fetch; the block head splices the same key back in.
+        self.entries_key = "nodes"
+        # None until discovered; "aggregator" routes fetches to the
+        # /api/v1/global/* surface one tier down.
+        self.tier: Optional[str] = None
+        # Named side-channel blocks the watch feed delivered with this
+        # cluster's state (summary / remediation budget / analytics SLO) —
+        # surfaced through /api/v1/global/clusters detail, never spliced
+        # into the merged nodes body (poll and feed bytes must agree).
+        self.feed_blocks: Optional[dict] = None
         # Cache identity of nodes_entries: the upstream ETag, or a content
         # hash when the upstream sends none (a validator-stripping proxy
         # must not freeze the merged bytes at their first-fetched content).
@@ -170,7 +214,8 @@ class ClusterView:
         content hash covers only the entries bytes — an ETag-less
         upstream whose round advances over identical entries must not
         serve a frozen ``"round"`` in its block head."""
-        key = (self.nodes_fp or self.nodes_etag, self.nodes_round, self.stale)
+        key = (self.nodes_fp or self.nodes_etag, self.nodes_round,
+               self.stale, self.entries_key)
         if self._block_key != key or self._block is None:
             head = {
                 "cluster": self.name,
@@ -180,7 +225,7 @@ class ClusterView:
             if self.stale:
                 head["stale"] = True
             self._block = (
-                joined_prefix(head, "nodes")
+                joined_prefix(head, self.entries_key)
                 + (self.nodes_entries or b"") + b"]}"
             )
             self._gz_lead = None
@@ -212,7 +257,8 @@ class GlobalSnapshot:
     """
 
     __slots__ = ("seq", "ts", "trace_id", "entities", "cluster_entities",
-                 "nodes_sig")
+                 "nodes_sig", "cluster_blocks", "nodes_head", "block_gz",
+                 "summary_doc")
 
     def __init__(self, seq: int, ts: float):
         self.seq = seq
@@ -223,6 +269,14 @@ class GlobalSnapshot:
         self.entities: Dict[str, Entity] = {}
         self.cluster_entities: Dict[str, Entity] = {}
         self.nodes_sig: tuple = ()
+        # The watch feed's raw material (this aggregator SERVES the same
+        # feed it consumes): per-cluster block bytes in body order, the
+        # head the body's prefix was spliced from, and the cached mid-run
+        # gzip members — all references into the views' byte caches.
+        self.cluster_blocks: Dict[str, bytes] = {}
+        self.nodes_head: Optional[dict] = None
+        self.block_gz: Dict[str, bytes] = {}
+        self.summary_doc: Optional[dict] = None
 
     # -- the read path (lock-free by construction) ----------------------------
 
@@ -332,6 +386,7 @@ def build_global_snapshot(
     snap = GlobalSnapshot(seq, ts)
     snap.trace_id = trace_id
     summary = build_global_summary(views, seq, ts, trace_id=trace_id)
+    snap.summary_doc = summary
     snap.entities["global/summary"] = json_entity(summary)
 
     now_wall = time.time()
@@ -353,7 +408,13 @@ def build_global_snapshot(
     if prev is not None and snap.nodes_sig == prev.nodes_sig:
         # Nothing observable moved: the previous entity (bytes, gz AND
         # ETag) serves on — every poller's cached ETag keeps 304-ing.
+        # The feed carriers come along unchanged too: the head must keep
+        # describing the bytes the reused ETag names, and the block
+        # references are the views' caches (identical by the sig).
         snap.entities["global/nodes"] = prev.entities["global/nodes"]
+        snap.cluster_blocks = prev.cluster_blocks
+        snap.nodes_head = prev.nodes_head
+        snap.block_gz = prev.block_gz
         return snap
 
     head = {
@@ -376,4 +437,12 @@ def build_global_snapshot(
         joined += gzip.compress(tail, _GZIP_LEVEL, mtime=0)
         gz = bytes(joined)
     snap.entities["global/nodes"] = Entity(body, gz=gz)
+    snap.nodes_head = head
+    snap.cluster_blocks = {v.name: v.block() for v in with_nodes}
+    # Watch-feed gzip reuse: the MID-run member (", " + block) is what a
+    # delta frame can splice by reference; views that only ever deflated
+    # as the lead member simply fall back at frame-build time.
+    snap.block_gz = {
+        v.name: v._gz_mid for v in with_nodes if v._gz_mid is not None
+    }
     return snap

@@ -99,6 +99,18 @@ Exported metric families:
   the bench's steady-round assertions;
 * ``tpu_node_checker_federation_fetch_duration_ms{cluster}`` — histogram
   of per-cluster upstream fetch cost in the ``--federate`` aggregator;
+* ``tpu_node_checker_federation_feed_frames_total{cluster,kind}`` —
+  watch-feed frames applied per upstream in ``--federate-feed`` mode, by
+  kind (``delta`` / ``heartbeat`` / ``resync``): a healthy steady state
+  is deltas and heartbeats with resyncs flat at their seed value;
+* ``tpu_node_checker_federation_feed_resyncs_total{cluster,reason}`` —
+  full-state resync frames by cause (``requested`` = cold start,
+  ``stale-cursor`` = the upstream's ring evicted our cursor — a climbing
+  rate means the consumer cannot keep up with upstream churn);
+* ``tpu_node_checker_federation_feed_lag_seconds{cluster}`` — seconds
+  since each stream last applied a frame (the feed-side counterpart of
+  ``watch_stream_age_seconds``: lag past a few long-poll windows means
+  the stream is wedged and the engine is riding last-known state);
 * ``tpu_node_checker_api_server_request_duration_ms{route}`` — histogram
   of routed-path fleet-API request latency (replaces the
   ``tpu_node_checker_api_server_request_latency_ms`` pseudo-summary,

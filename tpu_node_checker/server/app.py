@@ -60,6 +60,11 @@ from tpu_node_checker.obs.hist import (
     HistogramFamily,
 )
 from tpu_node_checker.server.auth import check_write_auth
+from tpu_node_checker.server.feed import (
+    DEFAULT_WAIT_S as _WATCH_DEFAULT_WAIT_S,
+    MAX_WAIT_S as _WATCH_MAX_WAIT_S,
+    FeedState,
+)
 from tpu_node_checker.server.ratelimit import retry_after_header
 from tpu_node_checker.server.router import (
     Request,
@@ -264,6 +269,7 @@ class FleetStateServer:
         readiness: Optional[Callable] = None,
         obs=None,
         lease: Optional[Callable] = None,
+        feed: bool = True,
     ):
         self._snap: Optional[FleetSnapshot] = None
         # The observability layer (obs.Observability): owns the debug ring
@@ -315,6 +321,12 @@ class FleetStateServer:
         # every request — the pre-snapshot cost model, measured against the
         # cached path by bench.py's serve case.  Never used in production.
         self._pre_serialized = pre_serialized
+        # The watch feed (DESIGN §20): push-delta frames over the same
+        # validator the conditional GETs use.  ``feed=False`` simulates a
+        # feed-less upstream — the route is not registered at all, so a
+        # stream-mode aggregator sees the same 404 an older build answers
+        # and silently degrades that cluster to conditional-GET polling.
+        self._feed = FeedState() if feed else None
         # The worker pool's fast table: request-line bytes → prebuilt wire
         # responses, swapped atomically per publish (empty = every request
         # rides the routed path — standalone store mode keeps it empty so
@@ -329,6 +341,8 @@ class FleetStateServer:
         router.add("GET", "/api/v1/nodes", self._get_collection("nodes"))
         router.add("GET", "/api/v1/slices", self._get_collection("slices"))
         router.add("GET", "/api/v1/nodes/{name}", self._get_node)
+        if feed:
+            router.add("GET", "/api/v1/watch", self._get_watch)
         router.add("GET", "/api/v1/trend", self._get_trend)
         router.add("GET", "/api/v1/remediation", self._get_remediation)
         for key in ("slo", "offenders", "flaps"):
@@ -393,6 +407,8 @@ class FleetStateServer:
         self._pool.restart(index)
 
     def close(self) -> None:
+        if self._feed is not None:
+            self._feed.close()
         self._pool.close()
 
     # -- publication (the check loop's side) ---------------------------------
@@ -457,6 +473,12 @@ class FleetStateServer:
             if self._pre_serialized and self._refresh is None
             else {}
         )
+        # The feed transition is derived BEFORE the swap (nothing feeds
+        # off the published reference post-swap): woken watch consumers
+        # serve from the feed's own captured references either way.
+        if self._feed is not None:
+            self._publish_feed(prev if prev is not None and
+                               prev.source == "round" else None, snap)
         # Swap order: metrics and the fast table first, snapshot last — the
         # snapshot's seq is what readiness and the hammer test key on, and
         # each reference is internally consistent on its own.
@@ -465,6 +487,38 @@ class FleetStateServer:
         self.fast_routes = fast
         self._snap = snap
         return snap
+
+    def _publish_feed(self, prev, snap) -> None:
+        """One round publish → one watch-feed transition: diff the two
+        rounds' per-node fragment tables (identity first — delta builds
+        carry unchanged fragments by reference — then bytes, so poll-mode
+        full builds still diff correctly)."""
+        entity = snap.entities.get("nodes")
+        doc = snap.docs.get("nodes")
+        frags = snap.node_fragments
+        if entity is None or doc is None or len(frags) != len(doc.get("nodes") or ()):
+            # Unnamed/duplicate entries: fragment state cannot reproduce
+            # the body — withdraw the feed; consumers fall back to polls.
+            self._feed.clear()
+            return
+        head = {k: v for k, v in doc.items() if k != "nodes"}
+        changed = None
+        removed: Tuple[str, ...] = ()
+        if prev is not None:
+            pf = prev.node_fragments
+            pdoc = prev.docs.get("nodes") or {}
+            if len(pf) == len(pdoc.get("nodes") or ()):
+                changed = []
+                for name, frag in frags.items():
+                    old = pf.get(name)
+                    if old is not frag and old != frag:
+                        changed.append(name)
+                removed = tuple(n for n in pf if n not in frags)
+        self._feed.publish(
+            entity.etag, snap.seq, snap.ts, head, "nodes",
+            frags, snap.node_gz_fragments, changed, removed,
+            blocks={"summary": snap.docs.get("summary")},
+        )
 
     def publish_global(self, gsnap, metrics_body: Optional[bytes] = None) -> None:
         """Federation mode: one merge round → the global view, atomically
@@ -493,10 +547,42 @@ class FleetStateServer:
             if self._pre_serialized
             else {}
         )
+        if self._feed is not None:
+            self._publish_feed_global(gsnap)
         # Same swap order discipline as publish(): metrics and the fast
         # table first, the snapshot (what readiness keys on) last.
         self.fast_routes = fast
         self._global = gsnap
+
+    def _publish_feed_global(self, gsnap) -> None:
+        """Federation mode's feed transition: the entries are per-cluster
+        BLOCKS (the merge tier's cached byte splices), so an
+        aggregator-of-aggregators consumes this feed exactly like an
+        aggregator consumes a checker's — federation stacks by
+        construction."""
+        entity = gsnap.entities.get("global/nodes")
+        blocks_map = getattr(gsnap, "cluster_blocks", None)
+        head = getattr(gsnap, "nodes_head", None)
+        if entity is None or not blocks_map or head is None:
+            self._feed.clear()
+            return
+        prev = self._global
+        changed = None
+        removed: Tuple[str, ...] = ()
+        prev_blocks = getattr(prev, "cluster_blocks", None) if prev is not None else None
+        if prev_blocks:
+            changed = []
+            for name, block in blocks_map.items():
+                old = prev_blocks.get(name)
+                if old is not block and old != block:
+                    changed.append(name)
+            removed = tuple(n for n in prev_blocks if n not in blocks_map)
+        summary_doc = getattr(gsnap, "summary_doc", None)
+        self._feed.publish(
+            entity.etag, gsnap.seq, gsnap.ts, head, "clusters",
+            blocks_map, getattr(gsnap, "block_gz", None), changed, removed,
+            blocks={"summary": summary_doc} if summary_doc is not None else None,
+        )
 
     def publish_snapshot(self, snap: FleetSnapshot) -> None:
         """Standalone mode: install an externally built (store) snapshot.
@@ -514,6 +600,8 @@ class FleetStateServer:
         stays lock-free (TNC011)."""
         if doc is None:
             self._remediation = None
+            if self._feed is not None:
+                self._feed.update_blocks("remediation", None)
             return
         body = (json.dumps(doc, ensure_ascii=False) + "\n").encode("utf-8")
         from tpu_node_checker.server.snapshot import Entity
@@ -521,6 +609,10 @@ class FleetStateServer:
         self._remediation = Entity(
             body, "application/json; charset=utf-8"
         )
+        if self._feed is not None:
+            # The budget rides the feed as a named block: downstream tiers
+            # see lease arithmetic at delta speed, not at poll cadence.
+            self._feed.update_blocks("remediation", doc)
 
     def publish_analytics(self, docs: Optional[dict]) -> None:
         """Swap the analytics query documents one round computed from its
@@ -528,12 +620,18 @@ class FleetStateServer:
         here; request threads only negotiate immutable entities."""
         if docs is None:
             self._analytics = None
+            if self._feed is not None:
+                self._feed.update_blocks("analytics_slo", None)
             return
         from tpu_node_checker.server.snapshot import json_entity
 
         self._analytics = {
             key: json_entity(doc) for key, doc in sorted(docs.items())
         }
+        if self._feed is not None:
+            # The SLO roll-up rides the feed too (offenders/flaps stay
+            # poll-only: they are operator drill-downs, not tier state).
+            self._feed.update_blocks("analytics_slo", docs.get("slo"))
 
     def refresh_metrics(self, result, breaker: Optional[dict] = None) -> None:
         """A steady watch-stream tick: served content is unchanged (no
@@ -694,6 +792,37 @@ class FleetStateServer:
         return self._stamp_round(
             negotiate(entity, req.headers), snap.seq, snap.trace_id
         )
+
+    def _get_watch(self, req: Request) -> Response:
+        """``GET /api/v1/watch?since=<ETag>[&timeout=s]`` — ONE feed frame
+        per request (see :mod:`~tpu_node_checker.server.feed`).
+
+        The one deliberately blocking read path: the request thread parks
+        until the state moves past ``since`` or the window closes.  It can
+        only ride the worker pool's routed fallback (a query string never
+        matches the fast table), and the pool pre-flushes batched
+        responses before dispatching here — the fast-route responders stay
+        lock-free and unparked (DESIGN §20)."""
+        feed = self._feed
+        if feed is None:
+            return json_response(
+                404, {"error": "watch feed disabled on this server"}
+            )
+        since = req.query.get("since") or ""
+        raw_wait = req.query.get("timeout")
+        try:
+            wait = (
+                float(raw_wait) if raw_wait is not None
+                else _WATCH_DEFAULT_WAIT_S
+            )
+        except ValueError:
+            return json_response(
+                400, {"error": f"bad timeout {raw_wait!r}: must be seconds"}
+            )
+        entity = feed.frame(since, min(max(wait, 0.0), _WATCH_MAX_WAIT_S))
+        if entity is None:
+            return self._no_round()
+        return negotiate(entity, req.headers)
 
     def _get_trend(self, req: Request) -> Response:
         if self._trend is None:

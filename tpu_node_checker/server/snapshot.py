@@ -36,6 +36,15 @@ _GZIP_LEVEL = 6
 _GZIP_MIN_BYTES = 256
 
 
+def entity_tag(raw: bytes) -> str:
+    """The strong ETag for a body: quoted truncated sha256.  ONE
+    definition shared by :class:`Entity` and the feed consumers that
+    digest-verify fragment-joined bodies against a frame's ``to``
+    cursor — the watch feed's cursor IS this tag, so the formula must
+    not drift per copy."""
+    return '"' + hashlib.sha256(raw).hexdigest()[:32] + '"'
+
+
 class Entity:
     """One immutable HTTP representation: raw bytes + gzip variant + ETag.
 
@@ -61,7 +70,7 @@ class Entity:
                 else None
             )
         self.gz = gz if gz is not None and len(gz) < len(raw) else None
-        self.etag = '"' + hashlib.sha256(raw).hexdigest()[:32] + '"'
+        self.etag = entity_tag(raw)
 
 
 def json_entity(obj) -> Entity:

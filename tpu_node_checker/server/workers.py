@@ -286,6 +286,11 @@ def _serve_connection(app, conn, addr, idle_timeout: float) -> None:
             if parsed is None:
                 out += _RESP_400
                 break
+            if out and line.startswith(b"GET /api/v1/watch"):
+                # The watch feed long-polls: its handler may park this
+                # thread for seconds, and responses already batched for
+                # pipelined requests must not wait behind it.
+                _flush(app, conn, out, fast_counts)
             buf, close = _respond_routed(app, conn, parsed, buf, remote, out)
         if out:
             _flush(app, conn, out, fast_counts)

@@ -578,6 +578,21 @@ def paged_nodelist_handler(nodes: List[dict], requests_seen: Optional[list] = No
 # ---------------------------------------------------------------------------
 
 
+def churn_flips(seed: int, nodes: int, rounds: int,
+                fraction: float = 0.01) -> List[frozenset]:
+    """Seeded churn-load plan for the watch-feed / federation tiers: one
+    frozenset of node indices to flip per round (never empty — a churn
+    round must change SOMETHING, or the publish dedups to a heartbeat and
+    the load plan silently thins).  Same seed ⇒ same plan, so a hammer
+    run or bench round that tore a frame replays exactly.
+    """
+    import random
+
+    rng = random.Random(seed)
+    k = max(1, int(nodes * fraction))
+    return [frozenset(rng.sample(range(nodes), k)) for _ in range(rounds)]
+
+
 class StormSchedule:
     """Seeded mass-failure + flap storm over a multi-slice TPU fleet.
 

@@ -30,6 +30,15 @@ Nodes with no event since the last tick are NOT re-observed by the FSM —
 silence neither banks healthy rounds toward ``--uncordon-after`` nor bad
 rounds toward ``--cordon-after``.  One-shot and poll-mode rounds are
 untouched: this module is reached only behind ``--watch-stream``.
+
+The same watch-over-relist contract now exists one tier up, applied to
+our OWN wire: the fleet API's ``GET /api/v1/watch`` push-delta feed
+(``server/feed.py``) is this module's counterpart with the collection
+ETag as the resume cursor, and the ``--federate-feed`` consumer
+(``federation/aggregator.py``) plays this module's role — deltas folded
+into a cached table, the conditional GET as the relist, stream loss
+degrading only its shard (DESIGN.md §20).  The cursor/digest plumbing is
+shared through ``server/snapshot.entity_tag``, not duplicated.
 """
 
 from __future__ import annotations
