@@ -79,6 +79,12 @@ Chaos-simulator evidence (the scenario-engine tentpole, PR 12):
   cordon sweeps) against a simulated apiserver, graded by the invariant
   matrix.  Both bench runs are ASSERTED green AND byte-identical
   (the ``--seed`` replay contract) before the number is printed.
+* ``sim_federated_round_p50_ms`` — one federation round over a
+  fuzz-shaped 20×1k world (seeded-rng sick sets published through real
+  FleetStateServers, merged by the real FederationEngine) with one
+  churned shard per round — the steady-state cost of a
+  ``federated-world`` chaos round at scale.  Also runnable alone:
+  ``python bench.py --sim-federated``.
 
 Bench honesty: every latency case records ``{n, p50_ms, iqr_ms}`` under
 ``sample_stats``; cases whose IQR exceeds 25% of their p50 are listed in
@@ -549,6 +555,117 @@ def _bench_trend_100k() -> dict:
         "trend_100k_rounds_raw_p50_ms": round(trend_raw_p50, 2),
         "trend_100k_rounds_speedup": round(trend_speedup, 1),
         "trend_100k_history_lines": trend_nodes_n * trend_rounds,
+    }
+
+
+def _bench_sim_federated() -> dict:
+    """Federation-scale sim round cost (the ISSUE 17 federated tier).
+
+    A fuzz-shaped 20×1k world: per-cluster node readiness drawn from one
+    seeded rng at the fuzzer's program density (~25% of hosts sick),
+    published through REAL ``FleetStateServer``s and merged by the REAL
+    ``FederationEngine`` — the same work a ``federated-world`` chaos
+    round pays, at bench scale.  The seed round pays 20 full fetches
+    plus the 20k-node merge; each timed round re-publishes ONE rng-drawn
+    cluster's re-rolled sick set and re-merges exactly that shard (the
+    steady-state shape of a chaos round: most shards 304, one changed).
+    Also runnable alone (``python bench.py --sim-federated``).
+    """
+    import random as random_mod
+    import tempfile as tempfile_mod
+
+    from tpu_node_checker import cli as tnc_cli
+    from tpu_node_checker.federation.aggregator import FederationEngine
+    from tpu_node_checker.server.app import FleetStateServer
+
+    rng = random_mod.Random(7)
+    n_clusters, n_nodes = 20, 1000
+
+    class _SimFedRound:
+        def __init__(self, payload):
+            self.payload = payload
+            self.exit_code = payload["exit_code"]
+
+    def _world_payload(cname: str) -> dict:
+        sick = {i for i in range(n_nodes) if rng.random() < 0.25}
+        nodes = [
+            {
+                "name": f"{cname}-tpu-{i:04d}",
+                "ready": i not in sick,
+                "accelerators": 4,
+                "families": ["google.com/tpu"],
+                "nodepool": f"{cname}-pool-{i // 250}",
+            }
+            for i in range(n_nodes)
+        ]
+        ready = n_nodes - len(sick)
+        return {
+            "total_nodes": n_nodes, "ready_nodes": ready,
+            "total_chips": n_nodes * 4, "ready_chips": ready * 4,
+            "nodes": nodes, "slices": [], "cluster": cname,
+            "cluster_source": "flag",
+            "exit_code": 0 if ready == n_nodes else 3,
+        }
+
+    servers: dict = {}
+    endpoints_name = None
+    try:
+        for c in range(n_clusters):
+            cname = f"sim-fed-{c:02d}"
+            srv = FleetStateServer(0, host="127.0.0.1")
+            srv.publish(_SimFedRound(_world_payload(cname)))
+            servers[cname] = srv
+        with tempfile_mod.NamedTemporaryFile(
+            "w", suffix=".endpoints.json", delete=False
+        ) as endpoints_f:
+            json.dump(
+                {"clusters": [
+                    {"name": cname, "url": f"http://127.0.0.1:{srv.port}"}
+                    for cname, srv in servers.items()
+                ]},
+                endpoints_f,
+            )
+            endpoints_name = endpoints_f.name
+        engine = FederationEngine(tnc_cli.parse_args(
+            ["--federate", endpoints_name, "--serve", "0",
+             "--federate-workers", "4", "--retry-budget", "0"]
+        ))
+        t0 = time.perf_counter()
+        snap = engine.round()
+        seed_ms = (time.perf_counter() - t0) * 1e3
+        summary = json.loads(snap.entity("global/summary").raw)
+        assert summary["total_nodes"] == n_clusters * n_nodes, summary
+        assert summary["clusters"]["fresh"] == n_clusters, summary
+        samples = []
+        names = sorted(servers)
+        for _ in range(21):
+            churned = rng.choice(names)
+            servers[churned].publish(
+                _SimFedRound(_world_payload(churned))
+            )
+            t0 = time.perf_counter()
+            snap = engine.round()
+            samples.append((time.perf_counter() - t0) * 1e3)
+            summary = json.loads(snap.entity("global/summary").raw)
+            assert summary["total_nodes"] == n_clusters * n_nodes, summary
+    finally:
+        for srv in servers.values():
+            srv.close()
+        if endpoints_name:
+            os.unlink(endpoints_name)
+    p50 = _case_p50("sim_federated_round", samples)
+    # Generous sanity bound only: one churned 1k shard re-fetch + merge.
+    # The honest spread lives in sample_stats; a tight wall gate here
+    # would measure the box, not the code (the BENCH_r13 lesson).
+    assert p50 < 1000.0, (
+        f"fuzzed federated round p50 {p50:.1f}ms is past any box toll — "
+        "the merge path regressed"
+    )
+    return {
+        "sim_federated_round_p50_ms": round(p50, 3),
+        "sim_federated_seed_ms": round(seed_ms, 2),
+        "sim_federated_clusters": n_clusters,
+        "sim_federated_nodes": n_clusters * n_nodes,
     }
 
 
@@ -1674,6 +1791,9 @@ def main() -> int:
         [ms for run in sim_runs for ms in run.round_ms],
     )
 
+    # -- federation-scale sim world (the ISSUE 17 chaos tier) ---------------
+    simfed_case = _bench_sim_federated()
+
     # -- fleet analytics: 100k-round history, roll-ups vs raw replay --------
     trend_case = _bench_trend_100k()
     trend_rollup_p50 = trend_case["trend_100k_rounds_p50_ms"]
@@ -1750,6 +1870,10 @@ def main() -> int:
                 "nodes5k_watch_churn1pct_p50_ms": round(watch_churn_p50, 2),
                 "nodes5k_fault30_p50_ms": round(nodes5k_fault30_p50, 2),
                 "sim_flapstorm_rounds_p50_ms": round(sim_flapstorm_p50, 2),
+                "sim_federated_round_p50_ms":
+                    simfed_case["sim_federated_round_p50_ms"],
+                "sim_federated_seed_ms":
+                    simfed_case["sim_federated_seed_ms"],
                 "trend_100k_rounds_p50_ms": round(trend_rollup_p50, 3),
                 "trend_100k_rounds_raw_p50_ms": round(trend_raw_p50, 2),
                 "trend_100k_rounds_speedup": round(trend_speedup, 1),
@@ -1826,6 +1950,21 @@ def _provenance() -> dict:
 if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--serve-child":
         sys.exit(_serve_child(sys.argv[2], int(sys.argv[3])))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--sim-federated":
+        # The federation-scale sim case alone (sanity gate asserted
+        # inside): JSON on stdout with the same sample-stats/provenance
+        # honesty as a full run.
+        case = _bench_sim_federated()
+        print(json.dumps({
+            "metric": "sim_federated_round_p50_ms",
+            "value": case["sim_federated_round_p50_ms"],
+            "unit": "ms",
+            **case,
+            "sample_stats": _SAMPLE_STATS,
+            "variance_warnings": _VARIANCE_WARNINGS,
+            **_provenance(),
+        }))
+        sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--trend-100k":
         # The fleet-analytics case alone (gates asserted inside): JSON on
         # stdout with the same sample-stats/provenance honesty as a full

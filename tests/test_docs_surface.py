@@ -11,6 +11,8 @@ from __future__ import annotations
 import re
 from pathlib import Path
 
+import pytest
+
 from tpu_node_checker import cli
 
 REPO = Path(__file__).resolve().parent.parent
@@ -51,6 +53,46 @@ def test_readme_documents_no_phantom_flags():
     documented = set(re.findall(r"`(--[a-z][a-z0-9-]*)", readme))
     phantom = documented - flags
     assert not phantom, f"README documents flags that do not exist: {sorted(phantom)}"
+
+
+def _registry_scenarios() -> set:
+    from tpu_node_checker.sim.scenarios import SCENARIOS
+
+    return set(SCENARIOS)
+
+
+def _table_scenarios(text: str, start_pat: str) -> set:
+    # First-column names of the markdown table inside one section:
+    # rows like "| `flap-storm` | ..." (README) or "| flap-storm | ..."
+    # (DESIGN).  Stops at the next "## " heading.
+    section = re.split(r"\n## ", text.split(start_pat, 1)[1], 1)[0]
+    names = set()
+    for m in re.finditer(r"^\|\s*`?([a-z][a-z0-9+-]*)`?\s*\|", section,
+                         re.M):
+        names.add(m.group(1))
+    return names - {"scenario"}  # the header row
+
+
+@pytest.mark.parametrize("path, heading", [
+    ("README.md", "## Chaos simulation"),
+    ("docs/DESIGN.md", "## 18."),
+])
+def test_scenario_table_matches_registry(path, heading):
+    # Both directions (the TNC203 pattern, pointed at the scenario grid):
+    # an undocumented scenario is invisible to operators; a documented
+    # scenario that no longer registers teaches a spelling that errors.
+    registry = _registry_scenarios()
+    assert registry, "the SCENARIOS registry is empty — the scan broke"
+    documented = _table_scenarios((REPO / path).read_text(), heading)
+    missing = registry - documented
+    assert not missing, (
+        f"scenarios registered but absent from the {path} table: "
+        f"{sorted(missing)}"
+    )
+    phantom = documented - registry
+    assert not phantom, (
+        f"{path} documents scenarios that do not exist: {sorted(phantom)}"
+    )
 
 
 def test_probe_md_documents_every_emitted_key():

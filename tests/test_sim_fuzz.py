@@ -1,0 +1,154 @@
+"""The chaos fuzzer and its shrinker: determinism, minimality, bite.
+
+The fuzzer's whole value is that a red seed is a PERMANENT artifact —
+which only holds if sampling, execution, and shrinking are all pure
+functions of their inputs.  These tests pin that: same seed, same
+program; same campaign, same bytes; same sabotage, same minimal
+reproducer.  The sabotage path reuses the ``test_simulate.py`` matrix-
+bite technique (an unbudgeted fleet-wide cordon) so the shrinker is
+proven against a violation the matrix is already known to catch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tpu_node_checker import checker
+from tpu_node_checker.sim import cli as sim_cli
+from tpu_node_checker.sim import fuzz
+from tpu_node_checker.sim.engine import ScenarioError
+
+REPRO_DIR = os.path.join(os.path.dirname(__file__), "sim_reproducers")
+
+
+def _sabotage_program() -> dict:
+    """A small sabotaged world with shrinkable decoys: one failure
+    program, one API fault, and a fleet/round surplus — everything except
+    the sabotage itself must shrink away."""
+    return {
+        "slices": 2,
+        "hosts_per_slice": 4,
+        "rounds": 3,
+        "programs": {"sim-c0-s1-h0": ["fail-at", 2]},
+        "api_faults": {"2": ["429:0"]},
+        "watch_loss": [],
+        "sabotage": {"round": 1},
+    }
+
+
+class TestSampling:
+    def test_same_seed_same_program(self):
+        assert fuzz.sample_program(7) == fuzz.sample_program(7)
+
+    def test_programs_vary_across_seeds(self):
+        drawn = [json.dumps(fuzz.sample_program(s), sort_keys=True)
+                 for s in range(8)]
+        assert len(set(drawn)) > 1, "eight seeds drew one program"
+
+    def test_grammar_only(self):
+        kinds = {"flap", "flap-until", "fail-at", "kubelet-down-at"}
+        for s in range(12):
+            p = fuzz.sample_program(s)
+            for prog in p["programs"].values():
+                assert prog[0] in kinds
+            for fault in p["api_faults"].values():
+                assert fault == "blackout" or isinstance(fault, list)
+
+
+class TestValidation:
+    def test_unknown_node_rejected(self):
+        prog = {"slices": 1, "rounds": 2,
+                "programs": {"nope-s9-h9": ["fail-at", 1]}}
+        with pytest.raises(ScenarioError, match="unknown node"):
+            fuzz.run_program(prog)
+
+    def test_unknown_program_kind_rejected(self):
+        prog = {"slices": 1, "rounds": 2,
+                "programs": {"sim-c0-s0-h0": ["explode", 1]}}
+        with pytest.raises(ScenarioError, match="unknown failure program"):
+            fuzz.run_program(prog)
+
+    def test_bad_arity_rejected(self):
+        prog = {"slices": 1, "rounds": 2,
+                "programs": {"sim-c0-s0-h0": ["flap", 1]}}
+        with pytest.raises(ScenarioError, match="expected 3 elements"):
+            fuzz.run_program(prog)
+
+    def test_bad_fault_rejected(self):
+        prog = {"slices": 1, "rounds": 2, "programs": {},
+                "api_faults": {"1": 7}}
+        with pytest.raises(ScenarioError, match="api_faults"):
+            fuzz.run_program(prog)
+
+
+class TestCampaign:
+    def test_campaign_byte_identical(self):
+        a = fuzz.run_fuzz(0, 2)
+        b = fuzz.run_fuzz(0, 2)
+        assert fuzz.fuzz_report_json(a) == fuzz.fuzz_report_json(b)
+        assert a["ok"], f"sampled seeds went red: {a['runs']}"
+        assert [r["seed"] for r in a["runs"]] == [0, 1]
+        assert a["reproducer"] is None
+
+
+class TestShrinker:
+    def test_sabotage_shrinks_deterministically_to_minimal(self):
+        program = _sabotage_program()
+        bad = fuzz.violated(fuzz.run_program(program))
+        assert "disruption-budget" in bad, "the matrix must catch sabotage"
+        shrunk, steps = fuzz.shrink(program, "disruption-budget")
+        again, steps_again = fuzz.shrink(program, "disruption-budget")
+        assert (shrunk, steps) == (again, steps_again), \
+            "shrinking is not replayable"
+        # 1-minimal: every decoy gone, the fleet halved to one slice, the
+        # rounds trimmed to just enough to reach the sabotage.
+        assert shrunk["programs"] == {}
+        assert shrunk["api_faults"] == {}
+        assert shrunk["slices"] == 1
+        assert shrunk["rounds"] == program["sabotage"]["round"] + 1
+        assert shrunk["sabotage"] == program["sabotage"]
+        assert any(s.startswith("delete-program") for s in steps)
+        assert any(s.startswith("halve-fleet") for s in steps)
+        assert any(s.startswith("shorten-rounds") for s in steps)
+        # The minimal reproducer still replays red — the permanence the
+        # sim_reproducers/ harness relies on.
+        assert "disruption-budget" in fuzz.violated(fuzz.run_program(shrunk))
+
+
+class TestFuzzCli:
+    def test_replay_red_reproducer_exits_3(self, capsys):
+        path = os.path.join(REPRO_DIR, "over-budget-sabotage.json")
+        rc = sim_cli.main(["--replay", path])
+        out = capsys.readouterr().out
+        assert rc == checker.EXIT_NONE_READY
+        assert "disruption-budget" in out
+
+    def test_replay_accepts_bare_program(self, tmp_path, capsys):
+        bare = {"slices": 1, "rounds": 2, "programs": {}}
+        path = tmp_path / "bare.json"
+        path.write_text(json.dumps(bare))
+        rc = sim_cli.main(["--replay", str(path), "--report", "json"])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == checker.EXIT_OK
+        assert report["ok"] is True
+        assert report["scenario"] == "fuzz"
+
+    def test_replay_missing_file_exits_1(self, capsys):
+        rc = sim_cli.main(["--replay", "/nonexistent/nope.json"])
+        assert rc == checker.EXIT_ERROR
+        assert "Error:" in capsys.readouterr().err
+
+    @pytest.mark.parametrize("argv", [
+        ["--fuzz", "--scenario", "flap-storm"],
+        ["--replay", "x.json", "--fuzz"],
+        ["--replay", "x.json", "--scenario", "flap-storm"],
+        ["--fuzz", "--seeds", "0"],
+    ])
+    def test_usage_errors(self, argv, capsys):
+        with pytest.raises(SystemExit) as exc:
+            sim_cli.main(argv)
+        assert exc.value.code == 2
+        capsys.readouterr()

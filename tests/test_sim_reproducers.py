@@ -1,0 +1,67 @@
+"""The ``tests/sim_reproducers/`` replay harness.
+
+Any JSON reproducer dropped into the directory is auto-collected here as
+a tier-1 test: ``expect: green`` files must grade fully green,
+``expect: red`` files must still violate their named invariant (they
+encode deliberate contract breaches the matrix must keep catching), and
+``expect: pinned`` files are known engine bugs — xfail while red, loud
+failure once fixed so the file gets promoted.  Replay is byte-identical,
+so none of this can flake.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from tpu_node_checker.sim import fuzz
+
+REPRO_DIR = os.path.join(os.path.dirname(__file__), "sim_reproducers")
+FILES = sorted(glob.glob(os.path.join(REPRO_DIR, "*.json")))
+
+
+def test_directory_is_seeded():
+    assert FILES, "tests/sim_reproducers/ must hold at least one reproducer"
+
+
+@pytest.mark.parametrize(
+    "path", FILES, ids=[os.path.splitext(os.path.basename(p))[0]
+                        for p in FILES]
+)
+def test_reproducer(path):
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc.get("kind") == fuzz.REPRODUCER_KIND, (
+        f"{path}: not a reproducer (kind={doc.get('kind')!r})"
+    )
+    assert doc.get("expect") in ("green", "red", "pinned"), (
+        f"{path}: expect must be green, red or pinned"
+    )
+    result = fuzz.run_program(doc["program"], seed=int(doc.get("seed", 0)))
+    bad = fuzz.violated(result)
+    if doc["expect"] == "green":
+        assert not bad, (
+            f"{os.path.basename(path)} regressed: violated {bad} "
+            f"(ref: {doc.get('ref')})"
+        )
+        return
+    name = doc.get("invariant")
+    assert name, f"{path}: red/pinned reproducers must name their invariant"
+    if doc["expect"] == "red":
+        assert name in bad, (
+            f"{os.path.basename(path)}: the deliberate violation no longer "
+            f"trips {name!r} (violated: {bad}) — the matrix stopped biting"
+        )
+        return
+    # expect == "pinned": a real bug awaiting its fixing PR.
+    if name in bad:
+        pytest.xfail(f"pinned red: {name} still violated "
+                     f"(fix tracked at {doc.get('ref')})")
+    pytest.fail(
+        f"{os.path.basename(path)} now replays GREEN — the pinned "
+        f"violation {name!r} is fixed; promote the file to expect=green "
+        f"or delete it (ref: {doc.get('ref')})"
+    )

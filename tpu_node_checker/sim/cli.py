@@ -49,6 +49,17 @@ def build_parser() -> argparse.ArgumentParser:
                    "byte-replayable CI artifact)")
     p.add_argument("--list-scenarios", action="store_true",
                    help="list the scenario grid and exit")
+    p.add_argument("--fuzz", action="store_true",
+                   help="fuzz mode: sample --seeds failure programs from "
+                   "the chaos grammar (--seed is the base seed), grade "
+                   "each against the invariant matrix, and shrink the "
+                   "first violation to a minimal reproducer")
+    p.add_argument("--seeds", type=int, default=10, metavar="N",
+                   help="fuzz campaign size; run i samples from seed "
+                   "--seed + i (default 10)")
+    p.add_argument("--replay", metavar="FILE",
+                   help="replay a JSON reproducer (emitted by --fuzz, "
+                   "checked into tests/sim_reproducers/) and re-grade it")
     return p
 
 
@@ -89,6 +100,32 @@ def _render_human(result) -> str:
     return "\n".join(lines)
 
 
+def _render_fuzz(report: dict) -> str:
+    lines = [f"fuzz base-seed={report['base_seed']} seeds={report['seeds']}"]
+    for r in report["runs"]:
+        mark = "ok " if r["ok"] else "RED"
+        line = (f"  [{mark}] seed={r['seed']} slices={r['slices']} "
+                f"rounds={r['rounds']} programs={r['programs']} "
+                f"api-faults={r['api_faults']} watch-loss={r['watch_loss']}")
+        if not r["ok"]:
+            line += f" violated={','.join(r['violated'])}"
+        lines.append(line)
+    if report["reproducer"]:
+        rep = report["reproducer"]
+        prog = rep["program"]
+        lines.append(
+            f"shrunk reproducer: invariant={rep['invariant']} "
+            f"seed={rep['seed']} slices={prog['slices']} "
+            f"rounds={prog['rounds']} programs={len(prog['programs'])}"
+        )
+        for step in report["shrink_steps"] or []:
+            lines.append(f"  shrink: {step}")
+    green = sum(1 for r in report["runs"] if r["ok"])
+    lines.append(f"{'OK' if report['ok'] else 'VIOLATED'} — "
+                 f"{green}/{len(report['runs'])} seeds green")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = build_parser()
     args = p.parse_args(argv)
@@ -97,9 +134,54 @@ def main(argv: Optional[List[str]] = None) -> int:
             p.error("--list-scenarios runs alone")
         print(_list_scenarios())
         return checker.EXIT_OK
+    from tpu_node_checker.sim.engine import ScenarioError, run_scenario
+
+    if args.replay:
+        if args.scenario or args.fuzz:
+            p.error("--replay runs alone (no --scenario, no --fuzz)")
+        import json
+
+        from tpu_node_checker.sim import fuzz as fuzz_mod
+
+        try:
+            with open(args.replay, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            program = doc.get("program", doc) if isinstance(doc, dict) else doc
+            result = fuzz_mod.run_program(
+                program,
+                seed=int(doc.get("seed", 0)) if isinstance(doc, dict) else 0,
+            )
+        except ScenarioError as exc:
+            p.error(str(exc))
+        except Exception as exc:  # tnc: allow-broad-except(the CLI's documented exit-1 contract: a bad reproducer file reports its error instead of a traceback impersonating a verdict)
+            print(f"Error: {exc}", file=sys.stderr)
+            return checker.EXIT_ERROR
+        if args.report == "json":
+            sys.stdout.write(result.report_json)
+        else:
+            print(_render_human(result))
+        return checker.EXIT_OK if result.ok else checker.EXIT_NONE_READY
+    if args.fuzz:
+        if args.scenario:
+            p.error("--fuzz and --scenario are mutually exclusive")
+        if args.seeds < 1:
+            p.error("--seeds must be >= 1")
+        from tpu_node_checker.sim import fuzz as fuzz_mod
+
+        try:
+            report = fuzz_mod.run_fuzz(args.seed, args.seeds)
+        except ScenarioError as exc:
+            p.error(str(exc))
+        except Exception as exc:  # tnc: allow-broad-except(same exit-1 contract as scenario runs)
+            print(f"Error: {exc}", file=sys.stderr)
+            return checker.EXIT_ERROR
+        if args.report == "json":
+            sys.stdout.write(fuzz_mod.fuzz_report_json(report))
+        else:
+            print(_render_fuzz(report))
+        return checker.EXIT_OK if report["ok"] else checker.EXIT_NONE_READY
     if not args.scenario:
         p.error("--scenario NAME is required (see --list-scenarios)")
-    from tpu_node_checker.sim.engine import ScenarioError, run_scenario
 
     try:
         result = run_scenario(

@@ -296,6 +296,25 @@ def _reset_checker_state() -> None:
     checker._ANALYTICS_CACHE["bundle"] = None
 
 
+def run_world(name: str, seed: int, params: Dict[str, int],
+              runner: Callable[["SimWorld"], None],
+              sabotage: Optional[str] = None) -> ScenarioResult:
+    """Run ANY runner through the full world machinery: scratch dir,
+    seeded world, checker-state isolation, cleanup, deterministic report.
+    :func:`run_scenario` is the named-registry wrapper; the fuzzer drives
+    sampled failure programs through this directly."""
+    with tempfile.TemporaryDirectory(prefix="tnc-sim-") as tmpdir:
+        world = SimWorld(name, seed, params, tmpdir)
+        world.sabotage = sabotage
+        _reset_checker_state()
+        try:
+            runner(world)
+        finally:
+            world.cleanup()
+            _reset_checker_state()
+        return world.result()
+
+
 def run_scenario(name: str, seed: int, clusters: Optional[int] = None,
                  nodes_per_cluster: Optional[int] = None,
                  rounds: Optional[int] = None,
@@ -316,16 +335,7 @@ def run_scenario(name: str, seed: int, clusters: Optional[int] = None,
             f"{', '.join(sorted(SCENARIOS))})"
         )
     params = scenario.resolve(clusters, nodes_per_cluster, rounds)
-    with tempfile.TemporaryDirectory(prefix="tnc-sim-") as tmpdir:
-        world = SimWorld(name, seed, params, tmpdir)
-        world.sabotage = sabotage
-        _reset_checker_state()
-        try:
-            scenario.runner(world)
-        finally:
-            world.cleanup()
-            _reset_checker_state()
-        return world.result()
+    return run_world(name, seed, params, scenario.runner, sabotage=sabotage)
 
 
 @dataclass(frozen=True)

@@ -329,6 +329,23 @@ def check_lease_bound(total_patches: int, fleet_budget: int) -> Verdict:
                      f"budget {fleet_budget}")
 
 
+def check_feed_parity(timeline: Sequence[dict]) -> Verdict:
+    """Stream ≡ poll, graded per round: a federated view folded from
+    push-delta frames must be byte-identical to one rebuilt by conditional
+    GETs — same per-cluster entry bytes, same upstream validators, same
+    staleness labels.  The scenario synchronizes the feed cursor before
+    comparing, so a mismatch is a wire/fold defect, not a race."""
+    name = "feed-parity"
+    for s in timeline:
+        diverged = sorted(c for c, ok in s["clusters"].items() if not ok)
+        if diverged:
+            return _fail(name, f"round {s['round']}: stream view diverged "
+                               f"from poll view for {diverged}")
+    cluster_rounds = sum(len(s["clusters"]) for s in timeline)
+    return _ok(name, f"{cluster_rounds} cluster-rounds byte-identical "
+                     "between the stream and poll federations")
+
+
 def check_retry_absorption(records: Sequence[dict], round_i: int,
                            min_retries: int) -> Verdict:
     """A brownout burst is absorbed invisibly: the faulted round still

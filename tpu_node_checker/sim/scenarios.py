@@ -19,6 +19,13 @@ under test share no code path.
 | watch-loss-relist   | stream losses + in-band 410, relist economy      |
 | partitioned-region  | one cluster vanishes; federation staleness       |
 | aggregator-death    | lease aggregator killed mid-storm                |
+| federated-world     | K×M world through the REAL federation, poll and  |
+|                     | feed engines in lockstep, lease + analytics      |
+| flap-storm+api-brownout | composed: brownout stacked on a flap storm   |
+
+Composed scenarios (``sim/compose.py``) are built by the ``compose()``
+combinator from registered program/fault layers and join ``SCENARIOS``
+as first-class entries at the bottom of this module.
 """
 
 from __future__ import annotations
@@ -384,7 +391,8 @@ def _run_torn_slice(world: SimWorld) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _tick_round(world: SimWorld, engine, round_i: int) -> dict:
+def _tick_round(world: SimWorld, engine, round_i: int,
+                cluster: str = "sim-c0") -> dict:
     """One REAL watch-stream tick, recorded like a poll round."""
     tracer = Tracer()
     result, _delta = engine.tick(tracer=tracer)
@@ -392,7 +400,7 @@ def _tick_round(world: SimWorld, engine, round_i: int) -> dict:
     phases = tracer.as_dict()
     record = {
         "round": round_i,
-        "cluster": "sim-c0",
+        "cluster": cluster,
         "exit_code": result.exit_code,
         "error": None,
         "payload_exit_code": result.payload.get("exit_code"),
@@ -655,6 +663,222 @@ def _run_aggregator_death(world: SimWorld) -> None:
     world.grade(inv.check_trace_completeness(world.records))
 
 
+# ---------------------------------------------------------------------------
+# federated-world: K clusters × M nodes through the REAL federation —
+# poll and feed engines in lockstep, the lease path, analytics folding
+# ---------------------------------------------------------------------------
+
+
+def _run_federated_world(world: SimWorld) -> None:
+    from tpu_node_checker import cli
+    from tpu_node_checker.federation.aggregator import FederationEngine
+    from tpu_node_checker.remediation.budget import FleetLeaseBudget
+    from tpu_node_checker.server.app import FleetStateServer
+
+    p = world.params
+    rounds = p["rounds"]
+    death_round = rounds - 2
+    fleet_budget = 2
+    names = [f"sim-c{i}" for i in range(p["clusters"])]
+    analytics_cluster, lease_cluster, dead = names[0], names[1], names[-1]
+    worlds: Dict[str, dict] = {}
+    decayers: List[str] = []
+    failers: List[str] = []
+    for name in names:
+        cluster = synth_cluster(name, p["nodes_per_cluster"])
+        if name == analytics_cluster:
+            # Decay prodrome: flap until round 3, then failed forever —
+            # the CUSUM detector must flag the flapping (round 3) before
+            # --cordon-after 2 condemns FAILED (round 4).
+            decayers = cluster.assign(
+                world.rng, lambda i: ("flap-until", 2, 3, 3), per_slice=1
+            )
+        elif name == lease_cluster:
+            # Hard failures from round 1 drive cordon requests through
+            # the aggregator-owned disruption lease.
+            failers = cluster.assign(
+                world.rng, lambda i: ("fail-at", 1), per_slice=1
+            )
+        api, state = fx.storm_apiserver(cluster.nodes())
+        world.on_cleanup(api.shutdown)
+        fleet = FleetStateServer(0, host="127.0.0.1")
+        world.on_cleanup(fleet.close)
+        worlds[name] = {
+            "cluster": cluster, "api": api, "state": state, "fleet": fleet,
+            "kc": world.kubeconfig(api.server_address[1], name),
+        }
+    world.event(
+        f"fleet clusters={','.join(names)} dead={dead} "
+        f"death_round={death_round} fleet_budget={fleet_budget} "
+        f"decayers={','.join(sorted(decayers))} "
+        f"failers={','.join(sorted(failers))}"
+    )
+    lease = FleetLeaseBudget(fleet_budget, 3600.0)
+    agg = FleetStateServer(0, host="127.0.0.1", lease=lease.grant)
+    world.on_cleanup(agg.close)
+    agg_url = f"http://127.0.0.1:{agg.port}"
+    endpoints = f"{world.tmpdir}/endpoints.json"
+    with open(endpoints, "w", encoding="utf-8") as fh:
+        json.dump({"clusters": [
+            {"name": n, "url": f"http://127.0.0.1:{worlds[n]['fleet'].port}"}
+            for n in names
+        ]}, fh)
+    fed_poll = FederationEngine(cli.parse_args([
+        "--federate", endpoints, "--serve", "0", "--retry-budget", "0",
+    ]))
+    world.on_cleanup(fed_poll.close)
+    fed_feed = FederationEngine(cli.parse_args([
+        "--federate", endpoints, "--serve", "0", "--retry-budget", "0",
+        "--federate-feed",
+    ]))
+    world.on_cleanup(fed_feed.close)
+
+    def _cluster_argv(name: str, reports: str) -> List[str]:
+        w = worlds[name]
+        argv = _base_argv(w["kc"], reports, "--cluster-name", name)
+        if name == analytics_cluster:
+            argv += ["--history", world.history_path(name),
+                     "--analytics", world.analytics_dir(name),
+                     "--cordon-after", "2"]
+        elif name == lease_cluster:
+            # No --strict-slices: a minority of hard failures must not
+            # drain this cluster's aggregate verdict — the global summary
+            # stays healthy-by-verdict, so the staleness invariant grades
+            # the PARTITION, not ordinary sickness.  The cordon path (and
+            # the fleet lease funding it) fires on the failed probes
+            # regardless of the exit code.
+            argv += ["--cordon-failed", "--cordon-max", "8",
+                     "--slice-floor-pct", "50",
+                     "--disruption-lease", agg_url]
+        else:
+            argv += ["--strict-slices"]
+        return argv
+
+    def _oracle(name: str, r: int) -> int:
+        if name == dead and r >= death_round:
+            return checker.EXIT_ERROR
+        cluster = worlds[name]["cluster"]
+        down = cluster.down(r)
+        if name in (analytics_cluster, lease_cluster):
+            # No --strict-slices on these: a minority of sick hosts never
+            # drains the aggregate verdict.
+            return (checker.EXIT_NONE_READY
+                    if len(down) == len(cluster.node_names())
+                    else checker.EXIT_OK)
+        return checker.EXIT_NONE_READY if down else checker.EXIT_OK
+
+    def _feeds_verified() -> bool:
+        live = {n for n in names if n != dead}
+        clients = dict(fed_feed._feeds)
+        return live <= set(clients) and all(
+            clients[n]._state is not None for n in live
+        )
+
+    def _frame_applied(name: str) -> bool:
+        client = fed_feed._feeds.get(name)
+        if client is None:
+            return False
+        with client._lock:
+            state = client._state
+        etag = worlds[name]["fleet"]._snap.entities["nodes"].etag
+        return state is not None and state[0] == etag
+
+    expected: List[int] = []
+    lease_patches = 0
+    staleness_timeline: List[dict] = []
+    parity_timeline: List[dict] = []
+    for r in range(rounds):
+        if r == death_round:
+            dead_client = fed_feed._feeds.get(dead)
+            worlds[dead]["fleet"].close()
+            worlds[dead]["api"].shutdown()
+            worlds[dead]["api"].server_close()
+            checker.reset_client_cache()
+            if dead_client is not None:
+                # Consume the stream death deterministically: the next
+                # feed round must already know, not race the reader.
+                dead_client.thread.join(timeout=10)
+            world.event(f"partition round={r} cluster={dead}")
+        for name in names:
+            w = worlds[name]
+            partitioned = name == dead and r >= death_round
+            reports = world.write_reports(name, w["cluster"].verdicts(r))
+            before = len(w["state"]["patches"])
+            result, rec = world.checker_round(
+                _cluster_argv(name, reports), r, name
+            )
+            rec["patches"] = _patch_names(w["state"], before)
+            if name == lease_cluster:
+                lease_patches += len(rec["patches"])
+            expected.append(_oracle(name, r))
+            if result is not None and not partitioned:
+                w["fleet"].publish(result)
+            world.commit(rec)
+        poll_snap = fed_poll.round()
+        if r == 0:
+            fed_feed.round()  # the relist round: polls, then opens streams
+            wait_for(_feeds_verified, timeout=10.0,
+                     what="federation streams verified")
+        else:
+            for name in names:
+                if name == dead and r >= death_round:
+                    continue
+                if name in fed_feed._feeds:
+                    wait_for(lambda n=name: _frame_applied(n), timeout=10.0,
+                             what=f"feed frame applied for {name}")
+            fed_feed.round()
+        summary = json.loads(poll_snap.entity("global/summary").raw)
+        clusters_doc = json.loads(poll_snap.entity("global/clusters").raw)
+        stale_rounds = 0
+        for c in clusters_doc.get("clusters", []):
+            if c.get("name") == dead or c.get("cluster") == dead:
+                stale_rounds = ((c.get("staleness") or {}).get("rounds")
+                                or 0)
+        staleness_timeline.append({
+            "round": r,
+            "healthy": bool(summary.get("healthy")),
+            "degraded_clusters": sorted(summary.get("degraded_clusters")
+                                        or []),
+            "staleness_rounds": stale_rounds,
+            "total_nodes": summary.get("total_nodes"),
+        })
+        parity = {
+            name: (fed_feed.views[name].nodes_entries
+                   == fed_poll.views[name].nodes_entries
+                   and fed_feed.views[name].nodes_etag
+                   == fed_poll.views[name].nodes_etag)
+            for name in names
+        }
+        stale_parity = {
+            name: bool(fed_feed.views[name].stale)
+            == bool(fed_poll.views[name].stale)
+            for name in names
+        }
+        parity_timeline.append({
+            "round": r,
+            "clusters": {n: parity[n] and stale_parity[n] for n in names},
+        })
+        world.event(
+            f"federation round={r} "
+            f"healthy={staleness_timeline[-1]['healthy']} "
+            f"degraded={','.join(staleness_timeline[-1]['degraded_clusters']) or '-'} "
+            f"stale_rounds={stale_rounds} "
+            f"total_nodes={staleness_timeline[-1]['total_nodes']} "
+            f"parity={'ok' if all(parity_timeline[-1]['clusters'].values()) else 'DIVERGED'}"
+        )
+    world.grade(inv.check_exit_codes(world.records, expected=expected,
+                                     allowed={0, 1, 3}))
+    world.grade(inv.check_staleness_labels(
+        staleness_timeline, dead, death_round
+    ))
+    world.grade(inv.check_lease_bound(lease_patches, fleet_budget))
+    world.grade(inv.check_prediction_precedes_failure(
+        world.records, sorted(decayers)
+    ))
+    world.grade(inv.check_feed_parity(parity_timeline))
+    world.grade(inv.check_trace_completeness(world.records))
+
+
 SCENARIOS: Dict[str, Scenario] = {
     s.name: s
     for s in (
@@ -741,5 +965,26 @@ SCENARIOS: Dict[str, Scenario] = {
                         "denials-visible", "slack-dedup",
                         "trace-completeness"),
         ),
+        Scenario(
+            name="federated-world",
+            title="K clusters × M nodes through the REAL federation: poll "
+                  "and feed engines in lockstep, disruption lease, "
+                  "analytics prediction, one shard partitioned",
+            runner=_run_federated_world,
+            defaults={"clusters": 3, "nodes_per_cluster": 8, "rounds": 6,
+                      "min_clusters": 3, "min_rounds": 5},
+            invariants=("exit-code-contract", "staleness-labels",
+                        "lease-bound", "prediction-precedes-failure",
+                        "feed-parity", "trace-completeness"),
+            tunable=("clusters", "nodes_per_cluster", "rounds"),
+        ),
     )
 }
+
+# Composed scenarios are first-class grid members: same registry, same
+# --list-scenarios row, same byte-identical replay contract.  compose()
+# enforces the layering rules (sim/compose.py).
+from tpu_node_checker.sim.compose import COMPOSED  # noqa: E402  (the combinator needs Scenario/engine loaded first)
+
+for _composed in COMPOSED:
+    SCENARIOS[_composed.name] = _composed
