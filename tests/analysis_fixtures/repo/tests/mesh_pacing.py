@@ -1,0 +1,14 @@
+"""Meshprobe near-miss seed for TNC016: a test that paces a per-link
+sweep hop by hop LOOKS like sleep-driven timing, but routed through the
+injectable fake clock it never really sleeps — the rule must stay quiet
+on every line here."""
+
+
+def sweep_with_fake_pacing(clock, links):
+    # near-miss: clock.sleep is the fake-clock seam, not time.sleep —
+    # per-link pacing with zero wall-clock cost.
+    timings = {}
+    for name, budget_us in links:
+        clock.sleep(budget_us / 1e6)
+        timings[name] = clock.now
+    return timings

@@ -1,0 +1,24 @@
+"""Meshprobe seed for TNC103: the link doctor's hop-deadline watchdog is
+a thread, and an unnamed one is exactly the kind that shows up as
+``Thread-7`` in a stuck-sweep stack dump with no way to tell which hop it
+was guarding."""
+
+import threading
+
+
+def watchdog_unnamed(deadline_s):
+    # The classic drift: daemon-ness chosen, attribution forgotten.
+    t = threading.Thread(target=threading.Event().wait, daemon=True)  # EXPECT[TNC103]
+    t.start()
+    return t
+
+
+def watchdog_hygienic(axis, hop, deadline_s):
+    # near-miss: the approved idiom — the guarded link IS the thread name.
+    t = threading.Thread(
+        target=threading.Event().wait,
+        name=f"tnc-mesh-watchdog-{axis}-{hop}",
+        daemon=True,
+    )
+    t.start()
+    return t

@@ -1,6 +1,7 @@
 """Seeds for TNC017: observability discipline — spans close via ``with``
 (a bare ``start_span`` is never closed and corrupts every offset after
-it); ``HistogramFamily`` names end ``_ms`` and declare their buckets."""
+it); ``HistogramFamily`` names carry a unit suffix (``_ms`` or ``_us``)
+and declare their buckets."""
 
 BUCKETS_MS = (1.0, 5.0, 25.0)
 
@@ -27,6 +28,12 @@ def histogram_families(HistogramFamily):
         "request wait",
         buckets=BUCKETS_MS,
     )
+    ok_us = HistogramFamily(
+        "tpu_node_checker_mesh_link_duration_us",  # near-miss: _us is a unit
+        "per-link ICI sweep timing",
+        BUCKETS_MS,
+        label=("slice", "axis"),
+    )
     bad_name = HistogramFamily(
         "tpu_node_checker_fetch_duration_seconds",  # EXPECT[TNC017]
         "seconds-denominated family",
@@ -36,4 +43,4 @@ def histogram_families(HistogramFamily):
         "tpu_node_checker_publish_duration_ms",
         "no buckets declared",
     )
-    return ok, ok_kw, bad_name, bad_buckets
+    return ok, ok_kw, ok_us, bad_name, bad_buckets

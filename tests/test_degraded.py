@@ -1,0 +1,414 @@
+"""The DEGRADED evidence class, end to end.
+
+The mesh link doctor (--probe-level mesh) grades a node whose chips pass
+but whose ICI link is SLOW as DEGRADED — an evidence VERDICT between the
+booleans, never an FSM state.  These tests pin the three contracts the
+class rides on:
+
+* FSM: a degraded round must not bank toward --cordon-after as if
+  FAILED, must not reset a SUSPECT streak as if healthy, must not enter
+  the flap window — but unlike no-evidence it DOES mint a machine;
+* store: ``"ok": "degraded"`` lines round-trip the tail-seed (the flap
+  replay skips them like any non-bool verdict);
+* remediation: --cordon-degraded drains the sick-link slice through the
+  budget engine's decide() under the same rails as --cordon-failed,
+  while the no-flag run's exit code and actuation stay untouched.
+"""
+
+import json
+import time
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from tests import fixtures as fx
+from tpu_node_checker import checker, cli
+from tpu_node_checker.history import (
+    DEGRADED,
+    FAILED,
+    HEALTHY,
+    SUSPECT,
+    HealthFSM,
+    HistoryStore,
+)
+
+
+class TestDegradedVerdictFSM:
+    def test_degraded_never_banks_toward_cordon_after(self):
+        fsm = HealthFSM(cordon_after=2)
+        fsm.observe("n", False)  # SUSPECT, streak 1
+        fsm.observe("n", DEGRADED)  # must NOT count as the 2nd bad round
+        h = fsm.health("n")
+        assert h.state == SUSPECT and h.streak == 1
+        fsm.observe("n", DEGRADED)
+        assert fsm.health("n").state == SUSPECT
+        assert not fsm.cordon_eligible("n")
+        fsm.observe("n", False)  # the REAL 2nd bad round condemns
+        assert fsm.health("n").state == FAILED
+
+    def test_degraded_never_resets_suspect_streak(self):
+        fsm = HealthFSM(cordon_after=3)
+        fsm.observe("n", False)
+        fsm.observe("n", False)
+        assert fsm.health("n").streak == 2
+        fsm.observe("n", DEGRADED)  # not a healthy round either
+        h = fsm.health("n")
+        assert h.state == SUSPECT and h.streak == 2
+
+    def test_degraded_never_enters_flap_window(self):
+        fsm = HealthFSM()
+        fsm.observe("n", True)
+        for _ in range(6):
+            # SLOW<->OK link weather interleaved with good rounds must
+            # not read as verdict flips.
+            fsm.observe("n", DEGRADED)
+            fsm.observe("n", True)
+        h = fsm.health("n")
+        assert h.flaps == 0 and h.flaps_total == 0
+        assert h.state == HEALTHY
+
+    def test_degraded_mints_a_machine_unlike_none(self):
+        fsm = HealthFSM()
+        assert fsm.observe("ghost", None) is None
+        assert "ghost" not in fsm.nodes  # absence observes nothing
+        fsm.observe("sick-link", DEGRADED)
+        # Affirmative evidence: the node exists and computes.
+        assert "sick-link" in fsm.nodes
+        assert fsm.health("sick-link").state == HEALTHY
+
+    def test_degraded_holds_recovering_quarantine(self):
+        fsm = HealthFSM(cordon_after=1, uncordon_after=2)
+        fsm.observe("n", False)  # FAILED
+        fsm.observe("n", True)  # RECOVERING, streak 1
+        fsm.observe("n", DEGRADED)  # must not bank the 2nd good round
+        assert not fsm.uncordon_eligible("n")
+        fsm.observe("n", True)
+        assert fsm.uncordon_eligible("n")
+
+
+class TestDegradedStoreRoundTrip:
+    def test_degraded_lines_round_trip_tail_seed(self, tmp_path):
+        path = tmp_path / "h.jsonl"
+        store = HistoryStore(str(path))
+        for ok in (True, DEGRADED, False, DEGRADED):
+            store.record(
+                {"node": "n", "ts": 1.0, "ok": ok, "causes": [],
+                 "state": SUSPECT, "streak": 1, "flaps": 0,
+                 "flaps_total": 0}
+            )
+        store.flush()
+        reloaded = HistoryStore(str(path)).load()
+        assert [e["ok"] for e in reloaded["n"]] == [True, "degraded",
+                                                   False, "degraded"]
+        fsm = HealthFSM()
+        fsm.seed("n", reloaded["n"])
+        h = fsm.health("n")
+        # Only the two BOOL verdicts replay into the flap window.
+        assert list(h.verdicts) == [True, False]
+        assert h.state == SUSPECT and h.streak == 1
+
+
+def _nodes_json(tmp_path, nodes):
+    p = tmp_path / "nodes.json"
+    p.write_text(json.dumps(fx.node_list(nodes)))
+    return str(p)
+
+
+def _tpu_nodes(n=2):
+    return [
+        fx.make_node(
+            f"tpu-{i}",
+            allocatable={"google.com/tpu": "4"},
+            labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-nodepool": "p",
+            },
+        )
+        for i in range(n)
+    ]
+
+
+def _links(slow=()):
+    links = {}
+    for name in ("t0/0", "t0/1", "t1/0", "t1/1"):
+        if name in slow:
+            links[name] = {"verdict": "SLOW", "p50_us": 900.0,
+                           "p99_us": 950.0, "budget_us": 400.0}
+        else:
+            links[name] = {"verdict": "OK", "p50_us": 50.0,
+                           "p99_us": 60.0, "budget_us": 400.0}
+    return links
+
+
+def _mesh_reports(tmp_path, degraded, name="probes"):
+    """Per-host mesh-level reports; degraded = {host: [slow link names]}."""
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    for host, slow in degraded.items():
+        (d / f"{host}.json").write_text(
+            json.dumps(
+                {
+                    "ok": True,
+                    "level": "mesh",
+                    "hostname": host,
+                    "written_at": time.time(),
+                    "error": None,
+                    "mesh_ok": True,
+                    "mesh_degraded": bool(slow),
+                    "mesh_n_links": 4,
+                    "mesh_latency_us": 1234.5,
+                    **({"mesh_slow_links": sorted(slow)} if slow else {}),
+                    "collective_legs_ok": {
+                        "psum_ok": True,
+                        "all_gather_ok": True,
+                        "reduce_scatter_ok": True,
+                        "psum_latency_us": 11.0,
+                        "all_gather_latency_us": 12.0,
+                        "reduce_scatter_latency_us": 13.0,
+                        "links": _links(slow),
+                    },
+                }
+            )
+        )
+    return str(d)
+
+
+class TestDegradedThroughChecker:
+    def test_degraded_round_holds_state_and_names_cause(self, tmp_path):
+        nodes = _tpu_nodes(2)
+        reports = _mesh_reports(
+            tmp_path, {"tpu-0": ["t1/1"], "tpu-1": []}
+        )
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--probe-results", reports,
+                "--history", str(tmp_path / "h.jsonl"),
+                "--json",
+            ]
+        )
+        res = checker.run_check(args)
+        # Exit-code contract unchanged: the chips pass, the round is OK.
+        assert res.exit_code == 0
+        entries = HistoryStore(str(tmp_path / "h.jsonl")).load()
+        sick = entries["tpu-0"][-1]
+        assert sick["ok"] == "degraded"
+        assert sick["causes"] == ["degraded-link"]
+        assert sick["state"] == HEALTHY  # held, not sickened
+        assert entries["tpu-1"][-1]["ok"] is True
+
+    def test_degraded_evidence_rides_budget_view(self, tmp_path):
+        nodes = _tpu_nodes(2)
+        reports = _mesh_reports(tmp_path, {"tpu-0": ["t1/1"], "tpu-1": []})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--probe-results", reports,
+                "--cordon-degraded", "--cordon-dry-run",
+                "--json",
+            ]
+        )
+        res = checker.run_check(args)
+        block = res.payload["remediation"]["degraded"]
+        assert block["nodes"] == ["tpu-0"]
+        # Slice-qualified: the budget-domain name prefixes the link.
+        assert block["links"] == ["p/tpu-v5-lite-podslice/-/t1/1"]
+        assert block["domains"] == ["p/tpu-v5-lite-podslice/-"]
+
+    def test_no_flag_run_payload_untouched(self, tmp_path):
+        nodes = _tpu_nodes(1)
+        reports = _mesh_reports(tmp_path, {"tpu-0": ["t1/1"]})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--probe-results", reports,
+                "--json",
+            ]
+        )
+        res = checker.run_check(args)
+        assert res.exit_code == 0
+        for key in ("cordon", "cordon_degraded", "remediation"):
+            assert key not in res.payload
+
+
+@pytest.fixture
+def fake_api(tmp_path):
+    patches = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_PATCH(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            patches.append({"path": self.path, "body": json.loads(body)})
+            payload = b"{}"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    server = fx.serve_http(Handler)
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        f"""
+apiVersion: v1
+kind: Config
+current-context: t
+contexts: [{{name: t, context: {{cluster: t, user: t}}}}]
+clusters: [{{name: t, cluster: {{server: "http://127.0.0.1:{server.server_address[1]}"}}}}]
+users: [{{name: t, user: {{token: tok}}}}]
+"""
+    )
+    yield {"patches": patches, "kubeconfig": str(kubeconfig)}
+    server.shutdown()
+
+
+class TestCordonDegraded:
+    def test_dry_run_reports_without_patching(self, tmp_path, capsys):
+        nodes = _tpu_nodes(2)
+        reports = _mesh_reports(tmp_path, {"tpu-0": ["t1/1"], "tpu-1": []})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--probe-results", reports,
+                "--cordon-degraded", "--cordon-dry-run",
+                "--slice-floor-pct", "10",
+                "--json",
+            ]
+        )
+        res = checker.run_check(args)
+        block = res.payload["cordon_degraded"]
+        assert block["dry_run"] is True
+        assert block["cordoned"] == ["tpu-0"]
+        assert block["links"] == ["p/tpu-v5-lite-podslice/-/t1/1"]
+        assert "would cordon tpu-0 (degraded ICI link)" in capsys.readouterr().err
+
+    def test_real_patch_cordons_degraded_node(self, tmp_path, fake_api):
+        nodes = _tpu_nodes(2)
+        reports = _mesh_reports(tmp_path, {"tpu-0": ["t1/1"], "tpu-1": []})
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--probe-results", reports,
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--cordon-degraded", "--slice-floor-pct", "10",
+                "--json",
+            ]
+        )
+        res = checker.run_check(args)
+        assert res.payload["cordon_degraded"]["cordoned"] == ["tpu-0"]
+        cordons = [
+            p for p in fake_api["patches"] if "tpu-0" in p["path"]
+        ]
+        assert cordons and cordons[0]["body"]["spec"]["unschedulable"] is True
+        # The healthy-link node is never touched.
+        assert not any("tpu-1" in p["path"] for p in fake_api["patches"])
+
+    def test_cordon_max_budget_gates_the_sweep(self, tmp_path, fake_api):
+        nodes = _tpu_nodes(2)
+        reports = _mesh_reports(
+            tmp_path, {"tpu-0": ["t1/1"], "tpu-1": ["t0/0"]}
+        )
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--probe-results", reports,
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--cordon-degraded", "--cordon-max", "1",
+                "--slice-floor-pct", "10",
+                "--json",
+            ]
+        )
+        res = checker.run_check(args)
+        block = res.payload["cordon_degraded"]
+        assert len(block["cordoned"]) == 1
+        assert len(block["skipped_over_cap"]) == 1
+
+    def test_failed_sweep_outranks_degraded_for_budget(self, tmp_path,
+                                                       fake_api):
+        # tpu-0 has DEAD chips, tpu-1 a slow link; one cordon of budget.
+        nodes = _tpu_nodes(2)
+        reports_dir = tmp_path / "probes"
+        reports_dir.mkdir()
+        (reports_dir / "tpu-0.json").write_text(json.dumps({
+            "ok": False, "level": "mesh", "hostname": "tpu-0",
+            "written_at": time.time(), "error": "mesh link dead",
+        }))
+        _mesh_reports(tmp_path, {"tpu-1": ["t0/0"]}, name="probes")
+        args = cli.parse_args(
+            [
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--probe-results", str(reports_dir),
+                "--kubeconfig", fake_api["kubeconfig"],
+                "--cordon-failed", "--cordon-degraded", "--cordon-max", "1",
+                "--slice-floor-pct", "10",
+                "--json",
+            ]
+        )
+        res = checker.run_check(args)
+        assert res.payload["cordon"]["cordoned"] == ["tpu-0"]
+        assert res.payload["cordon_degraded"]["cordoned"] == []
+        assert res.payload["cordon_degraded"]["skipped_over_cap"] == ["tpu-1"]
+
+
+class TestLinkDriftChannel:
+    def _run_rounds(self, tmp_path, rounds):
+        """One checker round per entry; entry = {host: [drifting links]}."""
+        nodes = _tpu_nodes(1)
+        results = []
+        for i, drifting in enumerate(rounds):
+            d = tmp_path / f"probes{i}"
+            d.mkdir()
+            for host in ("tpu-0",):
+                links = {}
+                for name in ("t0/0", "t0/1"):
+                    p50 = 300.0 if name in drifting.get(host, ()) else 10.0
+                    links[name] = {"verdict": "OK", "p50_us": p50,
+                                   "p99_us": p50 + 5.0, "budget_us": 400.0}
+                (d / f"{host}.json").write_text(json.dumps({
+                    "ok": True, "level": "mesh", "hostname": host,
+                    "written_at": time.time(), "error": None,
+                    "mesh_ok": True, "mesh_degraded": False,
+                    "mesh_n_links": 2, "mesh_latency_us": 10.0,
+                    "collective_legs_ok": {
+                        "psum_ok": True, "all_gather_ok": True,
+                        "reduce_scatter_ok": True, "links": links,
+                    },
+                }))
+            args = cli.parse_args([
+                "--nodes-json", _nodes_json(tmp_path, nodes),
+                "--probe-results", str(d),
+                "--history", str(tmp_path / "h.jsonl"),
+                "--analytics", str(tmp_path / "ana"),
+                "--json",
+            ])
+            results.append(checker.run_check(args))
+        return results
+
+    def test_link_drift_promotes_slice_to_suspect(self, tmp_path):
+        # p50=300 >= 0.5*400 drifts; three net drifting rounds fire.
+        results = self._run_rounds(
+            tmp_path, [{"tpu-0": ["t0/1"]}] * 4
+        )
+        fired = [
+            (i, p)
+            for i, res in enumerate(results)
+            for p in res.payload["analytics"]["predictions"]
+            if "link" in p
+        ]
+        assert fired, "drifting link never detected"
+        round_i, pred = fired[0]
+        assert pred["link"].endswith("t0/1")
+        assert pred["promoted"] == ["tpu-0"]
+        # Promotion is visible in the round's history gauges...
+        assert results[round_i].payload["history"]["states"][SUSPECT] == 1
+        # ...but never accelerates condemnation: the node is not
+        # cordon-eligible and later healthy rounds recover it.
+        assert results[-1].payload["history"]["states"][FAILED] == 0
+
+    def test_steady_links_never_fire(self, tmp_path):
+        results = self._run_rounds(tmp_path, [{}] * 5)
+        assert all(
+            not res.payload["analytics"]["predictions"] for res in results
+        )

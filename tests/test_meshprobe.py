@@ -1,0 +1,120 @@
+"""Mesh link doctor: per-link sweep grading on the 8-device CPU mesh.
+
+Mirrors test_chaos_hooks.py: every injection must be *named* (exactly the
+injected leg, nothing else), typos must fail loudly, and the no-injection
+sweep must be healthy, complete (n_links == the topology-derived
+expectation) and deterministically ordered — the contracts the bench
+series and the degraded-link sim scenario build on.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_node_checker.meshprobe import (
+    DEAD,
+    OK,
+    SLOW,
+    MeshLinkReport,
+    expected_link_count,
+    link_names,
+    mesh_link_sweep,
+    qualify_link,
+)
+
+N = 8  # conftest virtual CPU devices
+
+
+class TestLinkNaming:
+    def test_expected_link_count_topology(self):
+        # One leg per ring hop: 2x4 → 2 + 4.
+        assert expected_link_count("2x4", N) == 6
+        assert link_names("2x4", N) == [
+            "t0/0", "t0/1", "t1/0", "t1/1", "t1/2", "t1/3",
+        ]
+
+    def test_flat_fallback_and_degenerate(self):
+        # No/mismatched label → one flat ring of n legs; 1 device → none.
+        assert expected_link_count(None, N) == N
+        assert expected_link_count("4x4", N) == N  # label ≠ device count
+        assert expected_link_count(None, 1) == 0
+        assert link_names(None, 3) == ["d/0", "d/1", "d/2"]
+
+    def test_qualify_link_joins_domain_namespace(self):
+        assert qualify_link("pool-a", "t1/2") == "pool-a/t1/2"
+        assert qualify_link(None, "t1/2") == "t1/2"
+
+
+class TestMeshLinkSweep:
+    def test_healthy_sweep_complete_and_ordered(self):
+        r = mesh_link_sweep(topology="2x4", payload=16, hop_iters=3)
+        assert r.ok and not r.degraded and r.error is None
+        assert r.n_devices == N
+        assert r.n_links == expected_link_count("2x4", N)
+        assert list(r.links) == link_names("2x4", N)
+        assert all(v["verdict"] == OK for v in r.links.values())
+        assert all(
+            v["p50_us"] <= v["p99_us"] and v["budget_us"] > 0
+            for v in r.links.values()
+        )
+        assert r.slow == [] and r.dead == []
+
+    def test_flat_ring_without_topology(self):
+        r = mesh_link_sweep(payload=16, hop_iters=3)
+        assert r.ok
+        assert list(r.links) == link_names(None, N)
+
+    def test_deterministic_naming_across_runs(self):
+        a = mesh_link_sweep(topology="2x4", payload=16, hop_iters=3)
+        b = mesh_link_sweep(topology="2x4", payload=16, hop_iters=3)
+        assert list(a.links) == list(b.links)
+        assert [v["verdict"] for v in a.links.values()] == [
+            v["verdict"] for v in b.links.values()
+        ]
+
+    def test_slow_injection_names_exactly_that_link(self):
+        r = mesh_link_sweep(
+            topology="2x4", payload=16, hop_iters=3, inject_slow_link="t1:2"
+        )
+        # SLOW degrades, never fails: the probe's ok verdict must not change.
+        assert r.ok and r.degraded and r.error is None
+        assert r.slow == ["t1/2"] and r.dead == []
+        assert r.links["t1/2"]["verdict"] == SLOW
+        assert r.links["t1/2"]["p50_us"] > r.links["t1/2"]["budget_us"]
+        assert all(
+            v["verdict"] == OK for k, v in r.links.items() if k != "t1/2"
+        )
+
+    def test_dead_injection_fails_and_names(self):
+        r = mesh_link_sweep(
+            topology="2x4", payload=16, hop_iters=3, inject_dead_link="t0:1"
+        )
+        assert not r.ok
+        assert r.dead == ["t0/1"]
+        assert r.links["t0/1"]["verdict"] == DEAD
+        assert "t0/1" in r.error
+        assert all(
+            v["verdict"] == OK for k, v in r.links.items() if k != "t0/1"
+        )
+
+    @pytest.mark.parametrize(
+        "spec,needle",
+        [
+            ("zz:0", "axis 'zz'"),
+            ("t1:9", "out of range"),
+            ("t1", "must be 'axis:hop'"),
+            ("t1:x", "not an integer"),
+        ],
+    )
+    def test_typo_injection_fails_loudly(self, spec, needle):
+        # Never-inject-nothing-silently: the chaos-hook contract.
+        r = mesh_link_sweep(topology="2x4", payload=16, hop_iters=1,
+                            inject_slow_link=spec)
+        assert not r.ok
+        assert needle in r.error
+
+    def test_report_never_raises(self):
+        # A broken mesh argument degrades to a structured failure.
+        r = mesh_link_sweep(mesh=object(), payload=16, hop_iters=1)
+        assert isinstance(r, MeshLinkReport)
+        assert not r.ok and r.error

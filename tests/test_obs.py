@@ -906,3 +906,120 @@ class TestFederationTwoTierTrace:
             up_srv.close()
             agg.close()
             engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Mesh link observability: the _us histogram family, backfilled link spans,
+# and the checker-side feed that joins them to probe reports
+# ---------------------------------------------------------------------------
+
+
+def _links_block(entries):
+    """entries: {link: (p50_us, verdict)} -> a collective_legs_ok.links dict."""
+    return {
+        link: {"p50_us": p50, "p99_us": p50 * 2, "budget_us": 400.0,
+               "verdict": verdict, "n": 16}
+        for link, (p50, verdict) in entries.items()
+    }
+
+
+class TestMeshLinkObservability:
+    def test_tuple_label_family_renders_both_labels(self):
+        fam = HistogramFamily(
+            "tpu_node_checker_mesh_link_duration_us", "per-link sweep",
+            (50.0, 500.0), label=("slice", "axis"),
+        )
+        fam.record(120.0, ("pool/v5e/-", "t1"))
+        fam.record(30.0, ("pool/v5e/-", "t0"))
+        lines = fam.prometheus_lines()
+        joined = "\n".join(lines)
+        assert 'axis="t1"' in joined and 'slice="pool/v5e/-"' in joined
+        # Both label keys on every bucket line, alongside le.
+        bucket = [
+            ln for ln in lines
+            if ln.startswith("tpu_node_checker_mesh_link_duration_us_bucket")
+            and 'axis="t0"' in ln
+        ]
+        assert bucket and all('slice="pool/v5e/-"' in ln for ln in bucket)
+        assert any('le="50"' in ln and ln.endswith(" 1.0") for ln in bucket)
+
+    def test_record_timed_span_lands_in_spans_not_phases(self):
+        tracer = Tracer(round_seq=1)
+        with tracer.span("probe"):
+            tracer.record_timed_span(
+                "mesh-link:t1/2", 0.9, verdict="SLOW", budget_us=400.0
+            )
+        names = [s[0] for s in tracer.spans]
+        assert "mesh-link:t1/2" in names
+        # Phase names feed the per-phase histogram and the payload timings
+        # block — per-link names there would be unbounded-cardinality.
+        assert "mesh-link:t1/2" not in tracer.phases
+        span = next(s for s in tracer.spans if s[0] == "mesh-link:t1/2")
+        assert span[2] == pytest.approx(0.9)
+        assert span[1] >= 0.0
+        assert span[5] == {"verdict": "SLOW", "budget_us": 400.0}
+
+    def test_observability_mesh_family_scrapes_after_feed(self):
+        obs = Observability()
+        assert obs.prometheus_lines() == []  # empty family renders nothing
+        obs.record_mesh_links([
+            ("pool/v5e/-", "t0", 80.0),
+            ("pool/v5e/-", "t1", 900.0),
+        ])
+        joined = "\n".join(obs.prometheus_lines())
+        assert "tpu_node_checker_mesh_link_duration_us_bucket" in joined
+        assert 'slice="pool/v5e/-",' in joined or '"pool/v5e/-"' in joined
+        assert 'axis="t1"' in joined
+
+    def test_emit_link_spans_one_span_per_leg(self):
+        tracer = Tracer(round_seq=3)
+        probe = {
+            "ok": True, "level": "mesh",
+            "collective_legs_ok": {
+                "links": _links_block({
+                    "t0/0": (50.0, "OK"),
+                    "t1/2": (900.0, "SLOW"),
+                }),
+            },
+        }
+        checker._emit_link_spans(tracer, probe)
+        by_name = {s[0]: s for s in tracer.spans}
+        assert set(by_name) == {"mesh-link:t0/0", "mesh-link:t1/2"}
+        assert by_name["mesh-link:t1/2"][5]["verdict"] == "SLOW"
+        assert by_name["mesh-link:t1/2"][2] == pytest.approx(0.9)
+
+    def test_emit_link_spans_tolerates_non_mesh_probes(self):
+        tracer = Tracer()
+        checker._emit_link_spans(tracer, None)
+        checker._emit_link_spans(tracer, {"ok": True})
+        checker._emit_link_spans(
+            tracer, {"collective_legs_ok": {"t0": True, "t1": True}}
+        )
+        legacy_timer = PhaseTimer()
+        assert hasattr(legacy_timer, "record_timed_span")  # alias of Tracer
+        assert tracer.spans == []
+
+    def test_mesh_link_samples_dedupe_per_slice_link(self):
+        from tpu_node_checker.detect import select_accelerator_nodes
+
+        nodes = fx.tpu_v5p_64_slice()[:2]
+        accel, _ = select_accelerator_nodes(nodes)
+        links = _links_block({"t0/0": (60.0, "OK"), "t1/2": (900.0, "SLOW")})
+        for n in accel:
+            n.probe = {
+                "ok": True, "level": "mesh",
+                "collective_legs_ok": {"links": dict(links)},
+            }
+        samples = checker._mesh_link_samples(accel)
+        # Both hosts report the SAME sweep: one sample per distinct link,
+        # not per host — a big slice must not outweigh a small one.
+        assert len(samples) == 2
+        domains = {s[0] for s in samples}
+        assert len(domains) == 1 and "-" not in domains
+        assert {(axis, p50) for _, axis, p50 in samples} == {
+            ("t0", 60.0), ("t1", 900.0)
+        }
+        # Probe-less nodes contribute nothing.
+        for n in accel:
+            n.probe = None
+        assert checker._mesh_link_samples(accel) == []

@@ -90,6 +90,46 @@ class TestComputeLevels:
         assert r.details.get("collective_ok") is True
         assert r.details.get("ring_ok") is True
 
+    def test_mesh_level_healthy(self):
+        r = run_local_probe(level="mesh", timeout_s=450)
+        assert r.ok, r.error
+        assert r.details.get("mesh_ok") is True
+        assert r.details.get("mesh_degraded") is False
+        assert r.details.get("mesh_n_links") == 8  # flat ring, 8 CPU devices
+        # At mesh level the legs block is ALWAYS emitted (bools + timings +
+        # the per-link sub-block), healthy or not.
+        legs = r.details["collective_legs_ok"]
+        assert legs["psum_ok"] is True
+        assert isinstance(legs.get("psum_latency_us"), (int, float))
+        assert len(legs["links"]) == 8
+        assert all(v["verdict"] == "OK" for v in legs["links"].values())
+
+    def test_mesh_level_names_injected_slow_link(self, monkeypatch):
+        # The acceptance contract: ONE chaos-injected slow hop on the 2x4
+        # CPU mesh is named SLOW — exactly that link — and the node merely
+        # DEGRADES (probe ok unchanged).
+        monkeypatch.setenv("TNC_CHAOS_SLOW_LINK", "t1:2")
+        r = run_local_probe(level="mesh", timeout_s=450, topology="2x4")
+        assert r.ok, r.error
+        assert r.details["mesh_ok"] is True
+        assert r.details["mesh_degraded"] is True
+        assert r.details["mesh_slow_links"] == ["t1/2"]
+        assert r.details["chaos_injected"] == {"slow_link": "t1:2"}
+        links = r.details["collective_legs_ok"]["links"]
+        assert links["t1/2"]["verdict"] == "SLOW"
+        assert links["t1/2"]["p50_us"] > links["t1/2"]["budget_us"]
+        assert all(v["verdict"] == "OK" for k, v in links.items() if k != "t1/2")
+
+    def test_chaos_slow_link_below_mesh_level_fails_loudly(self, monkeypatch):
+        # Same inject-nothing-silently contract as the other chaos vars:
+        # the sweep only runs at mesh+.
+        monkeypatch.setenv("TNC_CHAOS_SLOW_LINK", "t0:0")
+        r = run_local_probe(level="collective", timeout_s=300, topology="2x4")
+        assert not r.ok
+        assert r.details.get("chaos_injected") == {"slow_link": "t0:0"}
+        assert "TNC_CHAOS_SLOW_LINK" in (r.error or "")
+        assert "never runs the injected surface" in (r.error or "")
+
     def test_compute_level_with_soak(self, monkeypatch):
         # Ratio criterion relaxed: CPU round timings are scheduler jitter.
         monkeypatch.setenv("TNC_SOAK_MIN_RATIO", "0")
@@ -140,11 +180,18 @@ class TestComputeLevels:
             "axis": "t1",
         }
         assert r.details["collective_ok"] is False
-        assert r.details["collective_legs_ok"] == {
+        legs = r.details["collective_legs_ok"]
+        assert {k: legs.get(k) for k in
+                ("psum_ok", "all_gather_ok", "reduce_scatter_ok")} == {
             "psum_ok": True,
             "all_gather_ok": False,
             "reduce_scatter_ok": True,
         }
+        # The timing backfill rides in the same block: old consumers see
+        # per-leg figures without opting into the mesh-level links.
+        for k in ("psum_latency_us", "all_gather_latency_us",
+                  "reduce_scatter_latency_us"):
+            assert isinstance(legs.get(k), (int, float)), k
         assert r.details["ring_ok"] is False
         assert r.details["ring_bad_links"] == ["3->4"]
         assert "ring_err" in r.details

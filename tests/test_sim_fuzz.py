@@ -49,7 +49,8 @@ class TestSampling:
         assert len(set(drawn)) > 1, "eight seeds drew one program"
 
     def test_grammar_only(self):
-        kinds = {"flap", "flap-until", "fail-at", "kubelet-down-at"}
+        kinds = {"flap", "flap-until", "fail-at", "kubelet-down-at",
+                 "torn-link"}
         for s in range(12):
             p = fuzz.sample_program(s)
             for prog in p["programs"].values():

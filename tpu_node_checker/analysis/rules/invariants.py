@@ -332,9 +332,11 @@ class ObsDiscipline(Rule):
     code = "TNC017"
     doc = ("spans close via ``with`` — a bare ``start_span()`` call outside "
            "a with-context is never closed and silently corrupts every span "
-           "offset after it — and ``HistogramFamily`` names end ``_ms`` with "
-           "their buckets declared at the instantiation (an implicit default "
-           "would mis-bucket the next family measured in seconds)")
+           "offset after it — and ``HistogramFamily`` names carry an explicit "
+           "unit suffix (``_ms``, or ``_us`` for microsecond-scale mesh link "
+           "timings) with their buckets declared at the instantiation (an "
+           "implicit default would mis-bucket the next family measured in "
+           "seconds)")
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         if not ctx.in_package():
@@ -361,12 +363,13 @@ class ObsDiscipline(Rule):
                 )
             if name == "HistogramFamily" or name.endswith(".HistogramFamily"):
                 lit = const_str(node.args[0]) if node.args else None
-                if lit is not None and not lit.endswith("_ms"):
+                if lit is not None and not (lit.endswith("_ms")
+                                            or lit.endswith("_us")):
                     yield self.finding(
                         ctx.path, node.args[0],
-                        f"histogram family {lit!r} does not end '_ms' — "
-                        "every latency family in this tree is "
-                        "milliseconds-denominated; a mixed unit poisons "
+                        f"histogram family {lit!r} does not end '_ms' or "
+                        "'_us' — every latency family in this tree declares "
+                        "its unit in the name; a mixed unit poisons "
                         "histogram_quantile() across families",
                     )
                 if (len(node.args) < 3

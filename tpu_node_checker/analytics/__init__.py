@@ -23,19 +23,26 @@ Three pieces:
   CUSUM detector over per-node flip rates that promotes a still-HEALTHY
   flapper to SUSPECT through the FSM's own transition log *before* the
   hysteresis machine sees a hard failure, and feeds the prediction set to
-  the remediation budget engine.
+  the remediation budget engine; plus a per-ICI-link timing channel
+  (:class:`LinkDriftDetector`) over the mesh link doctor's p50/budget
+  samples — drift on a link promotes its slice's nodes through the same
+  never-an-accelerant pin.
 
 Served from the fleet API as ``GET /api/v1/analytics/{slo,offenders,
 flaps}`` — pre-serialized snapshot entities swapped atomically per round,
 so the TNC011 lock-free read-path rules hold with zero new waivers.
 """
 
-from tpu_node_checker.analytics.changepoint import CusumFlapDetector
+from tpu_node_checker.analytics.changepoint import (
+    CusumFlapDetector,
+    LinkDriftDetector,
+)
 from tpu_node_checker.analytics.segments import SegmentStore, append_bucket
 from tpu_node_checker.analytics.queries import build_analytics_docs
 
 __all__ = [
     "CusumFlapDetector",
+    "LinkDriftDetector",
     "SegmentStore",
     "append_bucket",
     "build_analytics_docs",

@@ -123,3 +123,96 @@ class CusumFlapDetector:
             for node in sorted(self._score)
             if self._score.get(node, 0.0) > 0.0 or node in self.active
         ]
+
+
+# Per-link timing channel: the fraction of a link's timing budget its p50
+# may consume before the round counts as drifting.  One SLOW verdict is a
+# fact; a link that *trends toward* its budget round after round is a
+# prediction — the same early-warning-never-accelerant contract as the
+# flip channel above.
+LINK_HEADROOM = 0.5
+
+
+class LinkDriftDetector:
+    """Per-ICI-link one-sided CUSUM over timing-budget headroom.
+
+    The sample: each probed round, a link contributes ``x ∈ {0, 1}`` — did
+    its p50 consume at least :data:`LINK_HEADROOM` of its per-link budget
+    (the mesh link doctor's SLOW ladder).  Scores follow the exact flip-
+    channel mechanics (``S ← max(0, S + x − DRIFT)``, one firing per
+    episode, re-arm on drain), so a detection needs three net drifting
+    rounds: a healthy link far under budget contributes nothing, one noisy
+    sweep peaks at 0.5, and a link sliding toward SLOW fires typically
+    before the sweep ever grades it SLOW.  Keys are slice-qualified link
+    names (``slice/axis/hop`` — the budget-domain namespace), so a firing
+    names the slice whose nodes the caller promotes to SUSPECT, through
+    the same :meth:`HealthFSM.promote_suspect` pin as the flip channel —
+    never accelerating condemnation.  Pure arithmetic: no clock, no RNG
+    (the TNC020 replay contract holds by construction).
+    """
+
+    def __init__(self, drift: float = CUSUM_DRIFT,
+                 threshold: float = CUSUM_THRESHOLD,
+                 headroom: float = LINK_HEADROOM):
+        self.drift = float(drift)
+        self.threshold = float(threshold)
+        self.headroom = float(headroom)
+        self._score: Dict[str, float] = {}
+        self._armed: Dict[str, bool] = {}
+        self.detections_total = 0
+        # link -> round_seq of the current episode's first firing.
+        self.active: Dict[str, int] = {}
+
+    def observe(self, link: str, p50_us: float, budget_us: float,
+                round_seq: int = 0) -> bool:
+        """Advance one link's CUSUM by one probed round's timing sample.
+
+        Returns True exactly once per episode — on the round the score
+        first crosses the threshold.
+        """
+        drifting = budget_us > 0 and p50_us >= self.headroom * budget_us
+        score = max(
+            0.0,
+            self._score.get(link, 0.0)
+            + (1.0 if drifting else 0.0)
+            - self.drift,
+        )
+        self._score[link] = score
+        if score <= 0.0 and not self._armed.get(link, True):
+            self._armed[link] = True
+            self.active.pop(link, None)
+        if score >= self.threshold and self._armed.get(link, True):
+            self._armed[link] = False
+            self.active[link] = round_seq
+            self.detections_total += 1
+            return True
+        return False
+
+    def score(self, link: str) -> float:
+        return self._score.get(link, 0.0)
+
+    def active_count(self) -> int:
+        return len(self.active)
+
+    def prune(self, live: set) -> None:
+        """Forget every link outside ``live`` (this round's probed link
+        set) — a drained slice's links must not sit in the standing
+        prediction set forever, same policy as the flip channel's fleet
+        prune.  The cost is deliberate: a link that skips a round restarts
+        its episode, which only *delays* a detection — the conservative
+        direction for an early-warning channel."""
+        for link in set(self._score) - live:
+            for d in (self._score, self._armed, self.active):
+                d.pop(link, None)
+
+    def snapshot(self) -> List[dict]:
+        """Deterministic per-link view for the flaps query doc."""
+        return [
+            {
+                "link": link,
+                "score": round(self._score.get(link, 0.0), 3),
+                "active": link in self.active,
+            }
+            for link in sorted(self._score)
+            if self._score.get(link, 0.0) > 0.0 or link in self.active
+        ]

@@ -31,7 +31,7 @@ import re
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from tpu_node_checker import notify, report
 
@@ -586,6 +586,7 @@ def _remediation_enabled(args) -> bool:
         getattr(args, "slice_floor_pct", None) is not None
         or getattr(args, "disruption_budget", None)
         or getattr(args, "drain_failed", False)
+        or getattr(args, "cordon_degraded", False)
         or getattr(args, "repair_cmd", None)
         or getattr(args, "repair_webhook", None)
         or getattr(args, "disruption_lease", None)
@@ -636,6 +637,7 @@ def _build_remediation(args, history, events=None) -> dict:
         lease_url,
         getattr(args, "cordon_max", 1),
         bool(getattr(args, "drain_failed", False)),
+        bool(getattr(args, "cordon_degraded", False)),
         repair_on,
         os.path.abspath(args.history) if getattr(args, "history", None) else None,
         getattr(args, "nodes_json", None),
@@ -734,14 +736,24 @@ def _build_analytics(args):
     path = getattr(args, "analytics", None)
     if not path:
         return None
-    from tpu_node_checker.analytics import CusumFlapDetector, SegmentStore
+    from tpu_node_checker.analytics import (
+        CusumFlapDetector,
+        LinkDriftDetector,
+        SegmentStore,
+    )
 
     key = os.path.abspath(path)
     if _ANALYTICS_CACHE["key"] == key:
         return _ANALYTICS_CACHE["bundle"]
     store = SegmentStore(key)
     store.load()
-    bundle = {"store": store, "detector": CusumFlapDetector()}
+    bundle = {
+        "store": store,
+        "detector": CusumFlapDetector(),
+        # The mesh link doctor's timing channel: CUSUM over per-link
+        # p50/budget headroom, keyed by slice-qualified link names.
+        "link_detector": LinkDriftDetector(),
+    }
     _ANALYTICS_CACHE["key"], _ANALYTICS_CACHE["bundle"] = key, bundle
     return bundle
 
@@ -774,7 +786,167 @@ def _node_round_causes(n: NodeInfo) -> List[str]:
         causes.append(
             "no-probe-report" if n.probe.get("level") == "missing" else "probe-failed"
         )
+    elif n.probe is not None and n.probe.get("mesh_degraded"):
+        # Chips passed but the mesh link sweep graded an ICI link SLOW:
+        # the round is DEGRADED, not failed — the store line should say
+        # why without pretending the node is condemnable.
+        causes.append("degraded-link")
     return causes
+
+
+def _node_link_domain(n: NodeInfo) -> Optional[str]:
+    """The budget-domain name a node's ICI links are qualified under —
+    the remediation engine's own ``_domain_name`` over ``slice_group_key``
+    (one definition, so a link-drift firing and the degraded-drain sweep
+    can never name the same slice differently).  ``None`` for a node
+    outside any slice grouping: its links stay unqualified."""
+    from tpu_node_checker.detect import slice_group_key
+    from tpu_node_checker.remediation.budget import _domain_name
+
+    key = slice_group_key(n)
+    return _domain_name(key) if key is not None else None
+
+
+def _node_mesh_links(n: NodeInfo) -> dict:
+    """One node's per-link timing matrix (``collective_legs_ok.links``)
+    from its probe report, or ``{}`` — tolerant of pre-mesh emitters."""
+    links = ((n.probe or {}).get("collective_legs_ok") or {}).get("links")
+    return links if isinstance(links, dict) else {}
+
+
+def _degraded_link_evidence(accel: List[NodeInfo]) -> Optional[dict]:
+    """This round's DEGRADED-link evidence for the budget engine:
+    ``{node: [slice-qualified SLOW link names]}``, or ``None`` when no
+    probed node reported a slow ICI link — the byte-identical-payload pin
+    rides on the None (``begin_round`` then attaches no block)."""
+    from tpu_node_checker.meshprobe import qualify_link
+
+    out: dict = {}
+    for n in accel:
+        slow = (n.probe or {}).get("mesh_slow_links")
+        if not slow:
+            continue
+        domain = _node_link_domain(n)
+        out[n.name] = sorted(qualify_link(domain, link) for link in slow)
+    return out or None
+
+
+def _emit_link_spans(timer, probe: Optional[dict]) -> None:
+    """One named span per ICI link leg of the local mesh sweep, backfilled
+    into the round trace.  The probe child timed each leg in-process and
+    shipped the p50 home — :meth:`Tracer.record_timed_span` lands them as
+    complete spans (they never touch the phase histogram: per-link names
+    would be unbounded-cardinality there)."""
+    record = getattr(timer, "record_timed_span", None)
+    if record is None or not probe:
+        return
+    links = (probe.get("collective_legs_ok") or {}).get("links")
+    if not isinstance(links, dict):
+        return
+    for link in sorted(links):
+        entry = links[link]
+        if not isinstance(entry, dict) or entry.get("p50_us") is None:
+            continue
+        record(
+            f"mesh-link:{link}", float(entry["p50_us"]) / 1e3,
+            verdict=entry.get("verdict"), budget_us=entry.get("budget_us"),
+        )
+
+
+def _mesh_link_samples(accel: List[NodeInfo]) -> List[tuple]:
+    """This round's mesh histogram feed: ``(slice_domain, axis, p50_us)``
+    per link, deduplicated by (domain, link) — every host of a slice
+    reports the same sweep, and re-counting it per host would weight a
+    big slice's links by its host count."""
+    samples: List[tuple] = []
+    seen: set = set()
+    for n in accel:
+        links = _node_mesh_links(n)
+        if not links:
+            continue
+        domain = _node_link_domain(n) or "-"
+        for link in sorted(links):
+            entry = links[link]
+            if not isinstance(entry, dict) or entry.get("p50_us") is None:
+                continue
+            key = (domain, link)
+            if key in seen:
+                continue
+            seen.add(key)
+            samples.append((domain, link.split("/")[0], float(entry["p50_us"])))
+    return samples
+
+
+def _observe_link_drift(analytics, accel: List[NodeInfo], fsm, args=None,
+                        events=None, trace_id=None,
+                        round_seq: int = 0) -> List[dict]:
+    """The per-link timing channel (``--analytics`` + mesh probes): feed
+    every probed link's p50/budget sample through the
+    :class:`~tpu_node_checker.analytics.changepoint.LinkDriftDetector`.
+
+    A firing is an early warning that a link is trending toward its SLOW
+    budget: every node of the link's slice is promoted HEALTHY → SUSPECT
+    through :meth:`HealthFSM.promote_suspect` — the same zeroed-streak
+    pin as the flip channel, so link drift can never accelerate a cordon.
+    Returns the round's link prediction records (shape ``{"link",
+    "score", "nodes", "promoted"}`` — keyed by link, not node, so readers
+    can tell the two channels apart in the shared predictions list).
+    """
+    detector = analytics.get("link_detector")
+    if detector is None:
+        return []
+    members: Dict[str, List[str]] = {}
+    for n in accel:
+        domain = _node_link_domain(n)
+        members.setdefault(domain or n.name, []).append(n.name)
+    from tpu_node_checker.meshprobe import qualify_link
+
+    predictions: List[dict] = []
+    live: set = set()
+    for n in accel:
+        links = _node_mesh_links(n)
+        if not links:
+            continue
+        domain = _node_link_domain(n)
+        group = members[domain or n.name]
+        for link in sorted(links):
+            entry = links[link]
+            if not isinstance(entry, dict):
+                continue
+            name = qualify_link(domain, link)
+            live.add(name)
+            fired = detector.observe(
+                name,
+                float(entry.get("p50_us") or 0.0),
+                float(entry.get("budget_us") or 0.0),
+                round_seq,
+            )
+            if not fired:
+                continue
+            promoted = sorted(
+                m for m in group
+                if fsm is not None and fsm.promote_suspect(m) is not None
+            )
+            prediction = {
+                "link": name,
+                "score": round(detector.score(name), 3),
+                "nodes": sorted(group),
+                "promoted": promoted,
+            }
+            predictions.append(prediction)
+            if events is not None:
+                events.emit(
+                    "analytics-link-drift",
+                    trace_id=trace_id,
+                    link=name,
+                    score=prediction["score"],
+                    promoted=promoted,
+                )
+    # Same fleet-tracking policy as the flip channel's prune, but over
+    # THIS round's probed link set (a drained slice's links must not
+    # stand as suspects forever).
+    detector.prune(live)
+    return predictions
 
 
 def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
@@ -811,6 +983,8 @@ def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
     """
     import time as _time
 
+    from tpu_node_checker.history.fsm import DEGRADED
+
     fsm, store = history["fsm"], history["store"]
     now = round(_time.time(), 3)
     predictions: List[dict] = []
@@ -834,6 +1008,19 @@ def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
         ):
             # Bad SOLELY because no report arrived: no evidence either way.
             verdict = None
+        if (
+            verdict is True
+            and n.probe is not None
+            and n.probe.get("mesh_degraded")
+        ):
+            # Chips passed but the mesh link sweep graded an ICI link
+            # SLOW: the DEGRADED evidence class — affirmative evidence
+            # that holds state (no banking toward --cordon-after, no
+            # SUSPECT-streak reset, no flap-window entry; see
+            # HealthFSM.observe).  The store records "ok": "degraded"
+            # verbatim; the tail-seed's flap replay skips it like any
+            # non-bool verdict.
+            verdict = DEGRADED
         out_of_band = n.quarantined_by_us and not n.cordoned
         if verdict is None and n.name not in fsm.nodes and not out_of_band:
             # No evidence about a node this machine has NEVER observed:
@@ -884,6 +1071,28 @@ def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
             }
         )
     if analytics is not None:
+        # The per-link timing channel AFTER every node's verdict landed:
+        # a link-drift promotion belongs to the NEXT round's store lines
+        # (this round's were stamped with the pre-promotion state above —
+        # same before/after seam as any other prediction vs evidence).
+        link_predictions = _observe_link_drift(
+            analytics, accel, fsm, args=args, events=events,
+            trace_id=trace_id, round_seq=round_seq,
+        )
+        predictions.extend(link_predictions)
+        # Re-stamp the payload health of any node a link firing just
+        # promoted, so payload["nodes"] and the history state gauges
+        # agree within the round (the store line keeps the
+        # pre-promotion state: prediction is not evidence).
+        promoted_now = {
+            m for p in link_predictions for m in p.get("promoted", ())
+        }
+        for n in accel:
+            if n.name in promoted_now:
+                h = fsm.health(n.name)
+                n.health = {
+                    "state": h.state, "streak": h.streak, "flaps": h.flaps,
+                }
         # A departed node's episode could never close on its own (no
         # more observes drain its score): the standing prediction set
         # tracks THIS round's fleet, like the FSM state gauges.  The
@@ -1267,6 +1476,124 @@ def _cordon_failed_nodes(args, accel: List[NodeInfo], client=None, fsm=None,
     return report_entry
 
 
+def _degraded_candidates(accel: List[NodeInfo]) -> List[NodeInfo]:
+    """The evidence rule for the ``--cordon-degraded`` sweep: kubelet-Ready,
+    schedulable, not already cordoned, carrying a PASSING probe report
+    this round whose mesh link sweep graded an ICI link SLOW.  Disjoint
+    from :func:`_failed_candidates` by construction (a failed report is
+    never ``ok``), so the two sweeps can never fight over one node."""
+    return [
+        n
+        for n in accel
+        if n.ready
+        and n.schedulable
+        and not n.cordoned
+        and n.probe is not None
+        and n.probe.get("ok")
+        and n.probe.get("mesh_degraded")
+    ]
+
+
+def _cordon_degraded_nodes(args, accel: List[NodeInfo], client=None,
+                           engine=None, events=None, trace_id=None) -> dict:
+    """``--cordon-degraded``: quarantine the nodes of a slice whose ICI
+    link the mesh sweep graded SLOW.
+
+    The chips PASS — this is a capacity-quality call, not a failure
+    verdict, which is why it is its own opt-in flag and its own payload
+    block: a DEGRADED round never feeds the FSM's condemnation ladder
+    (see :meth:`HealthFSM.observe`), so without this flag a slow link
+    changes no actuation at all.  Every PATCH rides the budget engine's
+    :meth:`decide` (TNC019) under the same rails as the failed sweep —
+    ``--cordon-max`` total-state budget, slice floors, disruption
+    budget/lease — so draining a sick-link slice can never take a slice
+    below its floor or blow the round's disruption budget.  Dry-run
+    follows ``--cordon-dry-run``; a PATCH failure is a report note,
+    never fatal.
+    """
+    engine = _ensure_engine(args, accel, engine, trace_id)
+    candidates = _degraded_candidates(accel)
+    dry_run = bool(getattr(args, "cordon_dry_run", False))
+    to_cordon, decisions, capped = [], {}, []
+    for n in candidates:
+        decision = engine.decide("cordon", n, dry_run=dry_run)
+        if decision.allowed:
+            to_cordon.append(n)
+            decisions[n.name] = decision
+        elif decision.reason == "cordon-max":
+            capped.append(n)
+    report_entry: dict = {
+        "dry_run": dry_run,
+        "cordoned": [],
+        "failed": [],
+        "links": sorted(
+            {
+                link
+                for n in candidates
+                for link in (_degraded_link_evidence([n]) or {}).get(n.name, ())
+            }
+        ),
+        "skipped_over_cap": sorted(n.name for n in capped),
+    }
+    if capped:
+        print(
+            f"--cordon-degraded: {len(capped)} candidate(s) beyond the "
+            f"--cordon-max budget left alone: "
+            f"{', '.join(report_entry['skipped_over_cap'])}",
+            file=sys.stderr,
+        )
+    if not to_cordon:
+        return report_entry
+    if dry_run:
+        report_entry["cordoned"] = sorted(n.name for n in to_cordon)
+        for n in to_cordon:
+            print(
+                f"[dry-run] would cordon {n.name} (degraded ICI link)",
+                file=sys.stderr,
+            )
+            if events is not None:
+                events.emit(
+                    "remediation-cordon",
+                    trace_id=trace_id,
+                    node=n.name,
+                    domain=decisions[n.name].domain,
+                    degraded=True,
+                    dry_run=True,
+                )
+        return report_entry
+    try:
+        client = _resolve_client(args, client)
+    except Exception as exc:  # tnc: allow-broad-except(quarantine is best-effort)
+        report_entry["failed"] = [
+            {"node": n.name, "error": f"no cluster client: {exc}"}
+            for n in to_cordon
+        ]
+        print(f"--cordon-degraded: cannot reach cluster: {exc}", file=sys.stderr)
+        return report_entry
+    from tpu_node_checker.remediation import actuate
+    from tpu_node_checker.utils.fanout import bounded_map
+
+    for n, (ok, err) in zip(
+        to_cordon,
+        bounded_map(
+            lambda n: actuate.cordon(
+                client, decisions[n.name], events=events, trace_id=trace_id
+            ),
+            to_cordon,
+            _api_concurrency(args),
+        ),
+    ):
+        if not ok:
+            report_entry["failed"].append({"node": n.name, "error": str(err)})
+            print(f"Cordon of {n.name} failed: {err}", file=sys.stderr)
+        else:
+            n.cordoned = True
+            engine.commit(decisions[n.name])
+            report_entry["cordoned"].append(n.name)
+            print(f"Cordoned {n.name} (degraded ICI link).", file=sys.stderr)
+    return report_entry
+
+
 def resolve_cluster_name(args, client=None):
     """This checker's cluster identity → ``(name, source)``.
 
@@ -1400,6 +1727,9 @@ def run_check(args, nodes: Optional[List[dict]] = None,
     if getattr(args, "probe", False):
         with timer.phase("probe"):
             _run_probe(args, accel, result, slices)
+        # Mesh sweeps only: each ICI link leg becomes a named span in the
+        # round trace (timed by the probe child, backfilled here).
+        _emit_link_spans(timer, result.local_probe)
     reports_skipped = _attach_probe_results(args, accel)
 
     if getattr(args, "node_events", False):
@@ -1441,8 +1771,10 @@ def run_check(args, nodes: Optional[List[dict]] = None,
     cordon_report = uncordon_report = None
     drain_report = repair_report = None
     remediation = None
+    degraded_report = None
     actuation = (
         getattr(args, "cordon_failed", False)
+        or getattr(args, "cordon_degraded", False)
         or getattr(args, "uncordon_recovered", False)
         or getattr(args, "drain_failed", False)
         or getattr(args, "repair_cmd", None)
@@ -1465,6 +1797,10 @@ def run_check(args, nodes: Optional[List[dict]] = None,
             predictions=(
                 set(analytics["detector"].active) if analytics else None
             ),
+            # This round's DEGRADED-link evidence (node → slice-qualified
+            # SLOW links): the budget view renders it, and the
+            # --cordon-degraded sweep below consumes it through decide().
+            degraded=_degraded_link_evidence(accel),
         )
         fsm = history["fsm"] if history is not None else None
         with timer.phase("cordon"):
@@ -1478,6 +1814,13 @@ def run_check(args, nodes: Optional[List[dict]] = None,
             if getattr(args, "cordon_failed", False):
                 cordon_report = _cordon_failed_nodes(
                     args, accel, client=kube_client, fsm=fsm, engine=engine,
+                    events=audit, trace_id=timer.trace_id,
+                )
+            if getattr(args, "cordon_degraded", False):
+                # AFTER the failed sweep: dead chips outrank a slow link
+                # for whatever --cordon-max budget remains.
+                degraded_report = _cordon_degraded_nodes(
+                    args, accel, client=kube_client, engine=engine,
                     events=audit, trace_id=timer.trace_id,
                 )
         if getattr(args, "drain_failed", False):
@@ -1565,6 +1908,8 @@ def run_check(args, nodes: Optional[List[dict]] = None,
         stamp_expected_chips(payload, expected_key, expected_n, have_chips)
         if cordon_report is not None:
             payload["cordon"] = cordon_report
+        if degraded_report is not None:
+            payload["cordon_degraded"] = degraded_report
         if uncordon_report is not None:
             payload["uncordon"] = uncordon_report
         if drain_report is not None:
@@ -1601,6 +1946,7 @@ def run_check(args, nodes: Optional[List[dict]] = None,
                 "compactions_total": seg_store.compactions_total,
             }
         for phase_name, rep in (("cordon", cordon_report),
+                                ("cordon_degraded", degraded_report),
                                 ("uncordon", uncordon_report),
                                 ("drain", drain_report),
                                 ("repair", repair_report)):
@@ -1840,7 +2186,12 @@ def selftest(args) -> int:
             "corrupted all_gather fails that leg, and only that leg",
             lambda r, d: (
                 not r.ok
-                and d.get("collective_legs_ok")
+                # Projection, not equality: the block also carries the
+                # per-leg timing backfill (and, at mesh level, links).
+                and {
+                    k: (d.get("collective_legs_ok") or {}).get(k)
+                    for k in ("psum_ok", "all_gather_ok", "reduce_scatter_ok")
+                }
                 == {"psum_ok": True, "all_gather_ok": False, "reduce_scatter_ok": True},
                 d.get("collective_err") or d.get("error") or "not caught",
             ),
@@ -2792,6 +3143,10 @@ def watch(args) -> int:
                             node=t.get("node"),
                             transition=t,
                         )
+                # BEFORE the scrape surface refreshes: update(result)
+                # renders obs.prometheus_lines(), and this round's link
+                # samples must already be in the family it renders.
+                obs.record_mesh_links(_mesh_link_samples(result.accel or []))
                 if metrics_server is not None:
                     metrics_server.set_breaker(breaker.as_dict())
                     metrics_server.update(result)

@@ -380,6 +380,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "count against it, so a fleet-wide regression under "
                         "--watch converges at N instead of draining the pool; "
                         "raise deliberately for mass-repair workflows")
+    cordon.add_argument("--cordon-degraded", action="store_true",
+                        help="also quarantine nodes whose chips PASS but whose "
+                        "mesh link sweep (--probe-level mesh) graded an ICI "
+                        "link SLOW this round — a capacity-quality drain, "
+                        "never fed through the FSM condemnation ladder; "
+                        "rides the same budget rails (--cordon-max, slice "
+                        "floors, disruption budget/lease) as --cordon-failed")
     cordon.add_argument("--cordon-dry-run", action="store_true",
                         help="report cordon/uncordon decisions without patching anything")
     cordon.add_argument("--uncordon-recovered", action="store_true",
@@ -594,6 +601,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             ("--slack-only-on-error", args.slack_only_on_error),
             ("--slack-on-change", args.slack_on_change),
             ("--cordon-failed", args.cordon_failed),
+            ("--cordon-degraded", args.cordon_degraded),
             ("--uncordon-recovered", args.uncordon_recovered),
             ("--cordon-max", args.cordon_max is not None),
             ("--cordon-dry-run", args.cordon_dry_run),
@@ -643,6 +651,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.watch is not None
         or args.probe_results
         or args.cordon_failed
+        or args.cordon_degraded
         or args.uncordon_recovered
         or args.report_fresh
         or args.log_jsonl
@@ -665,6 +674,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.watch is not None
         or args.probe_results
         or args.cordon_failed
+        or args.cordon_degraded
         or args.uncordon_recovered
         or args.report_fresh
         or args.log_jsonl
@@ -728,6 +738,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.watch is not None
         or args.probe_results
         or args.cordon_failed
+        or args.cordon_degraded
         or args.uncordon_recovered
         or args.report_fresh
         or args.trend
@@ -760,6 +771,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             or args.watch is not None
             or args.probe_results
             or args.cordon_failed
+            or args.cordon_degraded
             or args.uncordon_recovered
             or args.report_fresh
             or args.trend
@@ -807,6 +819,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         or args.watch is not None
         or args.probe_results
         or args.cordon_failed
+        or args.cordon_degraded
         or args.uncordon_recovered
         or args.history
         or args.trend_nodes
@@ -842,7 +855,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         0 < args.slice_floor_pct <= 100
     ):
         p.error("--slice-floor-pct must be in (0, 100]")
-    actuator = args.cordon_failed or args.drain_failed
+    actuator = args.cordon_failed or args.drain_failed or args.cordon_degraded
     for flag, on in (
         ("--slice-floor-pct", args.slice_floor_pct is not None),
         ("--disruption-budget", args.disruption_budget),
@@ -872,8 +885,19 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     if args.fleet_disruption_budget and not args.federate:
         p.error("--fleet-disruption-budget requires --federate (the fleet "
                 "budget lives on the aggregator tier)")
+    if args.cordon_degraded and args.probe and args.probe_level not in (
+        "mesh", "workload"
+    ):
+        # The degraded sweep's only evidence is the mesh link doctor's
+        # verdict; below mesh level the sweep could never fire — the
+        # silent-no-op rule (aggregated --probe-results reports carry
+        # their own level and are checked per report instead).
+        p.error("--cordon-degraded with --probe requires --probe-level "
+                "mesh (or workload): lower levels never run the mesh "
+                "link sweep")
     for flag, on in (
         ("--cordon-failed", args.cordon_failed),
+        ("--cordon-degraded", args.cordon_degraded),
         ("--drain-failed", args.drain_failed),
         ("--uncordon-recovered", args.uncordon_recovered),
     ):
@@ -923,13 +947,18 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     if args.cordon_max is not None and args.cordon_max < 1:
         p.error("--cordon-max must be at least 1")
     if args.cordon_max is not None and not (
-        args.cordon_failed or args.drain_failed or args.serve is not None
+        args.cordon_failed
+        or args.cordon_degraded
+        or args.drain_failed
+        or args.serve is not None
     ):
         # --serve counts too: the fleet API's cordon endpoint shares the
         # same total-cordoned-state budget as the sweep.
         p.error("--cordon-max requires --cordon-failed, --drain-failed "
                 "or --serve")
-    if args.cordon_dry_run and not (args.cordon_failed or args.uncordon_recovered):
+    if args.cordon_dry_run and not (
+        args.cordon_failed or args.cordon_degraded or args.uncordon_recovered
+    ):
         p.error("--cordon-dry-run requires --cordon-failed or --uncordon-recovered")
     if args.cordon_max is None:
         args.cordon_max = 1
@@ -993,6 +1022,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                 ("--node-events", args.node_events),
                 ("--analytics", args.analytics),
                 ("--cordon-failed", args.cordon_failed),
+                ("--cordon-degraded", args.cordon_degraded),
                 ("--uncordon-recovered", args.uncordon_recovered),
                 ("--drain-failed", args.drain_failed),
                 ("--repair-cmd", args.repair_cmd),

@@ -9,6 +9,7 @@ untouched.
 
 from tpu_node_checker.history.fsm import (  # noqa: F401
     CHRONIC,
+    DEGRADED,
     DEFAULT_CORDON_AFTER,
     DEFAULT_FLAP_THRESHOLD,
     DEFAULT_FLAP_WINDOW,

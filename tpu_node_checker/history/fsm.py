@@ -48,6 +48,16 @@ CHRONIC = "CHRONIC"
 
 STATES = (HEALTHY, SUSPECT, FAILED, RECOVERING, CHRONIC)
 
+# The DEGRADED evidence VERDICT (not a state): the mesh link doctor found
+# the chips healthy but an ICI link SLOW.  It grades between a good and a
+# bad round — affirmative evidence the node exists and computes, but
+# neither heals (no banking toward ``--uncordon-after``) nor sickens (no
+# banking toward ``--cordon-after``, no SUSPECT-streak reset, no flap-
+# window entry).  Recorded verbatim in the history store (``"ok":
+# "degraded"``) and skipped by the tail-seed's flap replay exactly like
+# any non-bool verdict.
+DEGRADED = "degraded"
+
 # K = M = 1 keeps the one-shot contract: the first --history run behaves
 # exactly like the snapshot grading it replaces, plus memory.
 DEFAULT_CORDON_AFTER = 1
@@ -135,7 +145,7 @@ class HealthFSM:
     # -- the machine --------------------------------------------------------
 
     def observe(
-        self, node: str, ok: Optional[bool], uncordoned_out_of_band: bool = False
+        self, node: str, ok, uncordoned_out_of_band: bool = False
     ) -> Optional[Tuple[str, str]]:
         """Feed one round's verdict; returns ``(from, to)`` on a transition.
 
@@ -149,6 +159,15 @@ class HealthFSM:
         an observed verdict advances a streak.  For a node this machine has
         never seen, no-evidence observes NOTHING: absence must not mint a
         HEALTHY machine either.
+
+        ``ok=DEGRADED`` grades BETWEEN the booleans: the chips passed but
+        an ICI link is SLOW.  State, streaks and the flap window hold like
+        no-evidence — a degraded round must not bank toward
+        ``--cordon-after`` as if FAILED, must not reset a SUSPECT streak
+        as if healthy, and must not enter the flap window (SLOW↔OK link
+        weather is not a verdict flip).  Unlike ``None`` it IS affirmative
+        evidence, so it mints a machine for a never-seen node — the
+        degraded-drain path needs the node known to the fleet's state.
         """
         if ok is None and node not in self.nodes and not uncordoned_out_of_band:
             return None
@@ -163,7 +182,7 @@ class HealthFSM:
             h.state = RECOVERING
             h.streak = 0
             h.verdicts.clear()
-        if ok is None:
+        if ok is None or ok == DEGRADED:
             return self._transitioned(node, before, h.state)
         # Flap window first: a flip is a flip whatever the state outcome.
         if h.verdicts and h.verdicts[-1] != ok:

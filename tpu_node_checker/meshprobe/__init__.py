@@ -1,0 +1,23 @@
+"""Mesh link doctor: per-ICI-link timing and grading over the slice mesh.
+
+The collective level answers "do the fabrics *work*"; this subsystem
+answers "which *link* is sick".  :func:`mesh_link_sweep` walks every mesh
+axis one ring hop at a time — one single-pair ``ppermute`` program per
+(axis, hop) — so each ICI link leg gets its own timing distribution and
+its own verdict (``OK | SLOW | DEAD``) under a topology-derived name
+(``axis/hop``; the aggregator prefixes the slice domain so link names ≡
+budget failure domains).  CPU-backed jax meshes keep the whole sweep
+tier-1-testable.
+"""
+
+from tpu_node_checker.meshprobe.sweep import (  # noqa: F401 — public API
+    DEAD,
+    OK,
+    SLOW,
+    VERDICTS,
+    MeshLinkReport,
+    expected_link_count,
+    link_names,
+    mesh_link_sweep,
+    qualify_link,
+)

@@ -1,0 +1,298 @@
+"""Per-link ICI sweep: one timed single-pair ``ppermute`` per link leg.
+
+The collective probes grade whole fabrics (a psum over every chip, a ring
+walk whose verdict covers every link at once).  This sweep decomposes the
+mesh into its individual ICI link legs: for every mesh axis and every ring
+hop ``h → (h+1) mod s`` along it, ONE jitted program moves a payload across
+exactly that leg — all parallel rings of the other axes move simultaneously,
+so "link" here is a *torus leg*, the repair-sized unit — and its wall time
+is sampled ``hop_iters`` times into a per-link p50/p99.
+
+Grading is a relative ladder, not an absolute floor: the sweep's own median
+p50 is the baseline (healthy legs of one fabric agree within noise), the
+per-link budget is ``max(BUDGET_FLOOR_US, SLOW_FACTOR × baseline)``, and a
+leg is ``SLOW`` past its budget, ``DEAD`` when its delivered payload is
+wrong or its p50 passes the hop deadline.  A DEAD leg fails the probe; a
+merely SLOW one degrades it (``ok`` stays True, ``degraded`` set) — the
+evidence class the history FSM and the budget engine grade between HEALTHY
+and FAILED.
+
+Link names are ``axis/hop`` (``t1/3`` = axis t1's leg 3→0 on a size-4
+ring), derived from the same ``parse_topology`` axes the per-axis probes
+use; :func:`qualify_link` prefixes the slice domain upstream so a link's
+full name (``slice/axis/hop``) lives in the budget engine's failure-domain
+namespace.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_node_checker.detect import parse_topology
+
+OK = "OK"
+SLOW = "SLOW"
+DEAD = "DEAD"
+VERDICTS = (OK, SLOW, DEAD)
+
+DEFAULT_PAYLOAD = 4096
+DEFAULT_HOP_ITERS = 5
+# Relative grading ladder: budget = max(floor, factor × sweep-median p50).
+# The floor absorbs scheduler noise on µs-scale CPU hops; the factor is wide
+# enough that only a genuinely sick leg (not cache weather) crosses it.
+BUDGET_FLOOR_US = 50.0
+SLOW_FACTOR = 8.0
+# Absolute per-hop deadline: a leg this slow is indistinguishable from dead
+# for any workload that deadline-schedules collectives.  (A leg that HANGS
+# never returns a sample at all — the probe child's kill-timer owns that.)
+HOP_DEADLINE_US = 5_000_000.0
+# Chaos inflation for inject_slow_link: measured samples are scaled, no real
+# sleep — deterministic under test clocks and far past SLOW_FACTOR while
+# staying well under the hop deadline on µs-scale healthy legs.
+CHAOS_SLOW_INFLATION = 1000.0
+
+
+@dataclass
+class MeshLinkReport:
+    """Outcome of one sweep; ``links`` preserves sweep order."""
+
+    ok: bool
+    degraded: bool
+    n_devices: int
+    topology: Optional[str]
+    n_links: int
+    links: Dict[str, dict] = field(default_factory=dict)
+    slow: List[str] = field(default_factory=list)
+    dead: List[str] = field(default_factory=list)
+    latency_us: float = 0.0
+    error: Optional[str] = None
+
+
+def qualify_link(domain: Optional[str], link: str) -> str:
+    """``slice/axis/hop``: the link's name inside the budget-domain
+    namespace (``domain`` is ``_domain_name(slice_group_key(node))``)."""
+    return f"{domain}/{link}" if domain else link
+
+
+def _axis_dims(topology: Optional[str], n_devices: int,
+               axis_prefix: str = "t") -> List[Tuple[str, int]]:
+    """(axis name, size) pairs exactly as ``mesh_from_topology`` would build
+    them — shared by the host-side expectation helpers so a bench assertion
+    and the live sweep can never disagree about the link set."""
+    dims = parse_topology(topology)
+    if dims is not None and math.prod(dims) == n_devices:
+        return [(f"{axis_prefix}{i}", d) for i, d in enumerate(dims)]
+    return [("d", n_devices)]
+
+
+def link_names(topology: Optional[str], n_devices: int) -> List[str]:
+    """Deterministic sweep-order link names for a device set."""
+    return [
+        f"{nm}/{h}"
+        for nm, s in _axis_dims(topology, n_devices)
+        if s > 1
+        for h in range(s)
+    ]
+
+
+def expected_link_count(topology: Optional[str], n_devices: int) -> int:
+    """Topology-derived link-leg count (``2x4`` → 2 + 4 = 6; flat ring of
+    n → n; a single device has no links)."""
+    return len(link_names(topology, n_devices))
+
+
+def _quantile(samples: List[float], q: float) -> float:
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))]
+
+
+def _parse_link_spec(spec, sizes: Dict[str, int], what: str) -> Tuple[str, int]:
+    """Validate an ``axis:hop`` injection spec against the live mesh — a
+    typo'd axis or out-of-range hop must fail loudly, never inject nothing
+    silently (the chaos-hook contract shared with the collective probes)."""
+    axis, sep, hop = str(spec).partition(":")
+    if not sep:
+        raise ValueError(f"{what} {spec!r} must be 'axis:hop' (e.g. 't0:1')")
+    if axis not in sizes:
+        raise ValueError(
+            f"{what} axis {axis!r} not one of mesh axes {sorted(sizes)}"
+        )
+    if sizes[axis] < 2:
+        raise ValueError(f"{what} axis {axis!r} has no links (size 1)")
+    try:
+        h = int(hop)
+    except ValueError:
+        raise ValueError(f"{what} hop {hop!r} is not an integer")
+    if not 0 <= h < sizes[axis]:
+        raise ValueError(
+            f"{what} hop {h} out of range for axis {axis!r} "
+            f"(size {sizes[axis]})"
+        )
+    return axis, h
+
+
+def mesh_link_sweep(
+    mesh=None,
+    topology: Optional[str] = None,
+    payload: int = DEFAULT_PAYLOAD,
+    hop_iters: int = DEFAULT_HOP_ITERS,
+    inject_slow_link: Optional[str] = None,
+    inject_dead_link: Optional[str] = None,
+    slow_inflation: float = CHAOS_SLOW_INFLATION,
+    hop_deadline_us: float = HOP_DEADLINE_US,
+) -> MeshLinkReport:
+    """Time every ICI link leg individually; never raises.
+
+    As in the collective probes, each leg runs ONE program that is also the
+    timed one (position-varying integer payloads — element j of the device
+    at linear index i carries i+j, exact in float32 below 2^24) and a
+    separate compare-only jit consumes its sharded output into a replicated
+    mismatch count, so timing covers exactly the ppermute measured and the
+    sweep runs unchanged over a multi-host global mesh.
+
+    ``inject_slow_link="axis:hop"`` scales that leg's measured samples by
+    ``slow_inflation`` (grading sees a slow leg; nothing actually sleeps);
+    ``inject_dead_link`` corrupts the payload delivered over that leg on
+    the receiver.  Both validate against the live mesh and fail loudly on
+    typos.
+    """
+    t_sweep = time.perf_counter()
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_node_checker.parallel.collectives import (
+            _linear_index,
+            _row_major_strides,
+        )
+        from tpu_node_checker.parallel.mesh import (
+            mesh_from_topology,
+            shard_map_fn,
+        )
+
+        sm = shard_map_fn()
+        if mesh is None:
+            mesh = mesh_from_topology(topology)
+        axis_names = list(mesh.axis_names)
+        shape = list(mesh.devices.shape)
+        sizes = dict(zip(axis_names, shape))
+        strides = _row_major_strides(shape)
+        n = int(np.prod(shape))
+        slow = dead = None
+        if inject_slow_link is not None:
+            slow = _parse_link_spec(inject_slow_link, sizes, "inject_slow_link")
+        if inject_dead_link is not None:
+            dead = _parse_link_spec(inject_dead_link, sizes, "inject_dead_link")
+        legs = [
+            (nm, h, pos)
+            for pos, nm in enumerate(axis_names)
+            if sizes[nm] > 1
+            for h in range(sizes[nm])
+        ]
+        report = MeshLinkReport(
+            ok=True,
+            degraded=False,
+            n_devices=n,
+            topology=topology if parse_topology(topology) else None,
+            n_links=len(legs),
+        )
+        if not legs:
+            report.latency_us = (time.perf_counter() - t_sweep) * 1e6
+            return report
+
+        col = jnp.arange(payload, dtype=jnp.float32)
+        col_np = np.arange(payload, dtype=np.float32)
+        rep = NamedSharding(mesh, P())
+        # Global row r of every timed output = device r's (1, payload) shard,
+        # row-major over the mesh axes — the same linearization the payload
+        # itself encodes.
+        out_spec = P(tuple(axis_names), None)
+        measured: Dict[str, dict] = {}
+        for nm, h, pos in legs:
+            h_next = (h + 1) % sizes[nm]
+
+            def _hop(nm=nm, h=h, h_next=h_next, pos=pos):
+                idxs, lin = _linear_index(axis_names, strides)
+                local = lin + col[None, :]
+                out = jax.lax.ppermute(local, nm, [(h, h_next)])
+                if dead == (nm, h):
+                    out = jnp.where(idxs[pos] == h_next, out + 1.0, out)
+                return out
+
+            timed = jax.jit(sm(_hop, mesh=mesh, in_specs=(), out_specs=out_spec))
+            # Host-side oracle: the receiver row holds the sender's payload
+            # verbatim, every non-receiver row the ppermute-filled zeros.
+            expect = np.zeros((n, payload), dtype=np.float32)
+            for r in range(n):
+                if (r // strides[pos]) % sizes[nm] == h_next:
+                    sender = r + (h - h_next) * strides[pos]
+                    expect[r] = float(sender) + col_np
+            check = jax.jit(
+                lambda o, e=jnp.asarray(expect): jnp.sum(
+                    (jnp.abs(o - e) > 1e-3).astype(jnp.int32)
+                ),
+                out_shardings=rep,
+            )
+            first = timed()  # compile + verification input
+            mismatches = int(check(first))
+            samples = []
+            for _ in range(max(1, hop_iters)):
+                t0 = time.perf_counter()
+                out = timed()
+                jax.block_until_ready(out)
+                samples.append((time.perf_counter() - t0) * 1e6)
+            if slow == (nm, h):
+                samples = [s * slow_inflation for s in samples]
+            measured[f"{nm}/{h}"] = {
+                "p50_us": _quantile(samples, 0.5),
+                "p99_us": _quantile(samples, 0.99),
+                "mismatches": mismatches,
+            }
+
+        # Grade AFTER the whole sweep: the budget derives from the sweep's
+        # own median, so one sick leg cannot move its own yardstick.
+        baseline = _quantile([m["p50_us"] for m in measured.values()], 0.5)
+        budget_us = max(BUDGET_FLOOR_US, SLOW_FACTOR * baseline)
+        for link, m in measured.items():
+            if m["mismatches"] or m["p50_us"] > hop_deadline_us:
+                verdict = DEAD
+            elif m["p50_us"] > budget_us:
+                verdict = SLOW
+            else:
+                verdict = OK
+            report.links[link] = {
+                "verdict": verdict,
+                "p50_us": round(m["p50_us"], 1),
+                "p99_us": round(m["p99_us"], 1),
+                "budget_us": round(budget_us, 1),
+            }
+            if verdict == SLOW:
+                report.slow.append(link)
+            elif verdict == DEAD:
+                report.dead.append(link)
+        report.degraded = bool(report.slow)
+        if report.dead:
+            report.ok = False
+            report.error = (
+                f"mesh link sweep: {len(report.dead)} dead link leg(s): "
+                f"{', '.join(report.dead)}"
+            )
+        report.latency_us = (time.perf_counter() - t_sweep) * 1e6
+        return report
+    except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)
+        return MeshLinkReport(
+            ok=False,
+            degraded=False,
+            n_devices=0,
+            topology=topology,
+            n_links=0,
+            latency_us=(time.perf_counter() - t_sweep) * 1e6,
+            error=f"{type(exc).__name__}: {exc}",
+        )

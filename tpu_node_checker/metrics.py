@@ -149,7 +149,13 @@ Exported metric families:
 * ``tpu_node_checker_federation_lease_total{result}`` /
   ``tpu_node_checker_federation_fleet_budget_remaining`` — the
   ``--federate`` aggregator's disruption-lease traffic (granted permits
-  vs denied requests) and the fleet budget's remaining permits.
+  vs denied requests) and the fleet budget's remaining permits;
+* ``tpu_node_checker_mesh_link_duration_us{slice,axis}`` — NATIVE
+  histogram of per-link ICI sweep p50 from ``--probe-level mesh``, in
+  MICROSECONDS (the tree's one ``_us`` family — link legs are two orders
+  of magnitude under the millisecond ladder), one sample per distinct
+  link per round, labeled by slice budget-domain and mesh axis: the
+  scrape-side view of a link drifting toward its SLOW budget.
 
 This docstring is the package's metric index: tnc-lint's
 ``drift-readme-metrics`` rule (TNC202) fails CI when a family is emitted

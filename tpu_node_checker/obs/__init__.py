@@ -28,7 +28,11 @@ The histogram families this layer owns:
   cost (``phase="total"`` is the whole round: the production-side
   counterpart of BENCH_r06/r09's steady-round assertions);
 * ``tpu_node_checker_federation_fetch_duration_ms{cluster}`` — per-cluster
-  upstream fetch cost in the aggregator tier.
+  upstream fetch cost in the aggregator tier;
+* ``tpu_node_checker_mesh_link_duration_us{slice,axis}`` — per-link ICI
+  sweep p50 from the mesh probe level (``--probe-level mesh``),
+  microseconds-denominated: a drifting link tail shows up here rounds
+  before the per-hop deadline grades it SLOW.
 
 (The fleet API's ``tpu_node_checker_api_server_request_duration_ms{route}``
 family lives in ``server/app.ServerStats`` — always on, obs or not.)
@@ -42,6 +46,7 @@ from typing import List, Optional
 from tpu_node_checker.obs.events import EventLog
 from tpu_node_checker.obs.hist import (
     DEFAULT_LATENCY_BUCKETS_MS,
+    MESH_LINK_BUCKETS_US,
     HistogramFamily,
 )
 from tpu_node_checker.obs.trace import Tracer, TraceRing
@@ -82,7 +87,17 @@ class Observability:
             DEFAULT_LATENCY_BUCKETS_MS,
             label="cluster",
         )
-        self._families = [self.round_phases, self.federation_fetch]
+        self.mesh_links = HistogramFamily(
+            "tpu_node_checker_mesh_link_duration_us",
+            "Per-link ICI sweep p50 from the mesh probe level, in "
+            "MICROSECONDS (the one _us family) — one sample per link per "
+            "round, labeled by slice domain and mesh axis.",
+            MESH_LINK_BUCKETS_US,
+            label=("slice", "axis"),
+        )
+        self._families = [
+            self.round_phases, self.federation_fetch, self.mesh_links
+        ]
         # phase name -> dedicated Histogram recorder.  complete() runs on
         # the ONE round-driver thread, so it can skip record()'s
         # thread-local hop entirely — the steady watch round is ~15µs all
@@ -126,6 +141,18 @@ class Observability:
         self.ring.push(tracer)
         return tracer
 
+    def record_mesh_links(self, samples) -> None:
+        """Feed one round's mesh link sweep into the per-link histogram.
+        ``samples`` is an iterable of ``(slice_domain, axis, p50_us)``
+        triples (the checker derives them from each node's
+        ``collective_legs_ok.links`` block).  Runs on the round-driver
+        thread; record()'s thread-local hop makes that cheap, and label
+        cardinality is bounded by slices × mesh axes, not by hop."""
+        for slice_domain, axis, p50_us in samples:
+            self.mesh_links.record(
+                float(p50_us), (str(slice_domain), str(axis))
+            )
+
     def prometheus_lines(self) -> List[str]:
         """Scrape-time render of every family with data.  Merging reads
         the recorder lists without locks (TNC011: this runs on the serve
@@ -140,6 +167,7 @@ class Observability:
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS_MS",
     "DEFAULT_RING_SIZE",
+    "MESH_LINK_BUCKETS_US",
     "EventLog",
     "HistogramFamily",
     "Observability",

@@ -20,9 +20,11 @@ derive a p99 from that, and the BENCH_r07/r08 tail-latency targets
   :meth:`~HistogramFamily.merged` and
   :meth:`~HistogramFamily.prometheus_lines`).
 
-Naming discipline (tnc-lint TNC017): every family name ends ``_ms`` and
-every instantiation declares its buckets explicitly — an implicit default
-silently mis-buckets the next metric measured in seconds.
+Naming discipline (tnc-lint TNC017): every family name carries an
+explicit unit suffix (``_ms``, or ``_us`` for the microsecond-scale mesh
+link timings) and every instantiation declares its buckets explicitly —
+an implicit default silently mis-buckets the next metric measured in
+seconds.
 """
 
 from __future__ import annotations
@@ -39,6 +41,15 @@ from typing import Dict, List, Optional, Tuple
 DEFAULT_LATENCY_BUCKETS_MS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
     100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+)
+
+# Microsecond ladder for the mesh link sweep: a healthy ICI hop sits in
+# the tens-to-hundreds of µs, a SLOW grade lands just past its budget
+# (``max(BUDGET_FLOOR_US, SLOW_FACTOR × baseline)``), and the 1 s tail
+# catches a leg rescued from a hang by the hop deadline.  +Inf implicit.
+MESH_LINK_BUCKETS_US = (
+    10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+    10000.0, 50000.0, 250000.0, 1000000.0,
 )
 
 
@@ -100,13 +111,17 @@ class HistogramFamily:
     """One metric family (optionally labeled), merged across per-thread
     recorders at scrape time.
 
-    ``label`` names the ONE label key (``phase``, ``route``, ``cluster``);
-    ``None`` makes the family label-free.  Buckets are declared per family
-    — TNC017 rejects an instantiation that omits them.
+    ``label`` names the label key (``phase``, ``route``, ``cluster``) — or
+    a TUPLE of keys (``("slice", "axis")``) for a multi-label family, in
+    which case every ``label_value`` passed to :meth:`record` must be a
+    same-length tuple of values.  ``None`` makes the family label-free.
+    Buckets are declared per family — TNC017 rejects an instantiation
+    that omits them.
     """
 
     def __init__(self, name: str, help_text: str,
-                 buckets: Tuple[float, ...], label: Optional[str] = None):
+                 buckets: Tuple[float, ...],
+                 label: Optional[object] = None):
         self.name = name
         self.help_text = help_text
         self.buckets = tuple(buckets)
@@ -206,7 +221,12 @@ class HistogramFamily:
             f"# TYPE {self.name} histogram",
         ]
         for label_value, (counts, total, count) in sorted(merged.items()):
-            base = {self.label: label_value} if self.label else {}
+            if not self.label:
+                base = {}
+            elif isinstance(self.label, tuple):
+                base = dict(zip(self.label, label_value))
+            else:
+                base = {self.label: label_value}
             cumulative = 0
             for bound, n in zip(self.buckets, counts):
                 cumulative += n

@@ -125,6 +125,27 @@ class Tracer:
         """PhaseTimer-compatible alias of :meth:`span`."""
         return _Span(self, name, None)
 
+    def record_timed_span(self, name: str, dur_ms: float, **args) -> None:
+        """Backfill a span whose duration was measured ELSEWHERE (the mesh
+        probe child times each ICI link leg in-process and ships the
+        numbers home in its report — re-timing them here would measure
+        nothing).  The span lands at the tracer's current elapsed offset,
+        back-dated by its duration, one nesting level below top.  It is
+        deliberately NOT folded into :attr:`phases`: phase names feed the
+        per-phase histogram and the payload ``timings`` block, and
+        per-link names there would be unbounded-cardinality."""
+        now_ms = (time.perf_counter() - self._start) * 1e3
+        start_ms = max(0.0, now_ms - float(dur_ms))
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = len(self._tids) + 1
+                self._tids[ident] = tid
+            self.spans.append(
+                (name, start_ms, float(dur_ms), 1, tid, args or None)
+            )
+
     def _record(self, span: _Span, t1: float) -> None:
         tls = self._tls
         tls.depth = max(0, getattr(tls, "depth", 1) - 1)

@@ -211,6 +211,42 @@ def collective_probe(
                 (2 * (n - 1) / n * local_bytes) / (latency_us * 1e-6) / 1e9, 3
             )
 
+        # Per-leg attribution: the combined program's wall clock cannot say
+        # WHICH collective is slow, so each leg is re-timed as its own
+        # program.  Compiled after the verdict and the combined timing, so
+        # busbw_gbps keeps its meaning (the all-three figure) and the
+        # verdict still covers exactly the program measured above.
+        def _psum_leg():
+            i = jax.lax.axis_index("d").astype(jnp.float32)
+            return jax.lax.psum(i + col[None, :], "d")
+
+        def _gather_leg():
+            i = jax.lax.axis_index("d").astype(jnp.float32)
+            return jax.lax.all_gather(i + col[None, :], "d", tiled=True)
+
+        def _scatter_leg():
+            i = jax.lax.axis_index("d").astype(jnp.float32)
+            contrib = jnp.broadcast_to(i + col[None, :], (n, payload))
+            return jax.lax.psum_scatter(
+                contrib, "d", scatter_dimension=0, tiled=True
+            )
+
+        leg_latency_us = {}
+        for leg_name, body, spec in (
+            ("psum", _psum_leg, P()),
+            ("all_gather", _gather_leg, P("d")),
+            ("reduce_scatter", _scatter_leg, P("d")),
+        ):
+            leg_fn = jax.jit(sm(body, mesh=mesh, in_specs=(), out_specs=spec))
+            leg_out = leg_fn()  # compile + first pass
+            t1 = time.perf_counter()
+            for _ in range(timed_iters):
+                leg_out = leg_fn()
+            jax.block_until_ready(leg_out)
+            leg_latency_us[leg_name] = round(
+                (time.perf_counter() - t1) / timed_iters * 1e6, 1
+            )
+
         ok = sum_ok and gather_ok and scatter_ok
         return CollectiveResult(
             ok=ok,
@@ -227,6 +263,7 @@ def collective_probe(
                 "all_gather_ok": gather_ok,
                 "reduce_scatter_ok": scatter_ok,
                 "busbw_gbps": busbw_gbps,
+                "leg_latency_us": leg_latency_us,
             },
         )
     except Exception as exc:  # tnc: allow-broad-except(probes report, never raise)

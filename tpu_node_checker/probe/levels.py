@@ -8,13 +8,15 @@ Single source of truth: :mod:`.liveness` imports from here.
 
 from __future__ import annotations
 
-LEVELS = ("enumerate", "compute", "collective", "workload")
+LEVELS = ("enumerate", "compute", "collective", "mesh", "workload")
 # Per-level wall-clock budgets: each level compiles and runs strictly more
-# programs (first jit compile on TPU alone is ~20-40 s).
+# programs (first jit compile on TPU alone is ~20-40 s).  "mesh" adds one
+# jitted single-pair ppermute per ICI link leg on top of "collective".
 LEVEL_TIMEOUTS_S = {
     "enumerate": 30.0,
     "compute": 180.0,
     "collective": 300.0,
+    "mesh": 450.0,
     "workload": 600.0,
 }
 DEFAULT_TIMEOUT_S = LEVEL_TIMEOUTS_S["enumerate"]

@@ -19,6 +19,10 @@ Probe levels (each includes the previous):
 * ``collective`` — psum/all_gather/reduce-scatter and a ppermute ring walk
                    over all local chips (:mod:`tpu_node_checker.parallel`),
                    exercising ICI;
+* ``mesh``       — the mesh link doctor (:mod:`tpu_node_checker.meshprobe`):
+                   every ICI link leg timed individually with a per-link
+                   ``OK | SLOW | DEAD`` verdict; SLOW legs degrade the node
+                   (``mesh_degraded``) without failing it;
 * ``workload``   — a sharded transformer training step plus ring-attention
                    (sp), pipeline (pp) and expert-parallel all_to_all (ep)
                    passes (:mod:`tpu_node_checker.models`,
@@ -185,11 +189,12 @@ try:
     # failure reads as a hardware fault (and --cordon-failed would act on
     # it) with nothing tying it to the injection.
     _CHAOS_VARS = {
-        "collective_leg": ("TNC_CHAOS_COLLECTIVE_LEG", ("collective", "workload")),
-        "ring_link": ("TNC_CHAOS_RING_LINK", ("collective", "workload")),
-        "axis": ("TNC_CHAOS_AXIS", ("collective", "workload")),
-        "slices": ("TNC_CHAOS_SLICES", ("collective", "workload")),
-        "throttle": ("TNC_CHAOS_THROTTLE", ("compute", "collective", "workload")),
+        "collective_leg": ("TNC_CHAOS_COLLECTIVE_LEG", ("collective", "mesh", "workload")),
+        "ring_link": ("TNC_CHAOS_RING_LINK", ("collective", "mesh", "workload")),
+        "axis": ("TNC_CHAOS_AXIS", ("collective", "mesh", "workload")),
+        "slices": ("TNC_CHAOS_SLICES", ("collective", "mesh", "workload")),
+        "slow_link": ("TNC_CHAOS_SLOW_LINK", ("mesh", "workload")),
+        "throttle": ("TNC_CHAOS_THROTTLE", ("compute", "collective", "mesh", "workload")),
     }
     chaos = {}
     for key, (var, _lv) in _CHAOS_VARS.items():
@@ -204,11 +209,11 @@ try:
             raise ValueError(
                 f"{', '.join(bad)} set but probe level {level!r} never runs "
                 "the injected surface (collective legs need --probe-level "
-                "collective+, the throttle needs compute+) — the injection "
-                "would silently test nothing; raise the level or unset the "
-                "chaos vars"
+                "collective+, the mesh link sweep needs mesh+, the throttle "
+                "needs compute+) — the injection would silently test "
+                "nothing; raise the level or unset the chaos vars"
             )
-    if level in ("compute", "collective", "workload") and out["ok"]:
+    if level in ("compute", "collective", "mesh", "workload") and out["ok"]:
         from tpu_node_checker.ops import (
             hbm_bandwidth_probe,
             matmul_burn,
@@ -313,7 +318,7 @@ try:
             )
             out["soak"] = soak.to_dict()
             out["ok"] = out["ok"] and soak.ok
-    if level in ("collective", "workload") and out["ok"]:
+    if level in ("collective", "mesh", "workload") and out["ok"]:
         from tpu_node_checker.parallel import collective_probe, ring_probe
         # chaos was read (and stamped) unconditionally above; typo'd leg/axis
         # names fail loudly downstream (the probes validate their
@@ -330,13 +335,20 @@ try:
         out["collective_ok"] = coll.ok
         out["collective_latency_us"] = round(coll.latency_us, 1)
         out["collective_busbw_gbps"] = (coll.details or {}).get("busbw_gbps")
+        # Per-leg verdicts AND per-leg timings: a psum-only failure and an
+        # all-legs failure point at different fabric subgraphs, and a leg
+        # can be correct but slow.  Emitted on any failure (the long-
+        # standing triage block, now with the timing backfill) and ALWAYS
+        # at mesh level and above, where the links sub-block rides in it.
+        _legs_block = {
+            k: (coll.details or {}).get(k)
+            for k in ("psum_ok", "all_gather_ok", "reduce_scatter_ok")
+        }
+        for _lk, _lv in ((coll.details or {}).get("leg_latency_us") or {}).items():
+            _legs_block[f"{_lk}_latency_us"] = _lv
+        if not coll.ok or level in ("mesh", "workload"):
+            out["collective_legs_ok"] = _legs_block
         if not coll.ok:
-            # Per-leg verdicts for triage: a psum-only failure and an
-            # all-legs failure point at different fabric subgraphs.
-            out["collective_legs_ok"] = {
-                k: (coll.details or {}).get(k)
-                for k in ("psum_ok", "all_gather_ok", "reduce_scatter_ok")
-            }
             out["collective_err"] = coll.error
         ring = ring_probe(inject_fault_link=chaos.get("ring_link"))
         out["ring_ok"] = ring.ok
@@ -452,7 +464,32 @@ try:
             if bw_err:
                 out["ok"] = False
                 out["axis_busbw_err"] = bw_err
-    if level in ("compute", "collective", "workload"):
+    if level in ("mesh", "workload") and out["ok"]:
+        # Mesh link doctor: every ICI link leg timed individually, each
+        # with its own OK | SLOW | DEAD verdict under a topology-derived
+        # name (axis/hop; the aggregator prefixes the slice domain).  A
+        # DEAD leg fails the probe; a SLOW one DEGRADES it -- ok stays
+        # True (the exit-code contract holds) and mesh_degraded carries
+        # the evidence for the history FSM and the budget engine.
+        from tpu_node_checker.meshprobe import mesh_link_sweep
+        sweep = mesh_link_sweep(
+            topology=os.environ.get("TNC_TOPOLOGY"),
+            inject_slow_link=chaos.get("slow_link"),
+        )
+        out["mesh_ok"] = sweep.ok
+        out["mesh_degraded"] = sweep.degraded
+        out["mesh_n_links"] = sweep.n_links
+        out["mesh_latency_us"] = round(sweep.latency_us, 1)
+        if sweep.slow:
+            out["mesh_slow_links"] = sweep.slow
+        if sweep.dead:
+            out["mesh_dead_links"] = sweep.dead
+        out.setdefault("collective_legs_ok", {})["links"] = sweep.links
+        if sweep.error:
+            out["mesh_err"] = sweep.error
+        if not sweep.ok:
+            _append_error(sweep.error or "mesh link sweep failed")
+    if level in ("compute", "collective", "mesh", "workload"):
         # Performance floors: grade the measured figures against what this
         # device kind should deliver (tpu_node_checker.probe.floors) — a
         # throttled chip that aces every numerics gate must still fail.

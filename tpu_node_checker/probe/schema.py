@@ -149,13 +149,45 @@ REPORT_SPEC: dict = {
     "ring_link_gbps": _NUM_OR_NULL,
     "ring_bad_links": ["str"],
     "ring_err": "str",
-    # Values are bool OR null: a collective probe that CRASHED before
-    # producing per-leg verdicts emits {psum_ok: None, ...} ((coll.details
-    # or {}).get(k) in liveness.py) — that failed-probe report must still
-    # attach and degrade the host, not be refused as a schema violation
-    # (which would silently grade the host HEALTHY).
-    "collective_legs_ok": {"__values__": ("bool", "null")},
+    # Verdict values are bool OR null: a collective probe that CRASHED
+    # before producing per-leg verdicts emits {psum_ok: None, ...}
+    # ((coll.details or {}).get(k) in liveness.py) — that failed-probe
+    # report must still attach and degrade the host, not be refused as a
+    # schema violation (which would silently grade the host HEALTHY).
+    # The block additionally carries per-leg timings (the collective-level
+    # backfill) and, at mesh level, the per-link "links" sub-block from the
+    # mesh link doctor; unknown keys stay on the old bool|null contract.
+    "collective_legs_ok": {
+        "__keys__": {
+            "psum_ok": ("bool", "null"),
+            "all_gather_ok": ("bool", "null"),
+            "reduce_scatter_ok": ("bool", "null"),
+            "psum_latency_us": _NUM_OR_NULL,
+            "all_gather_latency_us": _NUM_OR_NULL,
+            "reduce_scatter_latency_us": _NUM_OR_NULL,
+            "links": {
+                "__values__": {
+                    "__keys__": {
+                        "verdict": "str",
+                        "p50_us": "number",
+                        "p99_us": "number",
+                        "budget_us": "number",
+                    }
+                }
+            },
+        },
+        "__values__": ("bool", "null"),
+    },
     "collective_err": "str",
+    # -- mesh (link doctor): SLOW legs degrade without failing; only a
+    # DEAD leg (or a sweep crash) turns mesh_ok False.
+    "mesh_ok": "bool",
+    "mesh_degraded": "bool",
+    "mesh_n_links": "int",
+    "mesh_latency_us": "number",
+    "mesh_slow_links": ["str"],
+    "mesh_dead_links": ["str"],
+    "mesh_err": "str",
     "chaos_injected": {"__values__": "str"},
     # The per-axis legs emit null for verdict/topology when the leg itself
     # crashed before producing one ((ax.details or {}).get(...) in

@@ -213,21 +213,31 @@ class BudgetEngine:
         self._round_budget_used = 0  # disruptive grants this round
         self._round_granted: set = set()  # node names granted cordon/drain
         self._predictions: set = set()  # analytics changepoint suspects
+        self._degraded: Dict[str, list] = {}  # node -> slow ICI links
         self.repairs: Optional[dict] = None  # repair.py stamps its roll-up
 
     # -- round lifecycle -----------------------------------------------------
 
     def begin_round(self, accel: List, trace_id: Optional[str] = None,
-                    predictions: Optional[set] = None) -> None:
+                    predictions: Optional[set] = None,
+                    degraded: Optional[Dict[str, list]] = None) -> None:
         """``predictions`` (the analytics tier's standing changepoint
         set, ``--analytics``) is the budget view's early-warning input:
         surfaced per domain in :meth:`payload_block` so the repair
         scheduler sees which domains are PREDICTED to degrade before the
         FSM condemns a single node in them.  It never relaxes a refusal
-        and never grants anything — prediction informs, evidence gates."""
+        and never grants anything — prediction informs, evidence gates.
+
+        ``degraded`` (node → its slice-qualified SLOW ICI links, the mesh
+        link doctor's standing DEGRADED evidence) is the second informing
+        input: surfaced per domain the same way, and consumed by the
+        ``--cordon-degraded`` drain path — whose every PATCH still rides
+        :meth:`decide`, so a sick-link drain obeys the same floor/budget/
+        lease ladder as any failure-driven cordon."""
         self._accel = list(accel)
         self._trace_id = trace_id
         self._predictions = set(predictions or ())
+        self._degraded = dict(degraded or {})
         self._round_denials = []
         self._round_budget_used = 0
         self._round_granted = set()
@@ -438,6 +448,23 @@ class BudgetEngine:
             block["prediction"] = {
                 "suspects": sorted(self._predictions),
                 "domains": predicted_domains,
+            }
+        if self._degraded:
+            # The DEGRADED-link input (--probe-level mesh): nodes whose
+            # chips pass but whose slice carries a SLOW ICI link, with the
+            # offending links by name — what --cordon-degraded acts on and
+            # what a repair scheduler reads to drain a slice BEFORE its
+            # chips die.
+            block["degraded"] = {
+                "nodes": sorted(self._degraded),
+                "links": sorted({
+                    link for links in self._degraded.values() for link in links
+                }),
+                "domains": sorted({
+                    d for n in self._accel
+                    if n.name in self._degraded
+                    and (d := self.domain_of(n)) is not None
+                }),
             }
         if self.slice_floor_pct is not None:
             block["slice_floor_pct"] = self.slice_floor_pct

@@ -112,19 +112,59 @@ class SimWorld:
         os.makedirs(path, exist_ok=True)
         return path
 
-    def write_reports(self, cluster: str, verdicts: Dict[str, bool]) -> str:
+    def write_reports(self, cluster: str, verdicts: Dict[str, bool],
+                      degraded: Optional[Dict[str, str]] = None) -> str:
         """Per-host probe reports for one round.  ``written_at`` is REAL
         wall time (via the clock seam) because the checker grades report
-        freshness against the real clock; it never enters the report."""
+        freshness against the real clock; it never enters the report.
+
+        ``degraded`` (``host -> slow link name``, from
+        :meth:`~tpu_node_checker.sim.fleet.SimCluster.degraded`) upgrades
+        those hosts' reports to mesh level: a PASSING report whose link
+        matrix grades exactly that link SLOW — the same shape the real
+        probe child emits under its ``TNC_CHAOS_SLOW_LINK`` chaos hook
+        (pinned by the test_probe chaos tests), replayed here without the
+        jax process so the scenario stays deterministic and fast."""
         path = self.reports_dir(cluster)
         for host, ok in verdicts.items():
-            doc = {
-                "ok": ok,
-                "level": "compute",
-                "hostname": host,
-                "written_at": wall_now(),
-                "error": None if ok else "simulated chip fault",
-            }
+            slow = (degraded or {}).get(host)
+            if ok and slow is not None:
+                links = {
+                    leg: {"verdict": "OK", "p50_us": 50.0, "p99_us": 60.0,
+                          "budget_us": 400.0}
+                    for leg in ("t0/0", "t0/1", "t1/0", "t1/1")
+                }
+                links[slow] = {"verdict": "SLOW", "p50_us": 900.0,
+                               "p99_us": 950.0, "budget_us": 400.0}
+                doc = {
+                    "ok": True,
+                    "level": "mesh",
+                    "hostname": host,
+                    "written_at": wall_now(),
+                    "error": None,
+                    "mesh_ok": True,
+                    "mesh_degraded": True,
+                    "mesh_n_links": len(links),
+                    "mesh_latency_us": 900.0,
+                    "mesh_slow_links": [slow],
+                    "collective_legs_ok": {
+                        "psum_ok": True,
+                        "all_gather_ok": True,
+                        "reduce_scatter_ok": True,
+                        "psum_latency_us": 11.0,
+                        "all_gather_latency_us": 12.0,
+                        "reduce_scatter_latency_us": 13.0,
+                        "links": links,
+                    },
+                }
+            else:
+                doc = {
+                    "ok": ok,
+                    "level": "compute",
+                    "hostname": host,
+                    "written_at": wall_now(),
+                    "error": None if ok else "simulated chip fault",
+                }
             with open(os.path.join(path, f"{host}.json"), "w",
                       encoding="utf-8") as fh:
                 json.dump(doc, fh)
@@ -188,17 +228,22 @@ class SimWorld:
                 for t in ((result.payload.get("history") or {})
                           .get("transitions") or [])
             ]
-            predictions = sorted(
-                p["node"]
-                for p in (result.payload.get("analytics") or {}).get(
-                    "predictions"
-                ) or []
-            )
+            preds = (result.payload.get("analytics") or {}).get(
+                "predictions"
+            ) or []
+            # The shared predictions list carries two channels: flip
+            # detections keyed by "node", link-drift firings keyed by
+            # "link" — record each under its own key.
+            predictions = sorted(p["node"] for p in preds if "node" in p)
+            link_predictions = sorted(p["link"] for p in preds
+                                      if "link" in p)
             if predictions:
                 # Node names only: scores are deterministic too, but the
                 # record keeps the minimal ground truth the invariant
                 # reads (bucket/timestamp fields must never leak in).
                 record["predictions"] = predictions
+            if link_predictions:
+                record["link_predictions"] = link_predictions
             record["trace_ok"] = bool(
                 result.payload.get("trace_id") == tracer.trace_id
                 and "detect" in tracer.as_dict()
@@ -222,7 +267,7 @@ class SimWorld:
         if record.get("error"):
             parts.append(f"error={record['error']}")
         for key in ("sick", "denials", "transitions", "predictions",
-                    "patches"):
+                    "link_predictions", "patches"):
             values = record.get(key)
             if values:
                 parts.append(f"{key}={','.join(values)}")

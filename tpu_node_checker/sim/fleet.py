@@ -22,6 +22,14 @@ Failure programs (per node):
 * ``("kubelet-down-at", r)`` — the NODE goes NotReady at round ``r``
   (torn slices): the probe verdict stays True — the kubelet, not the
   chips, is the story.
+* ``("torn-link", r)`` — from round ``r`` the host's mesh sweep grades
+  one ICI link SLOW: the chips PASS (verdict stays True, the host is
+  never ``down()``), but its probe report is mesh-level with
+  ``mesh_degraded`` set — the DEGRADED evidence class, which must never
+  feed condemnation.  :meth:`SimCluster.degraded` names the slow link
+  deterministically (``t1/<host index>``: the host's position in its
+  slice is its hop on the ``t1`` ring), so the scenario's oracle and
+  the checker-side evidence can be compared byte for byte.
 """
 
 from __future__ import annotations
@@ -118,6 +126,18 @@ class SimCluster:
                 out[name] = round_i < prog[1]
             else:
                 out[name] = True
+        return out
+
+    def degraded(self, round_i: int) -> Dict[str, str]:
+        """Hosts whose ``torn-link`` program is active this round, mapped
+        to the name of their slow ICI link (``t1/<host index>``).  These
+        hosts keep a True verdict and never enter :meth:`down` — degraded
+        capacity is not lost capacity, the whole point of the class."""
+        out: Dict[str, str] = {}
+        for name in self.node_names():
+            prog = self.programs[name]
+            if prog[0] == "torn-link" and round_i >= prog[1]:
+                out[name] = f"t1/{int(name.rsplit('-h', 1)[1])}"
         return out
 
     def down(self, round_i: int) -> set:

@@ -3,7 +3,8 @@
 The hand-written scenarios prove a LIST of failure shapes; the fuzzer
 searches the SPACE.  :func:`sample_program` draws a whole-fleet failure
 assignment from the per-node program grammar (``steady`` / ``flap`` /
-``flap-until`` / ``fail-at`` / ``kubelet-down-at``) plus rng-drawn API
+``flap-until`` / ``fail-at`` / ``kubelet-down-at`` / ``torn-link``)
+plus rng-drawn API
 fault schedules (burst or blackout rounds) and watch-loss injections,
 all from one seeded ``random.Random`` — same seed, same program, byte
 for byte (tnc-lint TNC020).  :func:`run_program` drives the sampled
@@ -28,7 +29,12 @@ collects it.
 A program may also carry ``"sabotage": {"round": R}`` — the deliberate
 over-budget fleet-wide cordon from the acceptance tests — which is how
 the shrinker itself is tested: the matrix must catch it, name it, and
-shrink everything else away.
+shrink everything else away.  ``{"round": R, "kind":
+"uncordon-degraded"}`` is the mesh-era sibling: an out-of-band uncordon
+of every drained ``torn-link`` host, which un-drains the sick slice
+behind the budget engine's back and must turn ``degraded-drain`` red —
+the checked-in ``torn-link`` reproducer pins that the invariant keeps
+biting.
 """
 
 from __future__ import annotations
@@ -55,12 +61,13 @@ REPRODUCER_KIND = "tnc-sim-reproducer"
 REPRODUCER_SCHEMA = 1
 
 # Invariants every fuzzed program is graded against (relist-economy joins
-# when the program injects watch losses).
+# when the program injects watch losses; degraded-not-condemned and
+# degraded-drain join when it draws a torn-link program).
 FUZZ_INVARIANTS = ("exit-code-contract", "disruption-budget", "slice-floor",
                    "fsm-legality", "trace-completeness")
 
 _PROGRAM_ARITY = {"steady": 1, "flap": 3, "flap-until": 4, "fail-at": 2,
-                  "kubelet-down-at": 2}
+                  "kubelet-down-at": 2, "torn-link": 2}
 
 
 # ---------------------------------------------------------------------------
@@ -87,7 +94,7 @@ def sample_program(seed: int) -> dict:
             if rng.random() >= 0.25:
                 continue
             kind = rng.choice(("flap", "flap-until", "fail-at",
-                               "kubelet-down-at"))
+                               "kubelet-down-at", "torn-link"))
             if kind == "flap":
                 period = rng.choice((2, 3))
                 programs[node] = ["flap", rng.randrange(period), period]
@@ -97,6 +104,8 @@ def sample_program(seed: int) -> dict:
                                   rng.randint(2, rounds - 2)]
             elif kind == "fail-at":
                 programs[node] = ["fail-at", rng.randint(1, rounds - 1)]
+            elif kind == "torn-link":
+                programs[node] = ["torn-link", rng.randint(1, rounds - 1)]
             else:
                 programs[node] = ["kubelet-down-at", rng.randint(1, rounds - 1)]
     api_faults: Dict[str, object] = {}
@@ -233,9 +242,16 @@ def _program_runner(world, program: dict) -> None:
     flags = [
         "--strict-slices",
         "--history", world.history_path("c0"),
-        "--cordon-after", "2", "--cordon-failed", "--cordon-max", "8",
+        "--cordon-after", "2", "--cordon-failed", "--cordon-degraded",
+        "--cordon-max", "8",
         "--slice-floor-pct", "50", "--disruption-budget", "2",
     ]
+    # torn-link ground truth: hosts whose link tears inside the run —
+    # they keep passing verdicts (never in down()), so the exit-code
+    # oracle ignores them; the degraded invariants below do not.
+    torn = sorted(n for n, prog in cluster.programs.items()
+                  if prog[0] == "torn-link" and prog[1] < rounds)
+    patch_timeline: List[List[str]] = []
     for r in range(rounds):
         fault = api_faults.get(r)
         blackout = fault == "blackout"
@@ -254,7 +270,8 @@ def _program_runner(world, program: dict) -> None:
             nd["status"]["conditions"] = fx.make_node(
                 nm, ready=not cluster._kubelet_down(nm, r)
             )["status"]["conditions"]
-        reports = world.write_reports("c0", cluster.verdicts(r))
+        reports = world.write_reports("c0", cluster.verdicts(r),
+                                      degraded=cluster.degraded(r))
         if blackout:
             expected.append(checker.EXIT_ERROR)
         else:
@@ -272,13 +289,24 @@ def _program_runner(world, program: dict) -> None:
             argv = _base_argv(kc, reports, *flags)
         _result, rec = world.checker_round(argv, r, "sim-c0")
         if sabotage and r == int(sabotage["round"]):
-            # Deliberate violation (tests only): cordon every remaining
-            # host behind the budget engine's back.
-            for host in sorted(cluster.node_names()):
-                if host not in _cordoned(state):
-                    _sabotage_patch(port, host)
-            world.event(f"sabotage round={r} over-budget fleet-wide")
+            if sabotage.get("kind") == "uncordon-degraded":
+                # Deliberate violation (tests only): resurrect every
+                # drained torn-link host behind the budget engine's back
+                # — the degraded-drain invariant must notice the slice
+                # is no longer drained.
+                for host in sorted(cluster.degraded(r)):
+                    if host in _cordoned(state):
+                        _sabotage_patch(port, host, unschedulable=False)
+                world.event(f"sabotage round={r} uncordon-degraded")
+            else:
+                # Deliberate violation (tests only): cordon every
+                # remaining host behind the budget engine's back.
+                for host in sorted(cluster.node_names()):
+                    if host not in _cordoned(state):
+                        _sabotage_patch(port, host)
+                world.event(f"sabotage round={r} over-budget fleet-wide")
         rec["patches"] = _patch_names(state, before)
+        patch_timeline.append(rec["patches"])
         patches_per_round.append(len(rec["patches"]))
         floor_timeline.append(fx.available_by_slice(
             cluster.by_slice, cluster.chips_per_host, state["nodes"]
@@ -291,6 +319,12 @@ def _program_runner(world, program: dict) -> None:
     world.grade(inv.check_disruption_budget(patches_per_round, 2))
     world.grade(inv.check_slice_floor(floor_timeline, floor_chips))
     world.grade(inv.check_fsm_legality(world.records))
+    if torn:
+        # The degraded invariants join only when the grammar drew a
+        # torn-link program — same pattern as relist-economy below.
+        world.grade(inv.check_degraded_not_condemned(world.records, torn))
+        world.grade(inv.check_degraded_drain(patch_timeline, torn,
+                                             world.records))
     if lists is not None:
         world.grade(inv.check_relist_economy(
             lists, expected=1 + len(watch_loss)

@@ -292,6 +292,100 @@ def check_prediction_precedes_failure(records: Sequence[dict],
                      f"max {max(lead)})")
 
 
+def check_degraded_link_named(timeline: Sequence[dict], host: str,
+                              link: str, onset: int) -> Verdict:
+    """The mesh link doctor's first promise: a DEGRADED verdict always
+    NAMES the slow link.  From the onset round on, the remediation budget
+    view's degraded block must carry exactly the torn host and a
+    slice-qualified name ending in the ground-truth link; before onset it
+    must be empty — phantom evidence would be its own bug."""
+    name = "degraded-link-named"
+    named = None
+    for s in timeline:
+        r = s["round"]
+        if r < onset:
+            if s["nodes"] or s["links"]:
+                return _fail(name, f"round {r}: degraded evidence "
+                                   f"(nodes={s['nodes']} links={s['links']})"
+                                   " before the link tore")
+            continue
+        if s["nodes"] != [host]:
+            return _fail(name, f"round {r}: degraded nodes {s['nodes']} != "
+                               f"[{host!r}]")
+        if len(s["links"]) != 1 or not s["links"][0].endswith("/" + link):
+            return _fail(name, f"round {r}: degraded links {s['links']} do "
+                               f"not name the torn link {link!r}")
+        named = s["links"][0]
+    if named is None:
+        return _fail(name, f"no round at or past onset {onset}: the "
+                           "scenario graded nothing")
+    return _ok(name, f"{named!r} named on {host} every round from {onset}")
+
+
+def check_degraded_not_condemned(records: Sequence[dict],
+                                 hosts: Sequence[str]) -> Verdict:
+    """DEGRADED is capacity-quality evidence, never condemnation: a host
+    whose only fault is a slow ICI link must never transition to FAILED
+    or CHRONIC — the FSM holds state on degraded rounds (link drift may
+    promote to SUSPECT, nothing more)."""
+    name = "degraded-not-condemned"
+    torn = set(hosts)
+    held = 0
+    for r in records:
+        for t in r.get("transitions") or ():
+            node, _, edge = t.partition(":")
+            _src, _, dst = edge.partition(">")
+            if node in torn and dst in (FAILED, CHRONIC):
+                return _fail(name, f"round {r['round']}: degraded host "
+                                   f"{node} condemned {dst} — a slow link "
+                                   "fed the condemnation ladder")
+            if node in torn:
+                held += 1
+    return _ok(name, f"{len(torn)} degraded host(s) never reached "
+                     f"FAILED/CHRONIC ({held} sub-condemnation "
+                     "transitions)")
+
+
+def check_degraded_drain(patch_timeline: Sequence[Sequence[str]],
+                         hosts: Sequence[str], records: Sequence[dict],
+                         strict: bool = False) -> Verdict:
+    """Remediation acts on DEGRADED evidence: every torn host is drained
+    (cordoned, counted SERVER-SIDE) or visibly budget-denied by the final
+    round — silently ignoring a sick link is the failure mode.  An
+    out-of-band uncordon (the resurrect sabotage) un-drains the host and
+    must turn this red.  ``strict`` additionally forbids actuation on any
+    OTHER host (the dedicated scenario, where the torn host is the only
+    sick one; the fuzzer mixes failure programs and skips it)."""
+    name = "degraded-drain"
+    torn = set(hosts)
+    cordoned: set = set()
+    for i, patches in enumerate(patch_timeline):
+        for p in patches:
+            node, _, action = p.rpartition(":")
+            if action == "cordon":
+                if strict and node not in torn:
+                    return _fail(name, f"round {i}: cordoned {node} outside "
+                                       "the degraded set")
+                if node in torn:
+                    cordoned.add(node)
+            elif action == "uncordon":
+                cordoned.discard(node)
+    missing = sorted(torn - cordoned)
+    if not missing:
+        return _ok(name, f"all {len(torn)} degraded host(s) drained within "
+                         "the budget rails")
+    # Denial pairs are (domain, reason) — node names fold away in the
+    # fingerprint — so a standing recorded refusal is the escape hatch:
+    # bounded actuation, but never silent.
+    denied = sorted({d for r in records for d in (r.get("denials") or ())})
+    if denied:
+        return _ok(name, f"{len(cordoned & torn)} drained, {len(missing)} "
+                         f"left under a visible refusal: {denied}")
+    return _fail(name, f"degraded host(s) {missing} neither drained nor "
+                       "visibly denied by the final round — the evidence "
+                       "was silently ignored")
+
+
 def check_trace_completeness(records: Sequence[dict]) -> Verdict:
     """Every completed round ran under a tracer: the payload carries the
     round's trace_id and the trace recorded the detect phase (exit-1

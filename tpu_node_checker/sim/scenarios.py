@@ -16,6 +16,8 @@ under test share no code path.
 | api-brownout        | 429/5xx bursts, then a black-hole outage         |
 | slow-drain          | staggered permanent failures trickling cordons   |
 | torn-slice          | kubelet NotReady tears a slice (no chip fault)   |
+| degraded-link       | one slow ICI hop: named link, DEGRADED verdict,  |
+|                     | drained within budget — never condemned          |
 | watch-loss-relist   | stream losses + in-band 410, relist economy      |
 | partitioned-region  | one cluster vanishes; federation staleness       |
 | aggregator-death    | lease aggregator killed mid-storm                |
@@ -79,11 +81,13 @@ def _base_argv(kubeconfig: str, reports: str, *extra: str) -> List[str]:
             *extra]
 
 
-def _sabotage_patch(port: int, node: str) -> None:
-    """An UNBUDGETED cordon PATCH straight at the simulated apiserver —
-    the deliberate contract violation the tests inject to prove the
-    matrix catches breakage instead of rubber-stamping green."""
-    body = json.dumps({"spec": {"unschedulable": True}}).encode()
+def _sabotage_patch(port: int, node: str,
+                    unschedulable: bool = True) -> None:
+    """An UNBUDGETED cordon (or, with ``unschedulable=False``, uncordon)
+    PATCH straight at the simulated apiserver — the deliberate contract
+    violation the tests inject to prove the matrix catches breakage
+    instead of rubber-stamping green."""
+    body = json.dumps({"spec": {"unschedulable": unschedulable}}).encode()
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
     try:
         conn.request("PATCH", f"/api/v1/nodes/{node}", body=body,
@@ -383,6 +387,83 @@ def _run_torn_slice(world: SimWorld) -> None:
                                      allowed={0, 3}))
     world.grade(inv.check_fsm_legality(world.records))
     world.grade(inv.check_slack_dedup(world.records, max_alerts=2))
+    world.grade(inv.check_trace_completeness(world.records))
+
+
+# ---------------------------------------------------------------------------
+# degraded-link: one slow ICI hop — named, DEGRADED not FAILED, drained
+# ---------------------------------------------------------------------------
+
+
+def _run_degraded_link(world: SimWorld) -> None:
+    """The mesh link doctor end to end, at sim speed: one host's ICI link
+    tears at round 1 (a ``torn-link`` program replaying the report shape
+    the probe child's ``TNC_CHAOS_SLOW_LINK`` hook produces — the jax
+    sweep itself is pinned by the slow test_probe chaos tests).  The
+    matrix asserts the link is NAMED in the budget view, the host grades
+    DEGRADED and is never condemned FAILED/CHRONIC, the exit code never
+    notices (the chips pass), and ``--cordon-degraded`` drains the sick
+    host through the budget engine's rails."""
+    p = world.params
+    onset = 1
+    cluster = synth_cluster("sim-c0", p["nodes_per_cluster"], min_slices=2)
+    first_pool = sorted(cluster.by_slice)[0]
+    torn = cluster.assign(
+        world.rng, lambda i: ("torn-link", onset), per_slice=1,
+        eligible=set(cluster.by_slice[first_pool]),
+    )
+    host = torn[0]
+    link = cluster.degraded(onset)[host]
+    world.event(f"fleet slices={len(cluster.by_slice)} torn={host} "
+                f"link={link} onset={onset}")
+    server, state = fx.storm_apiserver(cluster.nodes())
+    world.on_cleanup(server.shutdown)
+    kc = world.kubeconfig(server.server_address[1], "c0")
+    expected: List[int] = []
+    patch_timeline: List[List[str]] = []
+    degraded_timeline: List[dict] = []
+    for r in range(p["rounds"]):
+        # The exit-code contract is untouched by link weather: every
+        # chip passes every round, so the oracle is a flat 0 — DEGRADED
+        # rides the evidence layers, never the verdict.
+        expected.append(checker.EXIT_OK)
+        reports = world.write_reports("c0", cluster.verdicts(r),
+                                      degraded=cluster.degraded(r))
+        before = len(state["patches"])
+        result, rec = world.checker_round(_base_argv(
+            kc, reports,
+            "--history", world.history_path("c0"),
+            "--cordon-degraded", "--cordon-max", "8",
+            "--slice-floor-pct", "50", "--disruption-budget", "2",
+        ), r, "sim-c0")
+        rec["patches"] = _patch_names(state, before)
+        patch_timeline.append(rec["patches"])
+        block = {}
+        if result is not None:
+            block = ((result.payload.get("remediation") or {})
+                     .get("degraded") or {})
+        step = {
+            "round": r,
+            "nodes": list(block.get("nodes") or []),
+            "links": list(block.get("links") or []),
+        }
+        degraded_timeline.append(step)
+        world.commit(rec)
+        world.event(
+            f"degraded round={r} nodes={','.join(step['nodes']) or '-'} "
+            f"links={','.join(step['links']) or '-'}"
+        )
+    world.grade(inv.check_exit_codes(world.records, expected=expected,
+                                     allowed={0}))
+    world.grade(inv.check_degraded_link_named(degraded_timeline, host,
+                                              link, onset))
+    world.grade(inv.check_degraded_not_condemned(world.records, [host]))
+    world.grade(inv.check_degraded_drain(patch_timeline, [host],
+                                         world.records, strict=True))
+    world.grade(inv.check_disruption_budget(
+        [len(x) for x in patch_timeline], 2
+    ))
+    world.grade(inv.check_fsm_legality(world.records))
     world.grade(inv.check_trace_completeness(world.records))
 
 
@@ -931,6 +1012,18 @@ SCENARIOS: Dict[str, Scenario] = {
             defaults={"clusters": 1, "nodes_per_cluster": 8, "rounds": 5,
                       "min_rounds": 3},
             invariants=("exit-code-contract", "fsm-legality", "slack-dedup",
+                        "trace-completeness"),
+        ),
+        Scenario(
+            name="degraded-link",
+            title="One slow ICI hop: the link named, the host DEGRADED "
+                  "not FAILED, drained within budget",
+            runner=_run_degraded_link,
+            defaults={"clusters": 1, "nodes_per_cluster": 8, "rounds": 5,
+                      "min_rounds": 3},
+            invariants=("exit-code-contract", "degraded-link-named",
+                        "degraded-not-condemned", "degraded-drain",
+                        "disruption-budget", "fsm-legality",
                         "trace-completeness"),
         ),
         Scenario(
