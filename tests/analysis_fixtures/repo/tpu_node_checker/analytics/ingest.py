@@ -23,3 +23,26 @@ def gated_flush(path, records):  # near-miss: through the gate
 
 def append_bucket_counts(counts):  # near-miss: suffix differs, no call
     return sum(counts.values())
+
+
+def rogue_sketch_write(path, sk):
+    from tpu_node_checker.analytics import sketch
+
+    doc = sketch.sketch_state(sk)  # EXPECT[TNC021]
+    segments.append_bucket(path, [{"sk": doc}])
+
+
+def rogue_sketch_read(rec):
+    from tpu_node_checker.analytics.sketch import sketch_from_state
+
+    return sketch_from_state(rec.get("sk"))  # EXPECT[TNC021]
+
+
+def merged_block(docs):  # near-miss: the free read/merge surface
+    from tpu_node_checker.analytics.sketch import merge_state_docs
+
+    return merge_state_docs(docs)
+
+
+def export_sketch(sk):  # near-miss: wire shape, not persistence
+    return sk.to_doc()

@@ -611,7 +611,7 @@ class TestCheckerWiring:
         block = res.payload["analytics"]
         assert set(block) == {
             "predictions", "predictions_total", "suspects", "buckets",
-            "rollup_lines_total", "compactions_total",
+            "rollup_lines_total", "compactions_total", "sketch_samples",
         }
 
     def test_no_flag_payload_untouched(self, tmp_path):
@@ -766,10 +766,13 @@ class TestCliValidation:
         with pytest.raises(SystemExit):
             cli.parse_args(["--analytics", "d"])
 
-    def test_analytics_rejected_with_watch_stream(self):
-        with pytest.raises(SystemExit):
-            cli.parse_args(["--watch", "5", "--watch-stream",
-                            "--history", "h", "--analytics", "d"])
+    def test_analytics_accepted_with_watch_stream(self):
+        # PR 19 lifted this rejection: roll-up folding rides the tick
+        # path itself, so stream rounds produce the same buckets poll
+        # rounds do (steady ticks included).
+        args = cli.parse_args(["--watch", "5", "--watch-stream",
+                               "--history", "h", "--analytics", "d"])
+        assert args.watch_stream and args.analytics == "d"
 
     def test_analytics_rejected_with_emit_probe(self):
         with pytest.raises(SystemExit):
@@ -785,3 +788,140 @@ class TestCliValidation:
         args = cli.parse_args(["--watch", "5", "--serve", "0",
                                "--history", "h", "--analytics", "d"])
         assert args.analytics == "d"
+
+
+# ---------------------------------------------------------------------------
+# Federated analytics: merged sketches vs the raw-replay oracle (PR 19)
+# ---------------------------------------------------------------------------
+
+
+class TestGlobalAnalyticsMerge:
+    """The acceptance pin: global p50/p90/p99 availability/MTBF/MTTR
+    computed from MERGED per-cluster sketches equal a raw-replay oracle
+    over the union of per-node stats, within the declared alpha bound —
+    the analytics flavor of PR 15's roll-up == replay pin."""
+
+    def _cluster_store(self, tmp_path, name, nodes, seed):
+        from tpu_node_checker.analytics.segments import SegmentStore
+
+        rng = random.Random(seed)
+        store = SegmentStore(str(tmp_path / name))
+        store.load()
+        rows = []
+        for i in range(nodes):
+            node = f"{name}-n{i}"
+            fail_rate = rng.uniform(0.02, 0.4)
+            for r in range(120):
+                rows.append((node, T0 + 30 * r, rng.random() > fail_rate))
+        rows.sort(key=lambda row: row[1])
+        _ingest(store, rows)
+        for i in range(nodes):
+            store.node_groups[f"{name}-n{i}"] = {"cluster": name}
+        return store
+
+    @staticmethod
+    def _oracle_pct(values, q):
+        import math
+
+        ordered = sorted(values)
+        rank = max(1, math.ceil(q * len(ordered)))
+        return ordered[rank - 1]
+
+    def test_global_quantiles_match_union_oracle(self, tmp_path):
+        from tpu_node_checker.analytics.queries import (
+            build_analytics_docs,
+            node_stats_view,
+        )
+        from tpu_node_checker.analytics.sketch import DEFAULT_ALPHA
+        from tpu_node_checker.federation.merge import (
+            ClusterView,
+            build_global_analytics,
+        )
+
+        views = []
+        union = {"availability_pct": [], "mtbf_s": [], "mttr_s": []}
+        for idx, name in enumerate(("us-a", "eu-b", "ap-c")):
+            store = self._cluster_store(tmp_path, name, nodes=12, seed=idx)
+            # Oracle side: the raw per-node values, no sketches involved.
+            for stats in node_stats_view(store).values():
+                for metric in union:
+                    if stats[metric] is not None:
+                        union[metric].append(stats[metric])
+            view = ClusterView(name, f"http://{name}:8080")
+            view.set_analytics(build_analytics_docs(store)["slo"])
+            views.append(view)
+
+        doc = build_global_analytics(views)
+        assert doc["source"] == "sketches"
+        assert set(doc["clusters"]) == {"us-a", "eu-b", "ap-c"}
+        assert doc["fleet"]["nodes"] == 36
+        for metric, values in union.items():
+            assert values, metric
+            got = doc["fleet"][metric]
+            for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                exact = self._oracle_pct(values, q)
+                est = got[key]
+                assert abs(est - exact) <= DEFAULT_ALPHA * exact + 1e-9, (
+                    metric, key, est, exact)
+
+    def test_cluster_groups_synthesized_and_offenders_reranked(self, tmp_path):
+        from tpu_node_checker.analytics.queries import build_analytics_docs
+        from tpu_node_checker.federation.merge import (
+            ClusterView,
+            build_global_analytics,
+        )
+
+        views = []
+        for idx, name in enumerate(("us-a", "eu-b")):
+            store = self._cluster_store(tmp_path, name, nodes=6, seed=10 + idx)
+            view = ClusterView(name, f"http://{name}:8080")
+            view.set_analytics(build_analytics_docs(store)["slo"])
+            views.append(view)
+        doc = build_global_analytics(views)
+        kinds = {(g["kind"], g["group"]) for g in doc["groups"]}
+        assert ("cluster", "us-a") in kinds and ("cluster", "eu-b") in kinds
+        # Offenders: union of both clusters' worst, cluster-stamped,
+        # worst availability first.
+        assert doc["offenders"], "offenders expected from flapping fixtures"
+        avails = [o["availability_pct"] for o in doc["offenders"]]
+        assert avails == sorted(avails)
+        assert {o["cluster"] for o in doc["offenders"]} <= {"us-a", "eu-b"}
+
+    def test_restacks_through_an_aggregator_tier(self, tmp_path):
+        """Tier stacking: merging {A,B} then {that, C} equals merging
+        {A,B,C} flat — build_global_analytics consumes its own output."""
+        from tpu_node_checker.analytics.queries import build_analytics_docs
+        from tpu_node_checker.federation.merge import (
+            ClusterView,
+            build_global_analytics,
+        )
+
+        def _view(name, doc):
+            v = ClusterView(name, f"http://{name}:8080")
+            v.set_analytics(doc)
+            return v
+
+        slos = {
+            name: build_analytics_docs(
+                self._cluster_store(tmp_path, name, nodes=8, seed=20 + i)
+            )["slo"]
+            for i, name in enumerate(("us-a", "eu-b", "ap-c"))
+        }
+        flat = build_global_analytics(
+            [_view(n, d) for n, d in slos.items()])
+        lower = build_global_analytics(
+            [_view(n, slos[n]) for n in ("us-a", "eu-b")])
+        stacked = build_global_analytics(
+            [_view("agg-west", lower), _view("ap-c", slos["ap-c"])])
+        assert stacked["fleet"]["nodes"] == flat["fleet"]["nodes"] == 24
+        for metric in ("availability_pct", "mtbf_s", "mttr_s"):
+            assert stacked["fleet"][metric] == flat["fleet"][metric], metric
+
+    def test_no_analytics_views_yield_none(self):
+        from tpu_node_checker.federation.merge import (
+            ClusterView,
+            build_global_analytics,
+        )
+
+        view = ClusterView("us-a", "http://us-a:8080")
+        assert build_global_analytics([view]) is None

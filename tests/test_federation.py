@@ -1364,3 +1364,125 @@ class TestRouterPercentDecoding:
             assert json.loads(body)["node"]["name"] == "a%2Fb"
         finally:
             srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Federated analytics (PR 19): sketch blocks across the tier boundary
+# ---------------------------------------------------------------------------
+
+
+def _slo_fixture(cluster, avails):
+    """A per-cluster slo doc shaped like queries.build_analytics_docs
+    emits it — mergeable sketches riding next to the percentile text."""
+    from tpu_node_checker.analytics.sketch import DEFAULT_ALPHA, sketch_of
+
+    return {
+        "fleet": {
+            "nodes": len(avails),
+            "availability_pct": None,
+            "mtbf_s": None,
+            "mttr_s": None,
+            "sketches": {
+                "availability_pct": sketch_of(avails).to_doc(),
+                "mtbf_s": None,
+                "mttr_s": None,
+            },
+        },
+        "groups": [],
+        "streams": {},
+        "offenders": [{
+            "node": f"{cluster}-node-0",
+            "availability_pct": min(avails),
+            "flips": 3, "mttr_s": 45.0, "last_ok": True,
+        }],
+        "sketch_alpha": DEFAULT_ALPHA,
+        "source": "rollups",
+    }
+
+
+class TestGlobalAnalytics:
+    def test_endpoint_merges_and_survives_missing_upstreams(self, tmp_path):
+        """Poll path end-to-end: 404 while no upstream reports analytics,
+        then a republished upstream round re-probes the leg (negative
+        cache lifts on fresh content) and the merged doc serves with the
+        full conditional protocol."""
+        servers, endpoints = TestFederationE2E._fleet(
+            TestFederationE2E(), tmp_path, [("us-a", 4), ("eu-b", 3)]
+        )
+        engine = FederationEngine(_args(endpoints))
+        agg = FleetStateServer(0, host="127.0.0.1", federation=True,
+                               readiness=engine.readiness)
+        try:
+            engine.round(agg)
+            status, _, body = _req(agg.port, "GET",
+                                   "/api/v1/global/analytics")
+            assert status == 404 and b"analytics" in body
+            # Upstream us-a gains --analytics AND publishes a new round
+            # (fresh content is what re-opens the negative-cached leg).
+            servers["us-a"].publish_analytics(
+                {"slo": _slo_fixture("us-a", [91.0, 97.5, 99.9, 100.0])}
+            )
+            payload = _round_payload("us-a", 5)
+            servers["us-a"].publish(_Round(payload, 0))
+            engine.round(agg)
+            status, headers, body = _req(agg.port, "GET",
+                                         "/api/v1/global/analytics")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["source"] == "sketches"
+            assert set(doc["clusters"]) == {"us-a"}
+            assert doc["fleet"]["nodes"] == 4
+            p50 = doc["fleet"]["availability_pct"]["p50"]
+            assert abs(p50 - 97.5) <= 0.01 * 97.5
+            assert doc["offenders"][0]["cluster"] == "us-a"
+            # Conditional replay rides the same entity machinery.
+            status, _, _ = _req(
+                agg.port, "GET", "/api/v1/global/analytics",
+                headers={"If-None-Match": headers["ETag"]},
+            )
+            assert status == 304
+        finally:
+            agg.close()
+            engine.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_analytics_slo_block_rides_the_delta_feed(self, tmp_path):
+        """Stream path: publish_analytics on the upstream pushes an
+        analytics_slo block through --federate-feed; the next aggregator
+        round carries the merged doc with zero extra GETs."""
+        world = TestStreamingFederation()
+        servers, endpoints = world._fleet(tmp_path, [("us-a", 4)])
+        engine = FederationEngine(world._feed_args(endpoints))
+        try:
+            engine.round()
+            world._wait_streams(engine)
+            servers["us-a"].publish_analytics(
+                {"slo": _slo_fixture("us-a", [88.0, 99.0, 100.0])}
+            )
+            client = dict(engine._feeds)["us-a"]
+            deadline = time.perf_counter() + 10.0
+            while True:
+                with client._lock:
+                    if "analytics_slo" in client._blocks:
+                        break
+                assert time.perf_counter() < deadline, "block never arrived"
+                time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s wait for a REAL pushed analytics_slo frame)
+            before = dict(servers["us-a"].stats.requests)
+            snap = engine.round()
+            delta = {
+                k: n - before.get(k, 0)
+                for k, n in servers["us-a"].stats.requests.items()
+                if n != before.get(k, 0)
+            }
+            # The block arrived ON the stream: no /api/v1/analytics/slo GET.
+            assert set(delta) <= {("GET", "/api/v1/watch", 200)}, delta
+            assert engine.views["us-a"].analytics_doc is not None
+            assert "global/analytics" in snap.entities
+            doc = json.loads(snap.entities["global/analytics"].raw)
+            assert doc["fleet"]["nodes"] == 3
+            assert set(doc["clusters"]) == {"us-a"}
+        finally:
+            engine.close()
+            for srv in servers.values():
+                srv.close()

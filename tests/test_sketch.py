@@ -1,0 +1,184 @@
+"""Property tests for the mergeable percentile sketch (PR 19's tentpole
+primitive).
+
+Two contracts, both seeded and stdlib-only:
+
+* **Exact merge associativity/commutativity** — bucket counts are
+  integers and min/max merge by comparison, so ANY merge order over any
+  partition of a sample stream must yield byte-identical wire docs and
+  therefore identical quantiles.  This is what lets a 100-cluster fan-in
+  give every aggregator topology the same answer.
+* **Error bound vs the raw-replay oracle** — ``quantile(q)`` is within
+  the declared relative error ``alpha`` of the exact
+  rank-``max(1, ceil(q*n))`` order statistic, across 1k-round random
+  streams from several distributions (the shapes MTTR / repair-age /
+  round-duration data actually takes).
+"""
+
+import json
+import math
+import random
+
+import pytest
+
+from tpu_node_checker.analytics.sketch import (
+    DEFAULT_ALPHA,
+    MIN_TRACKABLE,
+    Sketch,
+    merge_docs,
+    merge_state_docs,
+    sketch_of,
+)
+
+QS = (0.5, 0.9, 0.99)
+
+
+def exact_quantile(values, q):
+    """The oracle: same rank definition as Sketch.quantile."""
+    ordered = sorted(values)
+    rank = max(1, math.ceil(q * len(ordered)))
+    return ordered[rank - 1]
+
+
+def within_bound(est, exact, alpha=DEFAULT_ALPHA):
+    if exact <= MIN_TRACKABLE:
+        return est == 0.0
+    return abs(est - exact) <= alpha * exact + 1e-12
+
+
+def _streams(seed, rounds=1000):
+    """Latency-shaped sample streams: lognormal (round durations),
+    exponential (MTTR), uniform with zero spikes (repair age)."""
+    rng = random.Random(seed)
+    return {
+        "lognormal": [rng.lognormvariate(3.0, 1.2) for _ in range(rounds)],
+        "exponential": [rng.expovariate(1 / 90.0) for _ in range(rounds)],
+        "uniform_with_zeros": [
+            0.0 if rng.random() < 0.1 else rng.uniform(0.5, 7200.0)
+            for _ in range(rounds)
+        ],
+        "wide_range": [
+            10 ** rng.uniform(-3, 6) for _ in range(rounds)
+        ],
+    }
+
+
+class TestMergeAlgebra:
+    @pytest.mark.parametrize("seed", [7, 23, 1729])
+    def test_merge_associative_and_commutative(self, seed):
+        rng = random.Random(seed)
+        values = [rng.lognormvariate(2.0, 1.5) for _ in range(3000)]
+        a = sketch_of(values[:1000])
+        b = sketch_of(values[1000:1800])
+        c = sketch_of(values[1800:])
+        left = a.copy().merge(b.copy().merge(c.copy()))       # a+(b+c)
+        right = a.copy().merge(b.copy()).merge(c.copy())      # (a+b)+c
+        swapped = c.copy().merge(a.copy()).merge(b.copy())    # (c+a)+b
+        # Stronger than quantile equality: the entire wire doc agrees
+        # except the float ``sum`` rider (addition order), which the
+        # quantile path never reads.
+        docs = [sk.to_doc() for sk in (left, right, swapped)]
+        for doc in docs:
+            doc.pop("sum")
+        assert docs[0] == docs[1] == docs[2]
+        for q in QS:
+            assert left.quantile(q) == right.quantile(q) == swapped.quantile(q)
+
+    def test_merge_order_free_over_many_partitions(self):
+        rng = random.Random(99)
+        values = [rng.expovariate(1 / 300.0) for _ in range(2000)]
+        parts = [values[i::7] for i in range(7)]  # 7 uneven shards
+        sketches = [sketch_of(p) for p in parts]
+        orderings = [list(range(7)) for _ in range(5)]
+        for ordering in orderings[1:]:
+            rng.shuffle(ordering)
+        results = []
+        for ordering in orderings:
+            merged = Sketch()
+            for i in ordering:
+                merged.merge(sketches[i])
+            results.append(tuple(merged.quantile(q) for q in QS))
+        assert len(set(results)) == 1
+
+    def test_alpha_mismatch_refuses(self):
+        with pytest.raises(ValueError):
+            Sketch(0.01).merge(Sketch(0.02))
+
+
+class TestErrorBound:
+    @pytest.mark.parametrize("seed", [1, 42, 31337])
+    def test_single_sketch_within_declared_bound(self, seed):
+        for name, values in _streams(seed).items():
+            sk = sketch_of(values)
+            for q in QS:
+                est = sk.quantile(q)
+                exact = exact_quantile(values, q)
+                assert within_bound(est, exact), (
+                    f"{name} q={q}: sketch {est} vs oracle {exact}"
+                )
+
+    @pytest.mark.parametrize("seed", [5, 77])
+    def test_merged_matches_raw_replay_oracle(self, seed):
+        """The federation claim: merge per-shard sketches, compare the
+        MERGED quantiles to the oracle over the UNION of raw samples."""
+        rng = random.Random(seed)
+        shards = []
+        union = []
+        for _ in range(10):
+            n = rng.randrange(50, 300)
+            vals = [rng.lognormvariate(4.0, 1.0) for _ in range(n)]
+            shards.append(sketch_of(vals))
+            union.extend(vals)
+        merged = merge_docs(sk.to_doc() for sk in shards)
+        assert merged.total == len(union)
+        for q in QS:
+            est = merged.quantile(q)
+            exact = exact_quantile(union, q)
+            assert within_bound(est, exact), (
+                f"q={q}: merged {est} vs raw-replay {exact}"
+            )
+
+    def test_zeros_and_extremes(self):
+        sk = sketch_of([0.0, 0.0, 0.0, 5.0])
+        assert sk.quantile(0.5) == 0.0
+        assert within_bound(sk.quantile(0.99), 5.0)
+        assert sk.min == 0.0 and sk.max == 5.0
+
+
+class TestWireShape:
+    def test_doc_roundtrip_preserves_quantiles(self):
+        rng = random.Random(11)
+        sk = sketch_of([rng.uniform(0.1, 1000.0) for _ in range(500)])
+        doc = json.loads(json.dumps(sk.to_doc()))  # through real JSON
+        back = Sketch.from_doc(doc)
+        assert back.total == sk.total
+        for q in QS:
+            assert back.quantile(q) == sk.quantile(q)
+
+    def test_merge_state_docs_restacks(self):
+        """Doc-level fan-in re-exports a doc the tier above merges again
+        to the same answer as a flat merge (aggregator-of-aggregators)."""
+        rng = random.Random(13)
+        vals = [[rng.expovariate(1 / 60.0) for _ in range(200)]
+                for _ in range(4)]
+        docs = [sketch_of(v).to_doc() for v in vals]
+        flat = merge_docs(docs)
+        mid_a = merge_state_docs(docs[:2])
+        mid_b = merge_state_docs(docs[2:])
+        stacked = merge_docs([mid_a, mid_b])
+        for q in QS:
+            assert stacked.quantile(q) == flat.quantile(q)
+
+    def test_malformed_docs_skipped_not_fatal(self):
+        good = sketch_of([1.0, 2.0, 3.0]).to_doc()
+        merged = merge_docs([
+            None, "nonsense", {"alpha": 7}, {"alpha": 0.01, "b": "x"},
+            good, {"alpha": 0.05, "n": 1, "b": {"0": 1}},  # alpha mismatch
+        ])
+        assert merged is not None
+        assert merged.total == 3
+
+    def test_from_doc_malformed_returns_none(self):
+        assert Sketch.from_doc(None) is None
+        assert Sketch.from_doc({"alpha": -1}) is None
+        assert Sketch.from_doc([1, 2]) is None

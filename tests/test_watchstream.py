@@ -334,6 +334,43 @@ class TestStreamEngine:
         # No LIST traffic on a steady tick.
         assert len(stream_world["list_requests"]) == lists_before
 
+    def test_steady_ticks_produce_rollups_with_analytics(
+        self, stream_world, tmp_path
+    ):
+        # PR 19 acceptance: before, --analytics + --watch-stream was
+        # rejected outright, so a steady streamed fleet produced ZERO
+        # roll-ups.  Now every tick — steady included — folds verdicts
+        # into the segment store and duration samples into the fleet
+        # sketches.
+        engine = stream_world["make_engine"](
+            "--history", str(tmp_path / "h.jsonl"),
+            "--analytics", str(tmp_path / "ana"),
+        )
+        first, _ = engine.tick()
+        assert first.analytics_docs is not None
+        assert set(first.analytics_docs) == {"slo", "offenders", "flaps"}
+        samples_first = first.payload["analytics"]["sketch_samples"]
+        steady = None
+        for _ in range(3):
+            steady, delta = engine.tick()
+            assert delta == frozenset()
+        assert steady.analytics_docs is not None
+        slo = steady.analytics_docs["slo"]
+        assert slo["fleet"]["nodes"] == 4
+        assert slo["source"] == "rollups"
+        assert slo["sketch_alpha"] == pytest.approx(0.01)
+        # Each steady tick folded a round-duration sample into the
+        # reserved fleet stream — the previously-zero evidence.
+        samples_steady = steady.payload["analytics"]["sketch_samples"]
+        assert samples_steady.get("round_ms", 0) >= (
+            samples_first.get("round_ms", 0) + 3
+        )
+        # Steady verdicts reached the per-node running aggregates too:
+        # four healthy rounds per node, one per tick.
+        stats = checker._build_analytics(engine.args)["store"].node_stats
+        assert stats["ws-0"]["n"] >= 4
+        assert stats["ws-0"]["ok"] == stats["ws-0"]["n"]
+
     def test_event_flips_grade_and_back(self, stream_world):
         engine = stream_world["make_engine"]()
         engine.tick()

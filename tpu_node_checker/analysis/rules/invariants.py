@@ -523,18 +523,30 @@ class RollupWriteGate(Rule):
            "called only inside analytics/segments.py, and every caller "
            "there must reference ``ROLLUP_SCHEMA_VERSION`` — the proof "
            "its lines are schema-stamped (the TNC019 actuator-gate "
-           "pattern, applied to the store)")
+           "pattern, applied to the store); the sketch persistence "
+           "entry points (``sketch_state``/``sketch_from_state``) ride "
+           "the same gate — callable only from segments.py and their "
+           "definer sketch.py, so sketch bytes reach segment records "
+           "only inside schema-stamped lines (the free read/merge "
+           "surface is ``Sketch.to_doc``/``merge_state_docs``)")
 
     _PRIMITIVES = ("rollup_append_lines", "rollup_replace_file")
+    # Sketch serialization/deserialization against SEGMENT RECORDS: a
+    # persistence surface, not a query surface — gated like the raw I/O
+    # (the wire/query shape has its own ungated entry points).
+    _SKETCH_PRIMITIVES = ("sketch_state", "sketch_from_state")
     _SANCTIONED = "tpu_node_checker/analytics/segments.py"
+    # Where the sketch primitives are DEFINED (and self-referenced).
+    _DEFINER = "tpu_node_checker/analytics/sketch.py"
     _SCHEMA_CONST = "ROLLUP_SCHEMA_VERSION"
 
-    def _primitive_calls(self, tree: ast.AST):
+    def _primitive_calls(self, tree: ast.AST, names=None):
+        primitives = names if names is not None else self._PRIMITIVES
         for node in ast.walk(tree):
             if isinstance(node, ast.Call):
                 name = call_name(node)
                 if (name is not None
-                        and name.split(".")[-1] in self._PRIMITIVES):
+                        and name.split(".")[-1] in primitives):
                     yield node, name
 
     @classmethod
@@ -557,6 +569,17 @@ class RollupWriteGate(Rule):
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         if not ctx.in_package():
             return
+        if ctx.path not in (self._SANCTIONED, self._DEFINER):
+            for node, name in self._primitive_calls(
+                    ctx.tree, self._SKETCH_PRIMITIVES):
+                yield self.finding(
+                    ctx.path, node,
+                    f"sketch persistence {name}() outside "
+                    "analytics/segments.py — sketch bytes reach segment "
+                    "records only through the store's schema-stamped "
+                    "append path; read or merge sketches through "
+                    "Sketch.to_doc()/merge_state_docs() instead",
+                )
         if ctx.path != self._SANCTIONED:
             for node, name in self._primitive_calls(ctx.tree):
                 yield self.finding(

@@ -30,8 +30,15 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from tpu_node_checker.analytics.segments import (
+    FLEET_STREAM,
+    RESERVED_STREAM_PREFIX,
     RESOLUTIONS,
     SegmentStore,
+)
+from tpu_node_checker.analytics.sketch import (
+    DEFAULT_ALPHA,
+    merge_docs,
+    sketch_of,
 )
 
 # Worst-offender list depth (the --trend-nodes convention).
@@ -62,6 +69,11 @@ def node_stats_view(store: SegmentStore) -> Dict[str, dict]:
     """Per-node SLO numbers from the store's running aggregates."""
     out: Dict[str, dict] = {}
     for node, s in sorted(store.node_stats.items()):
+        if node.startswith(RESERVED_STREAM_PREFIX):
+            # Reserved duration streams (``_fleet``) ride the bucket
+            # machinery but are not nodes — they surface through the slo
+            # doc's "streams" block, never through node views.
+            continue
         n = s["n"]
         span = (
             (s["last_ts"] - s["first_ts"])
@@ -132,16 +144,19 @@ def build_analytics_docs(store: SegmentStore, detector=None,
             "availability_pct": _percentiles(g["availability"]),
             "mtbf_s": _percentiles(g["mtbf"]),
             "mttr_s": _percentiles(g["mttr"]),
+            # Mergeable mirror of the percentile triplet: one sample per
+            # node, so an aggregator merging two clusters' sketches gets
+            # the distribution over the UNION of their nodes — the thing
+            # the percentile dicts above cannot give without raw stats.
+            "sketches": {
+                metric: (sketch_of(g[src]).to_doc() if g[src] else None)
+                for metric, src in (
+                    ("availability_pct", "availability"),
+                    ("mtbf_s", "mtbf"),
+                    ("mttr_s", "mttr"),
+                )
+            },
         }
-
-    slo = {
-        "fleet": _slo_entry(fleet),
-        "groups": [
-            {"kind": kind, "group": name, **_slo_entry(g)}
-            for (kind, name), g in sorted(grouped.items())
-        ],
-        "source": "rollups",
-    }
 
     # -- offenders: worst-first repair queue --------------------------------
     ranked = sorted(
@@ -154,6 +169,52 @@ def build_analytics_docs(store: SegmentStore, detector=None,
             n,
         ),
     )
+
+    # Fleet-wide duration streams: the per-sample sketches the store
+    # persists in bucket records.  round/link durations live under the
+    # reserved ``_fleet`` stream; repair age and per-event repair times
+    # merge across every real node (merge_docs skips missing sketches).
+    fleet_sketches = (
+        store.node_stats.get(FLEET_STREAM, {}).get("sketches") or {}
+    )
+    streams: Dict[str, dict] = {}
+    for metric in ("round_ms", "link_us"):
+        sk = fleet_sketches.get(metric)
+        if sk is not None and sk.total:
+            streams[metric] = sk.to_doc()
+    for metric, out_name in (("repair_age_s", "repair_age_s"),
+                             ("mttr_s", "mttr_event_s")):
+        merged = merge_docs(
+            (s.get("sketches") or {}).get(metric)
+            for node, s in store.node_stats.items()
+            if not node.startswith(RESERVED_STREAM_PREFIX)
+        )
+        if merged is not None and merged.total:
+            streams[out_name] = merged.to_doc()
+
+    slo = {
+        "fleet": _slo_entry(fleet),
+        "groups": [
+            {"kind": kind, "group": name, **_slo_entry(g)}
+            for (kind, name), g in sorted(grouped.items())
+        ],
+        "streams": streams,
+        # A compact worst-first brief so the aggregator can re-rank
+        # offenders FLEET-WIDE from slo blocks alone (the full offenders
+        # doc stays poll-only; the feed carries just the slo block).
+        "offenders": [
+            {
+                "node": n,
+                "availability_pct": nodes[n]["availability_pct"],
+                "flips": nodes[n]["flips"],
+                "mttr_s": nodes[n]["mttr_s"],
+                "last_ok": nodes[n]["last_ok"],
+            }
+            for n in ranked[:OFFENDERS_CAP]
+        ],
+        "sketch_alpha": DEFAULT_ALPHA,
+        "source": "rollups",
+    }
     offenders = {
         "offenders": [
             {"node": n, **nodes[n], "group": store.node_groups.get(n) or {}}

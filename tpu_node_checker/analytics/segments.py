@@ -9,7 +9,20 @@ resolution::
      "repair_s": 0.0, "dwell": {"HEALTHY": 1, "SUSPECT": 1},
      "first_ts": ..., "last_ts": ..., "last_ok": false,
      "cluster": "us-central2-a", "slice": "pool-0/v5e/4x4",
-     "topology": "4x4"}
+     "topology": "4x4",
+     "sk": {"mttr_s": {"alpha": 0.01, "n": 1, "b": {"231": 1}, ...}}}
+
+The optional ``"sk"`` field carries the bucket's mergeable percentile
+sketches (:mod:`~tpu_node_checker.analytics.sketch`, DESIGN.md §23) for
+latency-shaped metrics: ``mttr_s`` (individual repair durations) and
+``repair_age_s`` (in-flight failure age per observation) per node, plus
+``round_ms`` / ``link_us`` on the reserved ``_fleet`` stream (fleet-wide
+durations have no node of their own; reserved ``_``-prefixed stream
+names are filtered out of every node-level view).  Sketches merge
+bucket-wise like every other field — the coarse-window reconstruction
+and the node-stats stitch fold them with the same additive discipline as
+the counters — and serialize ONLY through :func:`~tpu_node_checker.
+analytics.sketch.sketch_state` (TNC021-gated, like the line primitives).
 
 Design rules, inherited from the history store and pinned by
 ``tests/test_analytics.py``:
@@ -43,12 +56,26 @@ import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
+from tpu_node_checker.analytics.sketch import (
+    Sketch,
+    sketch_from_state,
+    sketch_state,
+)
 from tpu_node_checker.federation.endpoints import HashRing
 from tpu_node_checker.history.store import read_jsonl_tolerant
 
 # Major version of the roll-up line contract (the history store's rule:
 # readers refuse lines from majors they do not speak).
 ROLLUP_SCHEMA_VERSION = 1
+
+# Reserved stream prefix: node names never start with "_" (Kubernetes
+# object names are DNS labels), so "_"-prefixed streams carry fleet-wide
+# sample distributions through the same bucket machinery without ever
+# appearing in node-level SLO views.
+RESERVED_STREAM_PREFIX = "_"
+
+# The fleet-wide duration stream (round wall-clock, mesh link p50s).
+FLEET_STREAM = "_fleet"
 
 # Downsampling ladder: 1m buckets answer "is it flapping NOW", 15m the
 # operational dashboards, 6h the week-scale SLO reports.
@@ -117,7 +144,7 @@ class _OpenBucket:
     """One still-filling (node, res, bucket) accumulator."""
 
     __slots__ = ("n", "ok", "flips", "onsets", "repairs", "repair_s",
-                 "dwell", "first_ts", "last_ts", "last_ok")
+                 "dwell", "first_ts", "last_ts", "last_ok", "sketches")
 
     def __init__(self):
         self.n = 0
@@ -130,6 +157,8 @@ class _OpenBucket:
         self.first_ts: Optional[float] = None
         self.last_ts: Optional[float] = None
         self.last_ok: Optional[bool] = None
+        # metric name -> mergeable percentile Sketch (DESIGN.md §23).
+        self.sketches: Dict[str, Sketch] = {}
 
 
 class SegmentStore:
@@ -163,6 +192,9 @@ class SegmentStore:
         self.rollup_lines_total = 0  # lifetime appended lines (counter)
         self.compactions_total = 0
         self._shard_lines: Dict[int, int] = {}  # physical lines per shard
+        # Lifetime samples folded into percentile sketches, by metric —
+        # the tpu_node_checker_analytics_sketch_samples_total family.
+        self.sketch_samples_total: Dict[str, int] = {}
 
     # -- paths ---------------------------------------------------------------
 
@@ -235,9 +267,21 @@ class SegmentStore:
     def _merge_records(self, recs: List[dict]) -> _OpenBucket:
         """Fold several finer-bucket records into one accumulator (all
         counters are additive; first/last ride min/max; last_ok follows
-        the newest last_ts)."""
+        the newest last_ts; sketches merge bucket-wise — exactly
+        associative, so the reconstruction order cannot matter)."""
         b = _OpenBucket()
         for e in sorted(recs, key=lambda r: r.get("first_ts") or 0):
+            sk = e.get("sk")
+            if isinstance(sk, dict):
+                for metric, doc in sk.items():
+                    loaded = sketch_from_state(doc)
+                    if loaded is None:
+                        continue
+                    existing = b.sketches.get(metric)
+                    if existing is None:
+                        b.sketches[metric] = loaded
+                    elif existing.alpha == loaded.alpha:
+                        existing.merge(loaded)
             b.n += int(e.get("n") or 0)
             b.ok += int(e.get("ok") or 0)
             b.flips += int(e.get("flips") or 0)
@@ -353,12 +397,27 @@ class SegmentStore:
             if s and s["last_ok"] is False and s["last_ts"] is not None:
                 self._failing_since.setdefault(node, s["last_ts"])
 
-    def _fold_into_stats(self, node: str, rec: dict) -> None:
-        s = self.node_stats.setdefault(node, {
+    @staticmethod
+    def _fresh_stats() -> dict:
+        return {
             "n": 0, "ok": 0, "flips": 0, "onsets": 0, "repairs": 0,
             "repair_s": 0.0, "first_ts": None, "last_ts": None,
-            "last_ok": None,
-        })
+            "last_ok": None, "sketches": {},
+        }
+
+    def _fold_into_stats(self, node: str, rec: dict) -> None:
+        s = self.node_stats.setdefault(node, self._fresh_stats())
+        sk = rec.get("sk")
+        if isinstance(sk, dict):
+            for metric, doc in sk.items():
+                loaded = sketch_from_state(doc)
+                if loaded is None:
+                    continue
+                existing = s["sketches"].get(metric)
+                if existing is None:
+                    s["sketches"][metric] = loaded
+                elif existing.alpha == loaded.alpha:
+                    existing.merge(loaded)
         s["n"] += int(rec.get("n") or 0)
         s["ok"] += int(rec.get("ok") or 0)
         s["flips"] += int(rec.get("flips") or 0)
@@ -392,6 +451,14 @@ class SegmentStore:
             onset = ts
         elif ok and node in self._failing_since:
             repair_s = max(0.0, ts - self._failing_since.pop(node))
+        # Latency-shaped samples this verdict yields: a completed repair's
+        # duration, and — while a failure is in flight — its current age
+        # (the repair-age distribution a pager duty dashboard percentiles).
+        samples: Dict[str, List[float]] = {}
+        if repair_s is not None:
+            samples["mttr_s"] = [repair_s]
+        if not ok:
+            samples["repair_age_s"] = [max(0.0, ts - self._failing_since[node])]
         for res in RESOLUTIONS:
             key = (node, res, bucket_start(ts, res))
             b = self._open.get(key)
@@ -409,6 +476,7 @@ class SegmentStore:
                 b.first_ts = ts
             b.last_ts = ts
             b.last_ok = ok
+            self._sketch_into(b.sketches, samples)
         # The running fold sees the verdict once, at the finest grain.
         self._fold_into_stats(node, {
             "n": 1, "ok": 1 if ok else 0, "flips": 1 if flipped else 0,
@@ -417,6 +485,54 @@ class SegmentStore:
             "repair_s": repair_s or 0.0,
             "first_ts": ts, "last_ts": ts, "last_ok": ok,
         })
+        stats = self.node_stats[node]
+        self._sketch_into(stats["sketches"], samples)
+        for metric, values in samples.items():
+            self.sketch_samples_total[metric] = (
+                self.sketch_samples_total.get(metric, 0) + len(values)
+            )
+
+    def observe_samples(self, node: str, ts: float,
+                        samples: Dict[str, List[float]]) -> None:
+        """Fold latency samples (no verdict) into ``node``'s open-bucket
+        and running sketches — the fleet streams' entry point
+        (``observe_samples(FLEET_STREAM, now, {"round_ms": [ms]})``).
+        Buckets created here carry ``n=0``: they hold distribution data,
+        not rounds, and the SLO counters ignore them."""
+        samples = {
+            metric: [float(v) for v in values]
+            for metric, values in samples.items() if values
+        }
+        if not samples:
+            return
+        for res in RESOLUTIONS:
+            key = (node, res, bucket_start(ts, res))
+            b = self._open.get(key)
+            if b is None:
+                b = self._open[key] = _OpenBucket()
+            if b.first_ts is None:
+                b.first_ts = ts
+            b.last_ts = ts
+            self._sketch_into(b.sketches, samples)
+        s = self.node_stats.setdefault(node, self._fresh_stats())
+        if s["first_ts"] is None or ts < s["first_ts"]:
+            s["first_ts"] = ts
+        if s["last_ts"] is None or ts >= s["last_ts"]:
+            s["last_ts"] = ts
+        self._sketch_into(s["sketches"], samples)
+        for metric, values in samples.items():
+            self.sketch_samples_total[metric] = (
+                self.sketch_samples_total.get(metric, 0) + len(values)
+            )
+
+    @staticmethod
+    def _sketch_into(sketches: Dict[str, Sketch],
+                     samples: Dict[str, List[float]]) -> None:
+        for metric, values in samples.items():
+            sk = sketches.get(metric)
+            if sk is None:
+                sk = sketches[metric] = Sketch()
+            sk.extend(values)
 
     # -- flush / compaction --------------------------------------------------
 
@@ -432,6 +548,14 @@ class SegmentStore:
             "last_ts": round(b.last_ts, 3) if b.last_ts is not None else None,
             "last_ok": b.last_ok,
         }
+        if b.sketches:
+            # Sketch persistence rides the same schema-stamped line as
+            # the counters; absent when empty so sketch-less deployments
+            # keep their exact pre-sketch bytes.
+            rec["sk"] = {
+                metric: sketch_state(sk)
+                for metric, sk in sorted(b.sketches.items())
+            }
         rec.update(self.node_groups.get(node, {}))
         return rec
 

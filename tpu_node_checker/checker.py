@@ -877,6 +877,29 @@ def _mesh_link_samples(accel: List[NodeInfo]) -> List[tuple]:
     return samples
 
 
+def _fold_round_samples(analytics, accel: List[NodeInfo], timer) -> None:
+    """Fold this round's duration samples into the reserved ``_fleet``
+    roll-up stream: round wall-clock (ms) and the deduplicated per-link
+    sweep medians (µs).  One ``observe_samples`` call — the sketches land
+    in whatever 1m/15m/6h buckets are open right now and persist through
+    the same TNC021-gated append path as verdict counters."""
+    import time as _time
+
+    from tpu_node_checker.analytics.segments import FLEET_STREAM
+
+    samples: Dict[str, List[float]] = {}
+    round_ms = timer.total_ms()
+    if round_ms > 0:
+        samples["round_ms"] = [round_ms]
+    link_us = [p50 for _domain, _axis, p50 in _mesh_link_samples(accel)]
+    if link_us:
+        samples["link_us"] = link_us
+    if samples:
+        analytics["store"].observe_samples(
+            FLEET_STREAM, round(_time.time(), 3), samples
+        )
+
+
 def _observe_link_drift(analytics, accel: List[NodeInfo], fsm, args=None,
                         events=None, trace_id=None,
                         round_seq: int = 0) -> List[dict]:
@@ -951,7 +974,7 @@ def _observe_link_drift(analytics, accel: List[NodeInfo], fsm, args=None,
 
 def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
                     args=None, events=None, trace_id=None,
-                    round_seq: int = 0) -> List[dict]:
+                    round_seq: int = 0, steady=None) -> List[dict]:
     """Feed this round's verdicts through the FSM and queue store lines.
 
     With an ``analytics`` bundle (``--analytics``), every boolean verdict
@@ -961,6 +984,15 @@ def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
     seam) BEFORE the store line and payload are stamped, so the persisted
     round and the served state agree.  Returns the round's prediction
     records (empty without analytics).
+
+    ``steady`` carries the watch-stream tick path's UNCHANGED nodes:
+    their current verdicts fold into analytics (roll-up buckets keep
+    counting, CUSUM scores keep draining) but they neither re-observe the
+    FSM nor append history lines — the stream mode's evidence discipline
+    (DESIGN.md §12: the FSM sees changed nodes only) is untouched, while
+    a steady fleet still produces roll-ups instead of none at all.  A
+    steady node the FSM has never observed folds nothing (analytics must
+    not mint state from a node whose first real round hasn't landed).
 
     Verdict rules:
 
@@ -995,7 +1027,10 @@ def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
         # groups analytics — inferred hostnames would mint per-restart
         # groups.
         cluster = name if source in ("flag", "env") else None
-    for n in accel:
+    rounds = [(n, False) for n in accel]
+    if steady:
+        rounds.extend((n, True) for n in steady)
+    for n, is_steady in rounds:
         verdict: Optional[bool] = n.effectively_ready
         if n.quarantined_by_us and n.probe is None:
             verdict = None
@@ -1022,17 +1057,20 @@ def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
             # non-bool verdict.
             verdict = DEGRADED
         out_of_band = n.quarantined_by_us and not n.cordoned
+        if is_steady and n.name not in fsm.nodes:
+            continue
         if verdict is None and n.name not in fsm.nodes and not out_of_band:
             # No evidence about a node this machine has NEVER observed:
             # record nothing and attach nothing.  Minting (and persisting)
             # a default-HEALTHY machine here would seed uncordon-eligible
             # state from pure absence — a restart would then trust it.
             continue
-        fsm.observe(
-            n.name,
-            verdict,
-            uncordoned_out_of_band=out_of_band,
-        )
+        if not is_steady:
+            fsm.observe(
+                n.name,
+                verdict,
+                uncordoned_out_of_band=out_of_band,
+            )
         if analytics is not None and isinstance(verdict, bool):
             detector, seg_store = analytics["detector"], analytics["store"]
             flipped = detector.flip(n.name, verdict)
@@ -1058,6 +1096,10 @@ def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
             )
         h = fsm.health(n.name)
         n.health = {"state": h.state, "streak": h.streak, "flaps": h.flaps}
+        if is_steady:
+            # Unchanged node: analytics folded above; no history line —
+            # the store records evidence, and nothing changed.
+            continue
         store.record(
             {
                 "node": n.name,
@@ -1098,7 +1140,11 @@ def _update_history(history: dict, accel: List[NodeInfo], analytics=None,
         # tracks THIS round's fleet, like the FSM state gauges.  The
         # store's lifetime aggregates deliberately keep departed nodes
         # (the flaps_total-counter policy) until retention ages them out.
-        analytics["detector"].prune({n.name for n in accel})
+        # On the tick path "this round's fleet" is changed ∪ steady.
+        fleet_names = {n.name for n in accel}
+        if steady:
+            fleet_names.update(n.name for n in steady)
+        analytics["detector"].prune(fleet_names)
         # Close+append buckets whose window passed; compaction rides the
         # same call when a shard outgrew its live set.
         analytics["store"].flush(now)
@@ -1944,6 +1990,9 @@ def run_check(args, nodes: Optional[List[dict]] = None,
                 "buckets": seg_store.bucket_counts(),
                 "rollup_lines_total": seg_store.rollup_lines_total,
                 "compactions_total": seg_store.compactions_total,
+                "sketch_samples": dict(
+                    sorted(seg_store.sketch_samples_total.items())
+                ),
             }
         for phase_name, rep in (("cordon", cordon_report),
                                 ("cordon_degraded", degraded_report),
@@ -1978,6 +2027,13 @@ def run_check(args, nodes: Optional[List[dict]] = None,
         payload["trace_id"] = timer.trace_id
         payload["exit_code"] = result.exit_code
     if analytics is not None:
+        # Fleet-wide duration streams: this round's wall-clock cost and
+        # the deduped per-link sweep medians fold into the same roll-up
+        # buckets as verdicts (the reserved "_fleet" stream), so round
+        # and link duration percentiles merge at the aggregator exactly
+        # like MTTR sketches do.  Folded BEFORE the query phase so the
+        # docs served this round already include this round.
+        _fold_round_samples(analytics, accel, timer)
         # Query documents for GET /api/v1/analytics/* — computed from
         # roll-ups (never raw replay), serialized once by the server's
         # publish_analytics, served as atomically-swapped entities.

@@ -534,15 +534,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             ("--probe", args.probe),
             ("--probe-results", args.probe_results),
             ("--node-events", args.node_events),
-            ("--analytics", args.analytics),
         ):
             if val:
                 # Silent-no-op rule: these surfaces gather evidence OUTSIDE
                 # the node-object stream, which the incremental tick does
                 # not re-poll — accepting them would quietly grade on stale
                 # probe/event data the operator thinks is fresh.
-                # (--analytics rides the probe-verdict history stream, so
-                # it waits for the same stream-mode evidence story.)
+                # (--analytics is NOT in this list: roll-up folding rides
+                # the tick path itself — steady nodes fold their current
+                # verdicts each tick — so stream rounds produce the same
+                # buckets poll rounds do.)
                 p.error(f"{flag} is not supported with --watch-stream yet "
                         "(use poll-mode --watch)")
     if args.serve_token and args.serve is None:

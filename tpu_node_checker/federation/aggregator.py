@@ -348,6 +348,14 @@ class _FeedClient:
         summary = blocks.get("summary")
         if isinstance(summary, dict):
             view.summary_doc = summary
+        slo = blocks.get("analytics_slo")
+        # Sketch blocks propagate at delta speed: the upstream's slo doc
+        # rides the same frame as its node delta, so the global analytics
+        # view moves without waiting for a poll round.  ``blocks`` is the
+        # upstream's COMPLETE current block set — absence means it
+        # stopped serving analytics, and the view must drop out of the
+        # global doc rather than freeze in it.
+        view.set_analytics(slo if isinstance(slo, dict) else None)
         view.feed_blocks = blocks or None
         view.record_success()
         return True
@@ -542,11 +550,16 @@ class FederationEngine:
 
     def _fetch_view(self, session, view: ClusterView,
                     base_headers: dict) -> None:
-        """The two conditional GETs against this upstream's tier surface:
-        the per-cluster paths for a checker, ``/api/v1/global/*`` when the
-        upstream has been discovered to be an aggregator itself."""
+        """The three conditional GETs against this upstream's tier
+        surface: the per-cluster paths for a checker, ``/api/v1/global/*``
+        when the upstream has been discovered to be an aggregator itself.
+        Summary and nodes are mandatory (their failure degrades the
+        shard); the analytics SLO doc is optional — a 404 just means the
+        upstream runs without ``--analytics`` and drops out of the global
+        analytics view."""
         base = ("/api/v1/global" if view.tier == "aggregator"
                 else "/api/v1")
+        fresh_before = view.fetch_fresh
         resp, etag = _fetch_entity(
             session, view, base_headers, base + "/summary",
             view.summary_etag,
@@ -593,6 +606,47 @@ class FederationEngine:
                 session, view, base_headers, resp
             )
         view.nodes_etag = etag
+        if not view.analytics_unsupported or view.fetch_fresh != fresh_before:
+            # 404-negative-cached: an upstream that answered "no
+            # analytics" is not re-asked on steady (all-304) rounds —
+            # only when a mandatory surface served fresh content, i.e.
+            # the upstream observably changed (restart, new round shape).
+            self._fetch_analytics(session, view, base_headers)
+
+    def _fetch_analytics(self, session, view: ClusterView,
+                         base_headers: dict) -> None:
+        """The optional analytics leg: a checker serves its slo doc at
+        ``/api/v1/analytics/slo``; a lower aggregator re-exports its
+        MERGED doc at ``/api/v1/global/analytics`` (same entry shape, so
+        tier stacking merges uniformly).  Conditional on the view's
+        analytics fingerprint; 404 clears the doc without failing the
+        shard; any other error is a real fetch failure like the mandatory
+        legs (a flapping analytics endpoint must not be silently stale).
+        """
+        path = (
+            "/api/v1/global/analytics" if view.tier == "aggregator"
+            else "/api/v1/analytics/slo"
+        )
+        headers = dict(base_headers)
+        if view.analytics_fp:
+            headers["If-None-Match"] = view.analytics_fp
+        resp = session.get(view.url + path, headers=headers,
+                           timeout=FETCH_TIMEOUT_S)
+        if resp.status_code == 304:
+            view.fetch_not_modified += 1
+            return
+        if resp.status_code == 404:
+            view.analytics_unsupported = True
+            view.set_analytics(None)
+            return
+        if resp.status_code != 200:
+            raise FetchError(f"{path}: HTTP {resp.status_code}")
+        doc = resp.json()
+        if not isinstance(doc, dict):
+            raise FetchError(path + ": not a JSON object")
+        view.analytics_unsupported = False
+        view.fetch_fresh += 1
+        view.set_analytics(doc, fp=resp.headers.get("etag"))
 
     def _stitch_upstream_trace(self, session, view: ClusterView,
                                base_headers: dict, resp) -> None:
@@ -968,6 +1022,41 @@ class FederationEngine:
             "# TYPE tpu_node_checker_last_run_timestamp_seconds gauge",
             _line("tpu_node_checker_last_run_timestamp_seconds", time.time()),
         ]
+        snap = self._prev
+        analytics = getattr(snap, "analytics_doc", None) if snap else None
+        if analytics is not None:
+            lines += [
+                "# HELP tpu_node_checker_analytics_global_clusters Clusters "
+                "contributing a mergeable SLO sketch block to the global "
+                "analytics view.",
+                "# TYPE tpu_node_checker_analytics_global_clusters gauge",
+                _line("tpu_node_checker_analytics_global_clusters",
+                      float(len(analytics.get("clusters") or {}))),
+                "# HELP tpu_node_checker_analytics_global_slo Fleet-wide "
+                "SLO percentiles from merged sketches (availability in "
+                "percent, MTBF/MTTR in seconds; quantiles within the "
+                "sketch error bound).",
+                "# TYPE tpu_node_checker_analytics_global_slo gauge",
+            ]
+            fleet = analytics.get("fleet") or {}
+            for metric in ("availability_pct", "mtbf_s", "mttr_s"):
+                pctls = fleet.get(metric)
+                if not isinstance(pctls, dict):
+                    continue
+                for q, value in sorted(pctls.items()):
+                    if isinstance(value, (int, float)):
+                        lines.append(_line(
+                            "tpu_node_checker_analytics_global_slo",
+                            float(value), {"metric": metric, "q": q},
+                        ))
+            lines += [
+                "# HELP tpu_node_checker_analytics_global_merge_ms Wall-"
+                "clock of the last global analytics sketch merge (0 while "
+                "the merged entity is being reused unchanged).",
+                "# TYPE tpu_node_checker_analytics_global_merge_ms gauge",
+                _line("tpu_node_checker_analytics_global_merge_ms",
+                      round(getattr(snap, "analytics_merge_ms", 0.0), 3)),
+            ]
         if self.lease_budget is not None:
             lines += [
                 "# HELP tpu_node_checker_federation_lease_total Disruption "

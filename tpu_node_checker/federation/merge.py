@@ -34,6 +34,11 @@ import json
 import time
 from typing import Dict, List, Optional, Tuple
 
+from tpu_node_checker.analytics.sketch import (
+    DEFAULT_ALPHA,
+    Sketch,
+    merge_docs,
+)
 from tpu_node_checker.server.snapshot import (
     _GZIP_LEVEL,
     _GZIP_MIN_BYTES,
@@ -109,6 +114,8 @@ class ClusterView:
         "summary_doc", "summary_etag",
         "nodes_entries", "nodes_etag", "nodes_fp", "nodes_count",
         "nodes_round", "nodes_head", "entries_key", "tier", "feed_blocks",
+        "analytics_doc", "analytics_fp", "analytics_rev",
+        "analytics_unsupported", "analytics_sketches",
         "reported_cluster",
         "upstream_trace", "upstream_trace_events",
         "consecutive_failures", "rounds_behind", "last_success_wall",
@@ -140,6 +147,24 @@ class ClusterView:
         # surfaced through /api/v1/global/clusters detail, never spliced
         # into the merged nodes body (poll and feed bytes must agree).
         self.feed_blocks: Optional[dict] = None
+        # This cluster's last-known analytics SLO doc (the ``analytics_slo``
+        # feed block, or the polled /api/v1/analytics/slo body) — the raw
+        # material of the global analytics merge.  ``analytics_rev`` bumps
+        # only when the doc CHANGES, so the merge's reuse signature can
+        # tell a quiet cluster from a moved one without comparing docs.
+        self.analytics_doc: Optional[dict] = None
+        self.analytics_fp: Optional[str] = None
+        self.analytics_rev = 0
+        # Lazy per-doc parse memo (sub-doc id → Sketch), reset whenever
+        # the doc changes: a quiet shard's sketches deserialize ONCE, not
+        # once per global merge — the federation's bytes-not-objects
+        # reuse discipline applied to the analytics tier.
+        self.analytics_sketches: dict = {}
+        # Negative cache for the optional analytics leg: a 404 means the
+        # upstream runs without --analytics, and a steady round must not
+        # keep re-asking — the fetch tier re-probes only when a mandatory
+        # surface served fresh content (the upstream observably changed).
+        self.analytics_unsupported = False
         # Cache identity of nodes_entries: the upstream ETag, or a content
         # hash when the upstream sends none (a validator-stripping proxy
         # must not freeze the merged bytes at their first-fetched content).
@@ -184,6 +209,31 @@ class ClusterView:
         self.consecutive_failures += 1
         self.rounds_behind += 1
         self.last_error = error
+
+    def set_analytics(self, doc: Optional[dict],
+                      fp: Optional[str] = None) -> None:
+        """Install this cluster's analytics SLO doc (None clears it — an
+        upstream that stopped serving analytics must 404 out of the
+        global view, not freeze in it).  ``fp`` is the upstream's ETag
+        when the poll path has one; the feed path passes None and the doc
+        is compared directly (feed blocks only arrive when changed, so
+        the comparison is rarely reached and never hot)."""
+        if doc is None:
+            if self.analytics_doc is not None:
+                self.analytics_doc = None
+                self.analytics_fp = None
+                self.analytics_sketches = {}
+                self.analytics_rev += 1
+            return
+        if self.analytics_doc is not None:
+            if fp is not None and fp == self.analytics_fp:
+                return
+            if fp is None and doc == self.analytics_doc:
+                return
+        self.analytics_doc = doc
+        self.analytics_fp = fp
+        self.analytics_sketches = {}
+        self.analytics_rev += 1
 
     # -- derived state ---------------------------------------------------------
 
@@ -257,8 +307,9 @@ class GlobalSnapshot:
     """
 
     __slots__ = ("seq", "ts", "trace_id", "entities", "cluster_entities",
-                 "nodes_sig", "cluster_blocks", "nodes_head", "block_gz",
-                 "summary_doc")
+                 "nodes_sig", "analytics_sig", "analytics_doc",
+                 "analytics_merge_ms", "cluster_blocks", "nodes_head",
+                 "block_gz", "summary_doc")
 
     def __init__(self, seq: int, ts: float):
         self.seq = seq
@@ -269,6 +320,14 @@ class GlobalSnapshot:
         self.entities: Dict[str, Entity] = {}
         self.cluster_entities: Dict[str, Entity] = {}
         self.nodes_sig: tuple = ()
+        # Reuse signature + parsed doc of the global analytics entity:
+        # (cluster, analytics_rev) pairs — unchanged revs mean the merged
+        # sketches cannot have moved, so bytes, gzip and ETag serve on.
+        # The parsed doc stays on the snapshot for the metrics renderer
+        # (re-parsing our own entity bytes every scrape would be silly).
+        self.analytics_sig: tuple = ()
+        self.analytics_doc: Optional[dict] = None
+        self.analytics_merge_ms = 0.0
         # The watch feed's raw material (this aggregator SERVES the same
         # feed it consumes): per-cluster block bytes in body order, the
         # head the body's prefix was spliced from, and the cached mid-run
@@ -366,6 +425,170 @@ def build_global_summary(views: List[ClusterView], seq: int, ts: float,
     }
 
 
+def _cached_sketch(view: ClusterView, doc) -> Optional[Sketch]:
+    """Deserialize a sketch doc through the view's parse memo.  Sub-docs
+    are identity-stable for as long as ``analytics_doc`` is installed
+    (``set_analytics`` swaps doc and memo together), so a quiet shard's
+    sketches parse once per delta, not once per global merge.  The cached
+    Sketch is never mutated: ``merge_docs`` copies caller-owned objects
+    before folding into them."""
+    if not isinstance(doc, dict):
+        return None
+    memo = view.analytics_sketches
+    key = id(doc)
+    sk = memo.get(key)
+    if sk is None and key not in memo:
+        sk = memo[key] = Sketch.from_doc(doc)
+    return sk
+
+
+def _merged_slo_entry(entries: List[Tuple[ClusterView, dict]]) -> dict:
+    """Merge slo entries (fleet blocks or same-key group rows) into one:
+    node counts add, per-metric sketches merge bucket-wise, and the
+    percentile triplets are re-derived from the MERGED sketch — never
+    averaged from the inputs' percentiles (averaging percentiles is the
+    classic federation lie; merging sketches is the whole point).
+
+    Single-contributor entries — most groups in a wide merge, since a
+    slice lives in exactly one cluster — memoize their WHOLE result
+    beside the view's sketch memo: the derived percentiles cannot change
+    while the installed doc doesn't, so a quiet shard's groups cost a
+    dict lookup per round.  Callers splat the result into fresh dicts,
+    so the cached object is never mutated."""
+    if len(entries) == 1:
+        view, entry = entries[0]
+        memo = view.analytics_sketches
+        key = ("entry", id(entry))
+        cached = memo.get(key)
+        if cached is None:
+            cached = memo[key] = _compute_slo_entry(entries)
+        return cached
+    return _compute_slo_entry(entries)
+
+
+def _compute_slo_entry(entries: List[Tuple[ClusterView, dict]]) -> dict:
+    out: dict = {"nodes": sum(e.get("nodes") or 0 for _, e in entries)}
+    sketches: Dict[str, Optional[dict]] = {}
+    for metric in ("availability_pct", "mtbf_s", "mttr_s"):
+        docs = [(e.get("sketches") or {}).get(metric) for _, e in entries]
+        merged = merge_docs(
+            _cached_sketch(v, doc)
+            for (v, _), doc in zip(entries, docs)
+        )
+        if merged is not None and merged.total:
+            out[metric] = merged.percentiles()
+            # Single-contributor groups (most of a 100-cluster merge:
+            # every per-slice group appears in exactly one cluster's doc)
+            # re-export the upstream's own doc — a re-serialization would
+            # say the same bytes slower.
+            if len(docs) == 1 and isinstance(docs[0], dict):
+                sketches[metric] = docs[0]
+            else:
+                sketches[metric] = merged.to_doc()
+        else:
+            out[metric] = None
+            sketches[metric] = None
+    # Re-exported so the tier above can merge again: the global doc's
+    # entries keep the exact shape of a checker's slo entries.
+    out["sketches"] = sketches
+    return out
+
+
+def build_global_analytics(views: List[ClusterView]) -> Optional[dict]:
+    """N per-cluster SLO docs → one global analytics doc, sketch-merge
+    only (never raw replay, never re-fetching node bodies).
+
+    The output deliberately mirrors the per-cluster slo doc's shape —
+    ``fleet`` / ``groups`` / ``streams`` / ``offenders`` / ``sketch_alpha``
+    — so an aggregator-of-aggregators consumes a lower aggregator's
+    ``/api/v1/global/analytics`` body with this very function (the same
+    tier-stacking trick ``extract_entries`` plays for node bodies).
+
+    A checker-tier doc (``source: "rollups"``) that carries no explicit
+    cluster group (no ``--cluster-name``) gets one synthesized from its
+    fleet sketches under the endpoints-file name, so "grouped by cluster"
+    holds fleet-wide without forcing every upstream to restate identity.
+    Stale shards contribute their LAST-KNOWN sketches, labeled in
+    ``clusters`` — the shard-degraded-never-fleet rule, analytics flavor.
+    """
+    from tpu_node_checker.analytics.queries import OFFENDERS_CAP
+
+    docs = [
+        (v, v.analytics_doc)
+        for v in sorted(views, key=lambda v: v.name)
+        if v.analytics_doc is not None
+    ]
+    if not docs:
+        return None
+    alpha = next(
+        (
+            d.get("sketch_alpha") for _, d in docs
+            if isinstance(d.get("sketch_alpha"), (int, float))
+        ),
+        DEFAULT_ALPHA,
+    )
+    clusters: Dict[str, dict] = {}
+    fleet_entries: List[Tuple[ClusterView, dict]] = []
+    grouped: Dict[Tuple[str, str], List[Tuple[ClusterView, dict]]] = {}
+    offenders: List[dict] = []
+    stream_docs: Dict[str, List[Tuple[ClusterView, dict]]] = {}
+    for v, doc in docs:
+        fleet = doc.get("fleet") or {}
+        fleet_entries.append((v, fleet))
+        clusters[v.name] = {
+            "nodes": fleet.get("nodes") or 0,
+            "stale": v.stale,
+        }
+        contributes_cluster_group = False
+        for g in doc.get("groups") or ():
+            kind, group = g.get("kind"), g.get("group")
+            if not kind or not group:
+                continue
+            grouped.setdefault((kind, group), []).append((v, g))
+            if kind == "cluster" and group == v.name:
+                contributes_cluster_group = True
+        if doc.get("source") == "rollups" and not contributes_cluster_group:
+            grouped.setdefault(("cluster", v.name), []).append((v, fleet))
+        for o in doc.get("offenders") or ():
+            if isinstance(o, dict) and o.get("node"):
+                offenders.append({**o, "cluster": o.get("cluster") or v.name})
+        streams = doc.get("streams")
+        if isinstance(streams, dict):
+            for name, sdoc in streams.items():
+                stream_docs.setdefault(name, []).append((v, sdoc))
+    # Fleet-wide re-rank over the UNION of every cluster's worst: same
+    # sort key as the per-cluster offenders doc, cluster stamped so the
+    # repair queue reads "which machine, where".
+    offenders.sort(key=lambda o: (
+        o["availability_pct"] if o.get("availability_pct") is not None
+        else 100.0,
+        -(o.get("flips") or 0),
+        o.get("cluster") or "",
+        o["node"],
+    ))
+    merged_streams: Dict[str, dict] = {}
+    for name, pairs in sorted(stream_docs.items()):
+        merged = merge_docs(_cached_sketch(v, sdoc) for v, sdoc in pairs)
+        if merged is not None and merged.total:
+            # Same single-contributor reuse as the slo entries.
+            if len(pairs) == 1 and isinstance(pairs[0][1], dict):
+                merged_streams[name] = pairs[0][1]
+            else:
+                merged_streams[name] = merged.to_doc()
+    return {
+        "clusters": clusters,
+        "fleet": _merged_slo_entry(fleet_entries),
+        "groups": [
+            {"kind": kind, "group": group, **_merged_slo_entry(entries)}
+            for (kind, group), entries in sorted(grouped.items())
+        ],
+        "offenders": offenders[:OFFENDERS_CAP],
+        "streams": merged_streams,
+        "sketch_alpha": alpha,
+        "source": "sketches",
+    }
+
+
 def build_global_snapshot(
     views: List[ClusterView],
     seq: int,
@@ -399,6 +622,34 @@ def build_global_snapshot(
             {"round": seq, "ts": ts, "cluster": entry,
              "summary": view.summary_doc}
         )
+
+    with_analytics = [v for v in views if v.analytics_doc is not None]
+    snap.analytics_sig = tuple(
+        (v.name, v.analytics_rev) for v in with_analytics
+    )
+    if with_analytics:
+        if (
+            prev is not None
+            and snap.analytics_sig == prev.analytics_sig
+            and "global/analytics" in prev.entities
+        ):
+            # No cluster's analytics rev moved: the merged doc cannot
+            # differ — bytes, gzip and ETag serve on (pollers keep
+            # 304-ing), and the metrics renderer keeps the parsed doc.
+            snap.entities["global/analytics"] = prev.entities["global/analytics"]
+            snap.analytics_doc = prev.analytics_doc
+            snap.analytics_merge_ms = prev.analytics_merge_ms
+        else:
+            merge_t0 = time.perf_counter()
+            analytics = build_global_analytics(views)
+            if analytics is not None:
+                snap.analytics_doc = analytics
+                snap.analytics_merge_ms = round(
+                    (time.perf_counter() - merge_t0) * 1000.0, 3
+                )
+                snap.entities["global/analytics"] = json_entity(
+                    {"round": seq, "ts": ts, **analytics}
+                )
 
     with_nodes = [v for v in views if v.nodes_entries is not None]
     snap.nodes_sig = tuple(

@@ -146,6 +146,15 @@ Exported metric families:
   ``tpu_node_checker_analytics_compactions_total`` — segment-store write
   telemetry: lines appended through the ``append_bucket`` gate, and
   atomic tmp+rename shard compactions;
+* ``tpu_node_checker_analytics_sketch_samples_total{metric}`` — duration
+  samples folded into the mergeable percentile sketches, by stream
+  (``mttr_s`` / ``repair_age_s`` / ``round_ms`` / ``link_us``);
+* ``tpu_node_checker_analytics_global_clusters`` /
+  ``tpu_node_checker_analytics_global_slo{metric,q}`` /
+  ``tpu_node_checker_analytics_global_merge_ms`` — the ``--federate``
+  aggregator's global analytics view (rendered by its own scrape body,
+  not this module): clusters contributing sketch blocks, fleet-wide SLO
+  percentiles from merged sketches, and the last sketch-merge cost;
 * ``tpu_node_checker_federation_lease_total{result}`` /
   ``tpu_node_checker_federation_fleet_budget_remaining`` — the
   ``--federate`` aggregator's disruption-lease traffic (granted permits
@@ -730,6 +739,18 @@ def render_metrics(
             "shard's live bucket set).",
             [({}, float(analytics.get("compactions_total", 0)))],
         )
+        sketch_samples = analytics.get("sketch_samples")
+        if sketch_samples:
+            family(
+                "tpu_node_checker_analytics_sketch_samples_total",
+                "counter",
+                "Duration samples folded into mergeable percentile "
+                "sketches, by metric stream (mttr_s / repair_age_s / "
+                "round_ms / link_us) — the raw material of the federated "
+                "SLO percentiles.",
+                [({"metric": metric}, float(n))
+                 for metric, n in sorted(sketch_samples.items())],
+            )
     transport = payload.get("api_transport")
     if transport:
         # Keep-alive pool telemetry (session-lifetime counters): opened

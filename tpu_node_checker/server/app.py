@@ -101,8 +101,11 @@ _METRICS_STATS_GZIP_LEVEL = 1
 _FAST_PATHS = ("summary", "nodes", "slices")
 
 # The federation aggregator's hot read surface (GlobalSnapshot entity keys
-# → fast-table paths); per-cluster detail rides the routed fallback.
-_GLOBAL_FAST_PATHS = ("global/summary", "global/clusters", "global/nodes")
+# → fast-table paths); per-cluster detail rides the routed fallback.  The
+# global analytics entity earns a slot because a dashboard fleet polling
+# SLOs is the same ≥100k req/s read shape as nodes/summary.
+_GLOBAL_FAST_PATHS = ("global/summary", "global/clusters", "global/nodes",
+                      "global/analytics")
 
 # Reusable no-op context for publish paths running without a tracer.
 _NULL_SPAN = _nullcontext()
@@ -363,6 +366,8 @@ class FleetStateServer:
                    self._get_global("global/clusters"))
         router.add("GET", "/api/v1/global/nodes",
                    self._get_global("global/nodes"))
+        router.add("GET", "/api/v1/global/analytics",
+                   self._get_global_analytics)
         router.add("GET", "/api/v1/global/clusters/{name}",
                    self._get_global_cluster)
         self.router = router
@@ -726,6 +731,31 @@ class FleetStateServer:
             )
 
         return handler
+
+    def _get_global_analytics(self, req: Request) -> Response:
+        """``GET /api/v1/global/analytics`` — unlike the always-present
+        global entities, this one exists only while at least one cluster
+        reports a mergeable SLO block, so absence is a 404 with a cause,
+        not a KeyError.  (When present it is normally answered by the
+        fast table; this handler is the cold/routed fallback.)"""
+        gsnap = self._global
+        if gsnap is None:
+            if not self._federation:
+                return self._not_an_aggregator()
+            return json_response(
+                503, {"error": "no federation round completed yet",
+                      "ready": False},
+            )
+        if "global/analytics" not in gsnap.entities:
+            return json_response(
+                404, {"error": "no cluster reports analytics "
+                               "(upstreams run without --analytics, or no "
+                               "analytics_slo block has arrived yet)"},
+            )
+        return self._stamp_round(
+            negotiate(gsnap.entity("global/analytics"), req.headers),
+            gsnap.seq, getattr(gsnap, "trace_id", None),
+        )
 
     def _get_global_cluster(self, req: Request) -> Response:
         gsnap = self._global

@@ -358,6 +358,11 @@ class StreamRoundEngine:
         self._entries_list: List[dict] = []
         self._last_result = None
         self._last_history_rollup: Optional[dict] = None
+        # This tick's analytics predictions (--analytics on the stream):
+        # steady ticks fold evidence but cannot flip, so the list empties
+        # on any tick without fresh detections — same semantics as the
+        # transition log.
+        self._last_predictions: List[dict] = []
         # Incremental slice cache (the relist fast path, one level up):
         # group membership, SliceInfo objects and their payload dicts are
         # rebuilt ONLY for groups touching a changed node — every other
@@ -469,7 +474,16 @@ class StreamRoundEngine:
         with timer.span("fold"):
             changed_raw, removed = self.cache.drain()
         if not changed_raw and not removed and self._last_result is not None:
-            return self._steady_result(timer), frozenset()
+            result = self._steady_result(timer)
+            # --analytics on a steady tick: the fleet's CURRENT verdicts
+            # still fold into the roll-up buckets (a healthy hour is
+            # availability evidence, not the absence of evidence) — this
+            # is what makes a steady --watch-stream fleet produce
+            # roll-ups at all.  Without the flag this is one falsy
+            # getattr: the zero-cost steady path stays zero-cost.
+            if getattr(self.args, "analytics", None):
+                self._fold_steady_analytics(timer, result)
+            return result, frozenset()
         changed = self._grade(changed_raw, removed, timer)
         result = self._build_result(timer, changed)
         self._last_result = result
@@ -511,6 +525,10 @@ class StreamRoundEngine:
                     self._entries.pop(name, None)
             self._accel_names = sorted(self._infos)
         history = checker._build_history(self.args)
+        analytics = (
+            checker._build_analytics(self.args) if history is not None
+            else None
+        )
         if history is not None:
             with timer.span("fsm"):
                 evidence = [
@@ -520,8 +538,25 @@ class StreamRoundEngine:
                 ]
                 # Only nodes with fresh events observe a verdict: a silent
                 # stream is no new evidence (DESIGN §12) — state, streaks
-                # and flap windows hold for everyone else.
-                checker._update_history(history, evidence)
+                # and flap windows hold for everyone else.  With
+                # --analytics the unchanged rest of the fleet rides along
+                # as ``steady``: their verdicts fold into roll-up buckets
+                # (and drain CUSUM scores) without touching FSM state or
+                # appending history lines.
+                steady = (
+                    [
+                        self._infos[n]
+                        for n in self._accel_names
+                        if n not in changed_names
+                    ]
+                    if analytics is not None else None
+                )
+                self._last_predictions = checker._update_history(
+                    history, evidence, analytics=analytics, args=self.args,
+                    trace_id=timer.trace_id,
+                    round_seq=getattr(timer, "round_seq", 0) or 0,
+                    steady=steady,
+                )
                 history["store"].flush()
             self._last_history_rollup = checker._history_payload(
                 history, [self._infos[n] for n in self._accel_names]
@@ -657,6 +692,36 @@ class StreamRoundEngine:
             payload["watch_stream"] = self.stats.as_dict()
             payload["trace_id"] = timer.trace_id
             payload["exit_code"] = exit_code
+        analytics = (
+            checker._build_analytics(self.args)
+            if checker._build_history(self.args) is not None else None
+        )
+        docs = None
+        if analytics is not None:
+            # Same round tail as run_check: fold this round's duration
+            # samples into the "_fleet" stream, stamp the payload's
+            # analytics telemetry block, then rebuild the query docs from
+            # roll-ups — stream and poll rounds serve identical surfaces.
+            checker._fold_round_samples(analytics, accel, timer)
+            detector, seg_store = analytics["detector"], analytics["store"]
+            payload["analytics"] = {
+                "predictions": self._last_predictions,
+                "predictions_total": detector.detections_total,
+                "suspects": sorted(detector.active),
+                "buckets": seg_store.bucket_counts(),
+                "rollup_lines_total": seg_store.rollup_lines_total,
+                "compactions_total": seg_store.compactions_total,
+                "sketch_samples": dict(
+                    sorted(seg_store.sketch_samples_total.items())
+                ),
+            }
+            from tpu_node_checker.analytics import build_analytics_docs
+
+            with timer.phase("analytics-query"):
+                docs = build_analytics_docs(
+                    seg_store, detector=detector,
+                    predictions=self._last_predictions,
+                )
         payload["timings_ms"] = timer.as_dict()
         result = checker.CheckResult(
             exit_code=exit_code,
@@ -666,6 +731,8 @@ class StreamRoundEngine:
             multislices=multislices,
             payload=payload,
         )
+        if docs is not None:
+            result.analytics_docs = docs
         return result
 
     def _steady_result(self, timer):
@@ -695,3 +762,52 @@ class StreamRoundEngine:
             multislices=last.multislices,
             payload=payload,
         )
+
+    def _fold_steady_analytics(self, timer, result) -> None:
+        """The steady tick's analytics leg (``--analytics``): every cached
+        node's CURRENT verdict folds into the roll-up buckets as steady
+        evidence — no FSM observes, no history lines, no flips possible —
+        then the query documents rebuild so the served SLO view keeps
+        moving while the fleet holds still.  This is the tentpole fix for
+        "a steady --watch-stream fleet has no roll-ups at all": before,
+        zero ticks reached the segment store; now every tick does."""
+        from tpu_node_checker import checker
+        from tpu_node_checker.analytics import build_analytics_docs
+
+        history = checker._build_history(self.args)
+        analytics = (
+            checker._build_analytics(self.args) if history is not None
+            else None
+        )
+        if analytics is None:
+            return
+        accel = list(result.accel or [])
+        with timer.span("fsm"):
+            checker._update_history(
+                history, [], analytics=analytics, args=self.args,
+                trace_id=timer.trace_id,
+                round_seq=getattr(timer, "round_seq", 0) or 0,
+                steady=accel,
+            )
+            history["store"].flush()
+        checker._fold_round_samples(analytics, accel, timer)
+        detector, seg_store = analytics["detector"], analytics["store"]
+        payload = result.payload
+        payload["analytics"] = {
+            "predictions": [],
+            "predictions_total": detector.detections_total,
+            "suspects": sorted(detector.active),
+            "buckets": seg_store.bucket_counts(),
+            "rollup_lines_total": seg_store.rollup_lines_total,
+            "compactions_total": seg_store.compactions_total,
+            "sketch_samples": dict(
+                sorted(seg_store.sketch_samples_total.items())
+            ),
+        }
+        with timer.phase("analytics-query"):
+            result.analytics_docs = build_analytics_docs(
+                seg_store, detector=detector, predictions=[],
+            )
+        # Refresh timings AFTER the analytics phases so the steady round's
+        # cost is honest about its new analytics leg.
+        payload["timings_ms"] = timer.as_dict()
