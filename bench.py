@@ -1927,37 +1927,11 @@ def main() -> int:
     trend_raw_p50 = trend_case["trend_100k_rounds_raw_p50_ms"]
     trend_speedup = trend_case["trend_100k_rounds_speedup"]
 
-    # -- tnc-lint whole-repo cost (the ISSUE 13 flow tier) ------------------
-    # The repo-wide lint is a CI gate, so its cost is part of the
-    # development loop's trajectory.  Two full runs (cold rule state each:
-    # run_project builds a fresh Project/graph per call); the flow tier's
-    # own budget — call-graph build + TNC111-113 — is ASSERTED < 10 s, and
-    # the run must be CLEAN: a bench number measured over a failing gate
-    # would be a number about nothing.
-    from tpu_node_checker.analysis.engine import run_project as _lint_repo
-
-    lint_totals = []
-    lint_flow = []
-    for _ in range(2):
-        lint_report = _lint_repo(os.path.dirname(os.path.abspath(__file__)))
-        assert lint_report.findings == [], (
-            "bench ran over a dirty lint gate: "
-            + "; ".join(f"{f.path}:{f.line} {f.code}" for f in
-                        lint_report.findings[:5])
-        )
-        t = lint_report.timings_ms
-        lint_totals.append(t["total"])
-        lint_flow.append(
-            t.get("graph_build", 0.0)
-            + sum(t.get(code, 0.0)
-                  for code in ("TNC111", "TNC112", "TNC113"))
-        )
-    lint_full_repo_p50 = _case_p50("lint_full_repo", lint_totals)
-    lint_graph_flow_p50 = _case_p50("lint_graph_flow", lint_flow)
-    assert lint_graph_flow_p50 < 10_000.0, (
-        f"graph build + TNC111-113 p50 {lint_graph_flow_p50:.0f}ms "
-        "breaches the 10s flow-tier budget"
-    )
+    # -- tnc-lint whole-repo cost (ISSUE 13 flow + ISSUE 20 typestate) ------
+    lint_case = _bench_lint_repo()
+    lint_full_repo_p50 = lint_case["lint_full_repo_p50_ms"]
+    lint_graph_flow_p50 = lint_case["lint_graph_flow_p50_ms"]
+    lint_typestate_p50 = lint_case["lint_typestate_p50_ms"]
 
     baseline_ms = 2000.0  # the <2 s north-star budget
     assert cold_p50 < baseline_ms, f"cold e2e p50 {cold_p50:.0f}ms breaches the 2s budget"
@@ -2010,6 +1984,7 @@ def main() -> int:
                 "trend_100k_rounds_speedup": round(trend_speedup, 1),
                 "lint_full_repo_p50_ms": round(lint_full_repo_p50, 2),
                 "lint_graph_flow_p50_ms": round(lint_graph_flow_p50, 2),
+                "lint_typestate_p50_ms": round(lint_typestate_p50, 2),
                 "serve_etag_hit_p50_ms": round(serve_etag_p50, 3),
                 "serve_cold_encode_p50_ms": round(serve_cold_p50, 3),
                 "serve_sustained_rps": round(serve_rps),
@@ -2053,6 +2028,56 @@ def main() -> int:
         )
     )
     return 0
+
+
+def _bench_lint_repo() -> dict:
+    """tnc-lint whole-repo cost (the ISSUE 13 flow tier + the ISSUE 20
+    typestate tier).  The repo-wide lint is a CI gate, so its cost is part
+    of the development loop's trajectory.  Two full runs (cold rule state
+    each: run_project builds a fresh Project/graph per call); the deep
+    tiers' budget — call-graph build + TNC111-113 plus typestate summary
+    build + TNC114-117 — is ASSERTED < 10 s, and the run must be CLEAN: a
+    bench number measured over a failing gate would be a number about
+    nothing."""
+    from tpu_node_checker.analysis.engine import run_project as _lint_repo
+
+    lint_totals = []
+    lint_flow = []
+    lint_typestate = []
+    for _ in range(2):
+        lint_report = _lint_repo(os.path.dirname(os.path.abspath(__file__)))
+        assert lint_report.findings == [], (
+            "bench ran over a dirty lint gate: "
+            + "; ".join(f"{f.path}:{f.line} {f.code}" for f in
+                        lint_report.findings[:5])
+        )
+        t = lint_report.timings_ms
+        lint_totals.append(t["total"])
+        lint_flow.append(
+            t.get("graph_build", 0.0)
+            + sum(t.get(code, 0.0)
+                  for code in ("TNC111", "TNC112", "TNC113"))
+        )
+        # The ISSUE 20 typestate tier on its own: summary build (escape +
+        # release/store fixpoints) plus the four rules riding it.
+        lint_typestate.append(
+            t.get("typestate_build", 0.0)
+            + sum(t.get(code, 0.0)
+                  for code in ("TNC114", "TNC115", "TNC116", "TNC117"))
+        )
+    lint_full_repo_p50 = _case_p50("lint_full_repo", lint_totals)
+    lint_graph_flow_p50 = _case_p50("lint_graph_flow", lint_flow)
+    lint_typestate_p50 = _case_p50("lint_typestate", lint_typestate)
+    assert lint_graph_flow_p50 + lint_typestate_p50 < 10_000.0, (
+        f"graph build + TNC111-113 p50 {lint_graph_flow_p50:.0f}ms "
+        f"+ typestate tier p50 {lint_typestate_p50:.0f}ms "
+        "breaches the 10s flow-tier budget"
+    )
+    return {
+        "lint_full_repo_p50_ms": round(lint_full_repo_p50, 2),
+        "lint_graph_flow_p50_ms": round(lint_graph_flow_p50, 2),
+        "lint_typestate_p50_ms": round(lint_typestate_p50, 2),
+    }
 
 
 def _provenance() -> dict:
@@ -2104,6 +2129,21 @@ if __name__ == "__main__":
         print(json.dumps({
             "metric": "global_slo_merge_p50_ms",
             "value": case["global_slo_merge_p50_ms"],
+            "unit": "ms",
+            **case,
+            "sample_stats": _SAMPLE_STATS,
+            "variance_warnings": _VARIANCE_WARNINGS,
+            **_provenance(),
+        }))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--lint":
+        # The tnc-lint case alone (clean-gate + 10s flow budget asserted
+        # inside): JSON on stdout with the same sample-stats/provenance
+        # honesty as a full run.
+        case = _bench_lint_repo()
+        print(json.dumps({
+            "metric": "lint_typestate_p50_ms",
+            "value": case["lint_typestate_p50_ms"],
             "unit": "ms",
             **case,
             "sample_stats": _SAMPLE_STATS,

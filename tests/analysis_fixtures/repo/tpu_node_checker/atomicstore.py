@@ -1,0 +1,43 @@
+"""Seeds for TNC116 (atomic-write): this module reads through a torn-
+tolerant loader, so every truncating write it makes must be the
+tmp-then-``os.replace`` idiom that keeps those readers honest."""
+
+import json
+import os
+
+
+def read_jsonl_tolerant(path):  # the loader call that marks this module
+    out = []
+    try:
+        with open(path, "rb") as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        return out
+    return out
+
+
+def load_rollups(path):
+    return read_jsonl_tolerant(path)
+
+
+def torn_overwrite(path, rows):
+    with open(path, "w") as fh:  # EXPECT[TNC116]
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+
+
+def atomic_overwrite(path, rows):  # near-miss: the sanctioned idiom
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+    os.replace(tmp, path)
+
+
+def append_only(path, row):  # near-miss: append IS the designed tolerance
+    with open(path, "a") as fh:
+        fh.write(json.dumps(row) + "\n")

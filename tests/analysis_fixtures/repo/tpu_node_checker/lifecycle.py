@@ -1,0 +1,111 @@
+"""Seeds for the typestate tier: TNC114 (exception-escape), TNC115
+(must-release), TNC117 (finally-hygiene) — one positive and the nearest
+near-miss for every shape the interpreter distinguishes."""
+
+import socket
+import threading
+
+_DEATHS: list = []
+
+
+# -- TNC114: a thread entry whose escape set is non-empty ------------------
+
+def doomed_worker():  # EXPECT[TNC114]
+    raise RuntimeError("boom")
+
+
+def spawn_doomed():
+    threading.Thread(target=doomed_worker, name="tnc-doomed",
+                     daemon=True).start()
+
+
+def recorded_worker():  # near-miss: the death is caught and recorded
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as exc:
+        _DEATHS.append(str(exc))
+
+
+def spawn_recorded():
+    threading.Thread(target=recorded_worker, name="tnc-recorded",
+                     daemon=True).start()
+
+
+# -- TNC115: acquire without release on some path --------------------------
+
+def leaky_socket(addr):
+    s = socket.socket()  # EXPECT[TNC115]
+    s.connect(addr)
+
+
+def may_fail(addr):
+    if not addr:
+        raise ValueError("no address")
+
+
+def exception_path_leak(addr):
+    s = socket.socket()  # EXPECT[TNC115]
+    may_fail(addr)  # raises past the close below: the accept-loop shape
+    s.close()
+
+
+def exception_safe(addr):  # near-miss: finally releases on every path
+    s = socket.socket()
+    try:
+        may_fail(addr)
+    finally:
+        s.close()
+
+
+def managed_socket(addr):  # near-miss: __exit__ releases on every path
+    with socket.socket() as s:
+        s.connect(addr)
+
+
+class Holder:
+    def adopt(self):  # near-miss: stored into self — obligation moves
+        self.sock = socket.socket()
+
+    def close(self):
+        self.sock.close()
+
+
+def close_it(s):
+    s.close()
+
+
+def handoff():  # near-miss: the callee's summary says it releases arg 0
+    s = socket.socket()
+    close_it(s)
+
+
+def minted():  # near-miss: returned — the caller owns it now
+    s = socket.socket()
+    return s
+
+
+def sanctioned_probe(addr):
+    # tnc: allow-must-release(standalone account: waiver kept while the probe API settles)
+    s = socket.socket()  # tnc: allow-must-release(probe socket hands its fd to the harness, which closes it)
+    s.connect(addr)
+
+
+# -- TNC117: release reachable only on the fall-through path ---------------
+
+def early_return_skips_close(path, flag):
+    fh = open(path, "rb")
+    if flag:
+        return None  # EXPECT[TNC117]
+    data = fh.read()
+    fh.close()
+    return data
+
+
+def finally_closed(path, flag):  # near-miss: finally runs on every exit
+    fh = open(path, "rb")
+    try:
+        if flag:
+            return None
+        return fh.read()
+    finally:
+        fh.close()

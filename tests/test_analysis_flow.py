@@ -599,9 +599,11 @@ class TestTimingsSurface:
         doc = json.loads(capsys.readouterr().out)
         t = doc["timings_ms"]
         assert "parse" in t and "total" in t and "graph_build" in t
-        for code in ("TNC111", "TNC112", "TNC113"):
+        assert "typestate_build" in t
+        for code in ("TNC111", "TNC112", "TNC113",
+                     "TNC114", "TNC115", "TNC116", "TNC117"):
             assert code in t
-        assert doc["schema"] == 2
+        assert doc["schema"] == 3
 
     def test_human_output_has_timing_line(self, capsys):
         from tpu_node_checker.analysis.__main__ import main

@@ -1,0 +1,530 @@
+"""Typestate-tier tests (TNC114-117): the exception-escape fixpoint,
+the release-obligation interpreter, the obligation-transfer matrix,
+full-vs-incremental equivalence, and the SARIF surface."""
+
+import json
+from pathlib import Path
+
+# Import the registry package FIRST: analysis/rules/__init__.py imports
+# flow/rules.py which imports typestate.py back — importing typestate (or
+# flow.rules) as the very first analysis import trips that cycle.
+import tpu_node_checker.analysis.rules  # noqa: F401
+
+from tpu_node_checker.analysis.cache import run_incremental
+from tpu_node_checker.analysis.engine import load_project, run_project
+from tpu_node_checker.analysis.flow.typestate import (
+    AtomicWrite,
+    ExceptionEscape,
+    FinallyHygiene,
+    MustRelease,
+    covers,
+    typestate_state,
+)
+from tpu_node_checker.analysis.sarif import SARIF_VERSION, render_sarif
+
+CORPUS_ROOT = Path(__file__).resolve().parent / "analysis_fixtures" / "repo"
+
+TYPESTATE_CODES = ("TNC114", "TNC115", "TNC116", "TNC117")
+
+
+def _mini(tmp_path, files):
+    """Write a miniature checkout; returns its root as str."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    (tmp_path / "tpu_node_checker").mkdir(exist_ok=True)
+    init = tmp_path / "tpu_node_checker" / "__init__.py"
+    if not init.exists():
+        init.write_text("")
+    return str(tmp_path)
+
+
+def _escapes(root):
+    project = load_project(root)
+    return project, typestate_state(project).escapes
+
+
+def _rule_findings(rule, root):
+    return sorted(
+        (f.path, f.line) for f in rule.check_project(load_project(root))
+    )
+
+
+# -- the exception lattice -------------------------------------------------
+
+
+class TestCovers:
+    def test_builtin_ancestry(self):
+        assert covers("OSError", "ConnectionResetError", {})
+        assert covers("Exception", "KeyError", {})
+        assert covers("BaseException", "KeyboardInterrupt", {})
+
+    def test_siblings_do_not_cover(self):
+        assert not covers("ValueError", "OSError", {})
+        # Exception does NOT cover the BaseException-only branch.
+        assert not covers("Exception", "SystemExit", {})
+
+    def test_unknown_class_assumed_exception_child(self):
+        # http.client.BadStatusLine isn't in the builtin table and isn't
+        # a project class — the lattice parks it under Exception so a
+        # catch-all handler subtracts it (documented soundness caveat).
+        assert covers("Exception", "BadStatusLine", {})
+        assert not covers("OSError", "BadStatusLine", {})
+
+    def test_project_class_chain(self):
+        parents = {"ShardError": {"OSError"}, "FleetError": {"ShardError"}}
+        assert covers("OSError", "FleetError", parents)
+        assert not covers("ValueError", "FleetError", parents)
+
+
+# -- the escape-set fixpoint ----------------------------------------------
+
+
+ESCAPE_SRC = '''\
+import threading
+
+
+def helper():
+    raise ValueError("boom")
+
+
+def worker():
+    helper()
+
+
+def guarded():
+    try:
+        helper()
+    except ValueError:
+        pass
+
+
+def reraiser():
+    try:
+        helper()
+    except ValueError:
+        raise
+
+
+def parent_handler():
+    try:
+        raise ConnectionResetError("gone")
+    except OSError:
+        pass
+
+
+def dyn(obj):
+    obj.frobnicate()
+
+
+def spawn():
+    threading.Thread(target=worker, name="w", daemon=True).start()
+
+
+class Widget:
+    def frobnicate(self):
+        return 1
+'''
+
+
+class TestEscapeFixpoint:
+    MOD = "tpu_node_checker/escmod.py"
+
+    def _esc(self, tmp_path, name):
+        _, escapes = _escapes(_mini(tmp_path, {self.MOD: ESCAPE_SRC}))
+        return set(escapes.get(f"{self.MOD}::{name}", frozenset()))
+
+    def test_escape_propagates_through_callee(self, tmp_path):
+        assert self._esc(tmp_path, "worker") == {"ValueError"}
+
+    def test_handler_subtracts(self, tmp_path):
+        assert self._esc(tmp_path, "guarded") == set()
+
+    def test_bare_reraise_keeps_the_class(self, tmp_path):
+        assert self._esc(tmp_path, "reraiser") == {"ValueError"}
+
+    def test_parent_handler_covers_child(self, tmp_path):
+        assert self._esc(tmp_path, "parent_handler") == set()
+
+    def test_dynamic_dispatch_widens_to_exception(self, tmp_path):
+        # .frobnicate() on an unknown receiver dispatch-falls-back onto
+        # Widget.frobnicate — the fixpoint widens the call to Exception
+        # rather than trusting any one candidate's summary.
+        assert "Exception" in self._esc(tmp_path, "dyn")
+
+
+# -- TNC114: the rule on top of the fixpoint -------------------------------
+
+
+class TestExceptionEscapeRule:
+    def test_doomed_thread_entry_flagged_at_def(self, tmp_path):
+        root = _mini(tmp_path, {"tpu_node_checker/escmod.py": ESCAPE_SRC})
+        assert _rule_findings(ExceptionEscape(), root) == [
+            ("tpu_node_checker/escmod.py", 8)  # def worker
+        ]
+
+    def test_recording_worker_is_clean(self, tmp_path):
+        src = (
+            "import threading\n"
+            "_DEATHS: list = []\n\n\n"
+            "def worker():\n"
+            "    try:\n"
+            "        raise RuntimeError('x')\n"
+            "    except Exception as exc:\n"
+            "        _DEATHS.append(str(exc))\n\n\n"
+            "def spawn():\n"
+            "    threading.Thread(target=worker, name='w',\n"
+            "                     daemon=True).start()\n"
+        )
+        root = _mini(tmp_path, {"tpu_node_checker/okmod.py": src})
+        assert _rule_findings(ExceptionEscape(), root) == []
+
+    def test_cli_main_may_only_raise_systemexit(self, tmp_path):
+        bad = (
+            "def main(argv=None):\n"
+            "    raise ValueError('unhandled')\n"
+        )
+        root = _mini(tmp_path, {"tpu_node_checker/cli.py": bad})
+        assert _rule_findings(ExceptionEscape(), root) == [
+            ("tpu_node_checker/cli.py", 1)
+        ]
+
+    def test_cli_main_systemexit_is_sanctioned(self, tmp_path):
+        ok = (
+            "def main(argv=None):\n"
+            "    raise SystemExit(2)\n"
+        )
+        root = _mini(tmp_path, {"tpu_node_checker/cli.py": ok})
+        assert _rule_findings(ExceptionEscape(), root) == []
+
+
+# -- TNC115/TNC117: the obligation interpreter -----------------------------
+
+
+OBL_SRC = '''\
+import socket
+
+
+def leak():
+    s = socket.socket()
+    s.connect(("h", 1))
+
+
+def branch_leak(flag):
+    s = socket.socket()
+    if flag:
+        s.close()
+
+
+def both_branches(flag):
+    s = socket.socket()
+    if flag:
+        s.close()
+    else:
+        s.close()
+
+
+def managed():
+    with socket.socket() as s:
+        s.connect(("h", 1))
+
+
+def try_finally(flag):
+    s = socket.socket()
+    try:
+        if flag:
+            raise OSError("x")
+    finally:
+        s.close()
+
+
+def may_raise():
+    raise OSError("x")
+
+
+def exc_path():
+    s = socket.socket()
+    may_raise()
+    s.close()
+'''
+
+TRANSFER_SRC = '''\
+import socket
+
+_POOL: list = []
+
+
+def minted():
+    s = socket.socket()
+    return s
+
+
+class Box:
+    def adopt(self):
+        self.s = socket.socket()
+
+
+def closer(conn):
+    conn.close()
+
+
+def handoff():
+    s = socket.socket()
+    closer(s)
+
+
+def sunk():
+    s = socket.socket()
+    _POOL.append(s)
+
+
+def laundered(harness):
+    s = socket.socket()
+    harness.launder(s)
+
+
+def alias_close():
+    s = socket.socket()
+    t = s
+    t.close()
+'''
+
+SKIP_SRC = '''\
+def early(path, flag):
+    fh = open(path, "rb")
+    if flag:
+        return None
+    data = fh.read()
+    fh.close()
+    return data
+
+
+def finally_closed(path, flag):
+    fh = open(path, "rb")
+    try:
+        if flag:
+            return None
+        return fh.read()
+    finally:
+        fh.close()
+'''
+
+
+class TestMustRelease:
+    def test_leaks_and_joins(self, tmp_path):
+        root = _mini(tmp_path, {"tpu_node_checker/oblmod.py": OBL_SRC})
+        assert _rule_findings(MustRelease(), root) == [
+            ("tpu_node_checker/oblmod.py", 5),  # leak
+            ("tpu_node_checker/oblmod.py", 10),  # branch_leak: join is OPEN
+            ("tpu_node_checker/oblmod.py", 42),  # exc_path: raise skips close
+        ]
+
+    def test_exception_path_message_names_the_path(self, tmp_path):
+        root = _mini(tmp_path, {"tpu_node_checker/oblmod.py": OBL_SRC})
+        msgs = {
+            f.line: f.message
+            for f in MustRelease().check_project(load_project(root))
+        }
+        assert "exception path" in msgs[42]
+        assert "normal path" in msgs[5]
+
+    def test_transfer_matrix_is_all_clean(self, tmp_path):
+        # return / store-into-self / releasing-callee / sink-method /
+        # unknown-callee benefit-of-doubt / alias move: obligation leaves.
+        root = _mini(tmp_path, {"tpu_node_checker/xfer.py": TRANSFER_SRC})
+        assert _rule_findings(MustRelease(), root) == []
+
+
+class TestFinallyHygiene:
+    def test_early_return_reported_at_skip_site(self, tmp_path):
+        root = _mini(tmp_path, {"tpu_node_checker/skipmod.py": SKIP_SRC})
+        assert _rule_findings(FinallyHygiene(), root) == [
+            ("tpu_node_checker/skipmod.py", 4)  # the `return None`
+        ]
+        # ...and TNC115 does NOT double-report the same obligation.
+        assert _rule_findings(MustRelease(), root) == []
+
+
+# -- TNC116: atomic writes in torn-tolerant store modules ------------------
+
+
+STORE_SRC = '''\
+import json
+import os
+
+
+def read_jsonl_tolerant(path):
+    out = []
+    try:
+        with open(path, "rb") as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        return out
+    return out
+
+
+def torn(path, rows):
+    with open(path, "w") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\\n")
+
+
+def atomic(path, rows):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\\n")
+    os.replace(tmp, path)
+
+
+def append_only(path, row):
+    with open(path, "a") as fh:
+        fh.write(json.dumps(row) + "\\n")
+
+
+def load(path):  # the loader CALL is what marks this module a store
+    return read_jsonl_tolerant(path)
+'''
+
+PLAIN_SRC = '''\
+def overwrite(path, text):
+    with open(path, "w") as fh:
+        fh.write(text)
+'''
+
+
+class TestAtomicWrite:
+    def test_torn_overwrite_flagged_in_store_module(self, tmp_path):
+        root = _mini(tmp_path, {
+            "tpu_node_checker/store.py": STORE_SRC,
+            "tpu_node_checker/plain.py": PLAIN_SRC,
+        })
+        # Only the store module's torn write fires: the tmp+os.replace
+        # shape and append mode are sanctioned, and plain.py (no
+        # torn-tolerant loader in sight) is out of scope entirely.
+        assert _rule_findings(AtomicWrite(), root) == [
+            ("tpu_node_checker/store.py", 20)
+        ]
+
+
+# -- full vs incremental equivalence ---------------------------------------
+
+
+class TestFullIncrementalEquivalence:
+    FILES = {
+        "tpu_node_checker/escmod.py": ESCAPE_SRC,
+        "tpu_node_checker/oblmod.py": OBL_SRC,
+        "tpu_node_checker/skipmod.py": SKIP_SRC,
+        "tpu_node_checker/store.py": STORE_SRC,
+    }
+
+    @staticmethod
+    def _typestate(report):
+        return sorted(
+            (f.code, f.path, f.line)
+            for f in report.findings if f.code in TYPESTATE_CODES
+        )
+
+    def test_cold_warm_and_touched_runs_match_full(self, tmp_path):
+        root = _mini(tmp_path, self.FILES)
+        cache = str(tmp_path / "lint-cache.json")
+        full = self._typestate(run_project(root))
+        assert full  # all four rules have material in this checkout
+
+        cold = run_incremental(root, cache_path=cache)
+        assert self._typestate(cold) == full
+
+        warm = run_incremental(root, cache_path=cache)
+        assert self._typestate(warm) == full
+        assert warm.cached_files > 0  # replayed, not re-scanned
+
+        # Fix one leak; the slices must re-run enough to notice.
+        mod = Path(root) / "tpu_node_checker" / "oblmod.py"
+        mod.write_text(mod.read_text().replace(
+            's.connect(("h", 1))\n\n\ndef branch_leak',
+            's.connect(("h", 1))\n    s.close()\n\n\ndef branch_leak',
+            1,
+        ))
+        after_full = self._typestate(run_project(root))
+        after_inc = self._typestate(run_incremental(root, cache_path=cache))
+        assert after_inc == after_full
+        assert len(after_full) == len(full) - 1
+
+
+# -- the SARIF surface -----------------------------------------------------
+
+
+class TestSarif:
+    def test_corpus_sarif_shape(self):
+        report = run_project(str(CORPUS_ROOT))
+        doc = json.loads(render_sarif(report))
+        assert doc["version"] == SARIF_VERSION == "2.1.0"
+        assert doc["$schema"].endswith("sarif-2.1.0.json")
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "tnc-lint"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        assert set(TYPESTATE_CODES) <= rule_ids
+
+        results = run["results"]
+        assert len(results) == len(report.findings) + len(report.suppressed)
+        seen_codes = {r["ruleId"] for r in results}
+        assert set(TYPESTATE_CODES) <= seen_codes
+        for res in results:
+            (loc,) = res["locations"]
+            region = loc["physicalLocation"]["region"]
+            assert region["startLine"] >= 1
+            assert region["startColumn"] >= 1  # SARIF columns are 1-based
+
+    def test_suppressed_findings_carry_in_source_status(self):
+        report = run_project(str(CORPUS_ROOT))
+        doc = json.loads(render_sarif(report))
+        results = doc["runs"][0]["results"]
+        suppressed = [r for r in results if r.get("suppressions")]
+        assert len(suppressed) == len(report.suppressed)
+        assert all(
+            s["kind"] == "inSource"
+            for r in suppressed for s in r["suppressions"]
+        )
+
+
+# -- suppression accounting (the stacked-waiver bugfix) --------------------
+
+
+class TestStackedWaivers:
+    def test_corpus_stacked_waivers_both_count_as_used(self):
+        # lifecycle.sanctioned_probe carries a standalone waiver on the
+        # line above AND a same-line waiver for the same rule.  Before
+        # the (line, rule) multimap fix the standalone one shadowed the
+        # same-line one in the lookup dict and was reported stale.
+        report = run_project(str(CORPUS_ROOT))
+        assert not [
+            u for u in report.unused_suppressions
+            if u["path"] == "tpu_node_checker/lifecycle.py"
+        ]
+        assert ("tpu_node_checker/lifecycle.py", "TNC115") in {
+            (f.path, f.code) for f in report.suppressed
+        }
+
+    def test_mini_stacked_waivers(self, tmp_path):
+        src = (
+            "import socket\n\n\n"
+            "def probe():\n"
+            "    # tnc: allow-must-release(harness owns the fd)\n"
+            "    s = socket.socket()  "
+            "# tnc: allow-must-release(double account)\n"
+            "    s.connect(('h', 1))\n"
+        )
+        root = _mini(tmp_path, {"tpu_node_checker/probe.py": src})
+        report = run_project(root)
+        assert not [f for f in report.findings if f.code == "TNC115"]
+        assert [f for f in report.suppressed if f.code == "TNC115"]
+        assert not [
+            u for u in report.unused_suppressions
+            if u["path"] == "tpu_node_checker/probe.py"
+        ]

@@ -1466,7 +1466,13 @@ class TestGlobalAnalytics:
                 with client._lock:
                     if "analytics_slo" in client._blocks:
                         break
-                assert time.perf_counter() < deadline, "block never arrived"
+                assert time.perf_counter() < deadline, (
+                    "block never arrived",
+                    client.exit_reason(),
+                    client.thread.is_alive(),
+                    client.stats(),
+                    dict(client._blocks),
+                )
                 time.sleep(0.01)  # tnc: allow-test-wall-clock(bounded 10s wait for a REAL pushed analytics_slo frame)
             before = dict(servers["us-a"].stats.requests)
             snap = engine.round()

@@ -85,10 +85,12 @@ def _req(port, path, headers=None):
         conn.close()
 
 
-def _watch(port, since="", timeout=None, headers=None):
+def _watch(port, since="", timeout=None, headers=None, rev=None):
     query = {"since": since} if since else {}
     if timeout is not None:
         query["timeout"] = f"{timeout:g}"
+    if rev is not None:
+        query["rev"] = str(rev)
     path = "/api/v1/watch"
     if query:
         path += "?" + urllib.parse.urlencode(query)
@@ -354,6 +356,36 @@ class TestWatchFrames:
         server.publish_remediation(None)
         _, _, frame = _watch(server.port, since=cursor, timeout=0.05)
         assert "remediation" not in frame["blocks"]
+
+    def test_stale_rev_answers_immediately_never_parks(self, server):
+        """A consumer that was BETWEEN polls when a blocks-only update
+        fired must not sit out a long-poll window to learn about it: its
+        next poll echoes the rev of its last frame, the server sees the
+        mismatch, and answers an immediate entry-less heartbeat carrying
+        the current blocks — blocks stay at delta speed on BOTH sides of
+        the park."""
+        server.publish(_Round(_payload()))
+        first = _watch(server.port)[2]
+        cursor, rev = first["to"], first["rev"]
+        # Current rev + current cursor still parks (tiny window → heartbeat).
+        _, _, frame = _watch(server.port, since=cursor, timeout=0.05, rev=rev)
+        assert frame["kind"] == "heartbeat" and frame["rev"] == rev
+        server.publish_analytics({"slo": {"ready_p50": 0.5}})
+        t0 = time.monotonic()
+        _, _, frame = _watch(server.port, since=cursor, timeout=20, rev=rev)
+        assert time.monotonic() - t0 < 5.0, "stale-rev poll parked"
+        assert frame["kind"] == "heartbeat"
+        assert frame["from"] == cursor and frame["to"] == cursor
+        assert frame["nodes"] == []
+        assert frame["blocks"]["analytics_slo"] == {"ready_p50": 0.5}
+        assert frame["rev"] > rev
+
+    def test_bad_rev_param_is_a_400(self, server):
+        server.publish(_Round(_payload()))
+        cursor = _watch(server.port)[2]["to"]
+        status, _, _ = _watch(server.port, since=cursor, timeout=0.05,
+                              rev="new")
+        assert status == 400
 
     def test_gzip_negotiated_frame_decompresses_identical(self, server):
         server.publish(_Round(_payload(n=64)))

@@ -40,8 +40,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="repository checkout to analyze (default: current directory)",
     )
     parser.add_argument(
-        "--format", choices=("human", "json"), default="human",
-        help="output format (json: stable schema for CI artifacts)",
+        "--format", choices=("human", "json", "sarif"), default="human",
+        help="output format (json: stable schema for CI artifacts; "
+        "sarif: SARIF 2.1.0 for forge annotation upload)",
     )
     parser.add_argument(
         "--rule", action="append", metavar="SLUG",
@@ -121,7 +122,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("tnc-lint: internal error — a rule crashed; this is a linter "
               "bug, not a finding", file=sys.stderr)
         return EXIT_INTERNAL
-    print(render_json(report) if args.format == "json" else render_human(report))
+    if args.format == "json":
+        print(render_json(report))
+    elif args.format == "sarif":
+        from tpu_node_checker.analysis.sarif import render_sarif
+
+        print(render_sarif(report))
+    else:
+        print(render_human(report))
     return EXIT_FINDINGS if report.findings else EXIT_CLEAN
 
 

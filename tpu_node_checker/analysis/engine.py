@@ -48,7 +48,11 @@ _EXCLUDE_PARTS = ("__pycache__", "analysis_fixtures")
 
 # v2: adds top-level ``timings_ms`` (parse, graph_build, per-rule, total)
 # — additive, but versioned so CI artifact consumers can tell.
-JSON_SCHEMA_VERSION = 2
+# v3: the typestate tier — TNC114–117 rule codes appear in findings and
+# ``timings_ms`` (incl. the "typestate_build" phase); a SARIF 2.1.0
+# surface exists alongside (--format sarif), versioned by its own
+# $schema, not by this number.
+JSON_SCHEMA_VERSION = 3
 
 
 @dataclass(frozen=True)
@@ -203,18 +207,25 @@ def extract_suppressions(source: str) -> Tuple[List[Suppression], List[Finding]]
 def _apply_suppressions(
     ctx: FileContext, findings: List[Finding]
 ) -> Tuple[List[Finding], List[Finding]]:
-    """Split one file's rule findings into (active, suppressed)."""
-    by_key: Dict[Tuple[int, str], Suppression] = {}
+    """Split one file's rule findings into (active, suppressed).
+
+    ``by_key`` is a multimap: a standalone waiver above a line AND a
+    same-line waiver for the same rule can both cover one finding, and
+    each is an independent (rule, file, line) account — marking only one
+    ``used`` would report the other as spuriously unused.
+    """
+    by_key: Dict[Tuple[int, str], List[Suppression]] = {}
     for sup in ctx.suppressions:
-        by_key[(sup.line, sup.rule)] = sup
+        by_key.setdefault((sup.line, sup.rule), []).append(sup)
         if sup.standalone:
-            by_key.setdefault((sup.line + 1, sup.rule), sup)
+            by_key.setdefault((sup.line + 1, sup.rule), []).append(sup)
     active: List[Finding] = []
     suppressed: List[Finding] = []
     for finding in findings:
-        sup = by_key.get((finding.line, finding.rule))
-        if sup is not None:
-            sup.used = True
+        sups = by_key.get((finding.line, finding.rule))
+        if sups:
+            for sup in sups:
+                sup.used = True
             suppressed.append(finding)
         else:
             active.append(finding)
@@ -380,7 +391,9 @@ def run_project_rules(project: Project, wanted: Optional[set],
     from tpu_node_checker.analysis.rules import PROJECT_RULES
 
     out: Dict[str, List[Finding]] = {}
-    prev_build = 0.0
+    prev_build = {"_flow_state": 0.0, "_typestate_state": 0.0}
+    phase_key = {"_flow_state": "graph_build",
+                 "_typestate_state": "typestate_build"}
     for rule in PROJECT_RULES:
         if wanted is not None and rule.slug not in wanted:
             continue
@@ -390,12 +403,13 @@ def run_project_rules(project: Project, wanted: Optional[set],
         out[rule.code] = list(rule.check_project(project))
         elapsed = (time.perf_counter() - t0) * 1e3
         if timings is not None:
-            state = getattr(project, "_flow_state", None)
-            build = state.build_ms if state is not None else 0.0
-            if build != prev_build:  # this rule triggered the graph build
-                timings["graph_build"] = build
-                elapsed = max(0.0, elapsed - (build - prev_build))
-                prev_build = build
+            for attr, phase in phase_key.items():
+                state = getattr(project, attr, None)
+                build = state.build_ms if state is not None else 0.0
+                if build != prev_build[attr]:  # this rule triggered it
+                    timings[phase] = build
+                    elapsed = max(0.0, elapsed - (build - prev_build[attr]))
+                    prev_build[attr] = build
             timings[rule.code] = timings.get(rule.code, 0.0) + elapsed
     return out
 
@@ -481,12 +495,14 @@ def render_human(report: Report) -> str:
     t = report.timings_ms
     if t:
         phases = ", ".join(
-            f"{key} {t[key]:.0f}ms" for key in ("parse", "graph_build")
+            f"{key} {t[key]:.0f}ms"
+            for key in ("parse", "graph_build", "typestate_build")
             if key in t
         )
         rules = sorted(
             ((k, v) for k, v in t.items()
-             if k not in ("parse", "graph_build", "total")),
+             if k not in ("parse", "graph_build", "typestate_build",
+                          "total")),
             key=lambda kv: -kv[1],
         )[:3]
         slowest = ", ".join(f"{k} {v:.0f}ms" for k, v in rules)

@@ -581,4 +581,9 @@ def _internals_of(expr: ast.AST, name: str) -> Optional[str]:
     return None
 
 
-RULES: List[Rule] = [TransitiveBlocking(), LocksetRace(), SnapshotEscape()]
+from tpu_node_checker.analysis.flow.typestate import (  # noqa: E402
+    TYPESTATE_RULES,
+)
+
+RULES: List[Rule] = [TransitiveBlocking(), LocksetRace(), SnapshotEscape(),
+                     *TYPESTATE_RULES]

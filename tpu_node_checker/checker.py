@@ -508,6 +508,7 @@ def _attach_node_events(
 
     paged_fetch = getattr(client, "list_node_events_paged", None)
 
+    # tnc: allow-exception-escape(bounded_map CAPTURES a worker's exception as its (False, exc) outcome — every raise becomes a per-node stderr note and errors entry below, never a silent death)
     def _fetch(n):
         # Drop-in clients without the truncation-aware walk still attach
         # events; they just cannot report a capped walk.

@@ -117,6 +117,11 @@ class _FeedClient:
         self._stop = threading.Event()
         self._exit_reason: Optional[str] = None
         self._cursor = ""
+        # Last feed revision a frame stamped; -1 (never matches a real
+        # rev) until the first frame lands, so a stream that opens AFTER a
+        # blocks-only publish gets an immediate catch-up heartbeat instead
+        # of parking a full window behind the update it never saw.
+        self._rev_seen = -1
         self._key = view.entries_key
         self._fragments: Optional[Dict[str, bytes]] = None
         self._head: Optional[dict] = None
@@ -211,8 +216,10 @@ class _FeedClient:
             while not self._stop.is_set():
                 with self._lock:
                     cursor = self._cursor
+                    rev_seen = self._rev_seen
                 query = urllib.parse.urlencode(
-                    {"since": cursor, "timeout": f"{self._poll_timeout:g}"}
+                    {"since": cursor, "timeout": f"{self._poll_timeout:g}",
+                     "rev": str(rev_seen)}
                 )
                 resp = self._session.get(
                     f"{self.url}/api/v1/watch?{query}",
@@ -258,6 +265,9 @@ class _FeedClient:
                 self._resyncs[reason] = self._resyncs.get(reason, 0) + 1
             if isinstance(blocks, dict):
                 self._blocks = blocks
+            rev = frame.get("rev")
+            if isinstance(rev, int) and not isinstance(rev, bool):
+                self._rev_seen = rev
         if kind == "heartbeat":
             with self._lock:
                 self._frames["heartbeat"] += 1
@@ -675,6 +685,7 @@ class FederationEngine:
         except Exception:  # tnc: allow-broad-except(trace stitching is best-effort telemetry; a failed debug fetch must never degrade the shard that just fetched fine)
             return
 
+    # tnc: allow-exception-escape(every concrete fetch failure is caught inside _fetch_cluster's catch-all and recorded on the cluster view (record_failure + breaker); the residual escape set is dispatch widening on in-process stats/view record() calls that do not raise)
     def _fetch_shard(self, slot: int, names: List[str], tracer) -> None:
         session = self._session(slot)
         for name in names:

@@ -90,6 +90,7 @@ def drain_node(
         return True, detail
     from tpu_node_checker.utils.fanout import bounded_map
 
+    # tnc: allow-exception-escape(bounded_map CAPTURES a worker's exception as its (False, exc) outcome — a refused eviction becomes the per-pod PDB/budget accounting below, never a silent death)
     def _evict_one(pod):
         meta = pod.get("metadata") or {}
         actuate.evict(

@@ -136,23 +136,25 @@ def _fire_cmd(cmd: str, env_extra: Dict[str, str]) -> None:
 
 
 def _fire_webhook(url: str, body: dict, session=None) -> None:
-    if session is None:
-        from tpu_node_checker.cluster import _StdlibSession
+    if session is not None:  # caller-owned: its lifetime is the caller's
+        _post_webhook(session, url, body)
+        return
+    from tpu_node_checker.cluster import _StdlibSession
 
-        session = _StdlibSession()
-        owns = True
-    else:
-        owns = False
+    session = _StdlibSession()
     try:
-        resp = session.post(
-            url, data=json.dumps(body),
-            headers={"Content-Type": "application/json"},
-            timeout=REPAIR_WEBHOOK_TIMEOUT_S,
-        )
-        resp.raise_for_status()
+        _post_webhook(session, url, body)
     finally:
-        if owns:
-            session.close()
+        session.close()
+
+
+def _post_webhook(session, url: str, body: dict) -> None:
+    resp = session.post(
+        url, data=json.dumps(body),
+        headers={"Content-Type": "application/json"},
+        timeout=REPAIR_WEBHOOK_TIMEOUT_S,
+    )
+    resp.raise_for_status()
 
 
 def run_repairs(
@@ -228,6 +230,7 @@ def run_repairs(
     if to_fire:
         from tpu_node_checker.utils.fanout import bounded_map
 
+        # tnc: allow-exception-escape(bounded_map CAPTURES a worker's exception as its (False, exc) outcome — a failed hook becomes tracker.mark_failed + a report entry below, never a silent death)
         def _fire(item):
             n, decision, reason = item
             if cmd:

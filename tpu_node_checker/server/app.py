@@ -824,8 +824,8 @@ class FleetStateServer:
         )
 
     def _get_watch(self, req: Request) -> Response:
-        """``GET /api/v1/watch?since=<ETag>[&timeout=s]`` — ONE feed frame
-        per request (see :mod:`~tpu_node_checker.server.feed`).
+        """``GET /api/v1/watch?since=<ETag>[&timeout=s][&rev=n]`` — ONE
+        feed frame per request (see :mod:`~tpu_node_checker.server.feed`).
 
         The one deliberately blocking read path: the request thread parks
         until the state moves past ``since`` or the window closes.  It can
@@ -849,7 +849,18 @@ class FleetStateServer:
             return json_response(
                 400, {"error": f"bad timeout {raw_wait!r}: must be seconds"}
             )
-        entity = feed.frame(since, min(max(wait, 0.0), _WATCH_MAX_WAIT_S))
+        raw_rev = req.query.get("rev")
+        rev = None
+        if raw_rev is not None:
+            try:
+                rev = int(raw_rev)
+            except ValueError:
+                return json_response(
+                    400, {"error": f"bad rev {raw_rev!r}: must be an integer"}
+                )
+        entity = feed.frame(
+            since, min(max(wait, 0.0), _WATCH_MAX_WAIT_S), rev
+        )
         if entity is None:
             return self._no_round()
         return negotiate(entity, req.headers)

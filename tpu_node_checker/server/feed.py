@@ -3,7 +3,7 @@
 The federation tier's version of the watch-over-relist move PR 6 made
 against the k8s API, applied to our own wire.  A consumer long-polls
 
-    GET /api/v1/watch?since=<ETag>[&timeout=<seconds>]
+    GET /api/v1/watch?since=<ETag>[&timeout=<seconds>][&rev=<n>]
 
 and receives exactly ONE JSON frame per request:
 
@@ -20,6 +20,15 @@ and receives exactly ONE JSON frame per request:
   frame, and the consumer needs no second code path.
 * ``heartbeat`` — nothing moved within the long-poll window: an
   entry-less frame proving liveness (and refreshing the named blocks).
+
+Every frame also stamps ``rev`` — the feed's internal state revision,
+which advances on blocks-only updates the collection ETag cannot see.  A
+consumer that echoes its last seen ``rev`` never parks behind a blocks
+update it missed: a poll whose cursor matches the collection but whose
+``rev`` is stale answers immediately (an entry-less heartbeat carrying
+the current blocks) instead of sitting out a full long-poll window.
+Consumers that omit ``rev`` get the legacy behavior — blocks updates
+reach them on the wake-up if parked, else on the window's heartbeat.
 
 Frames are built from the same per-entry byte fragments the snapshot /
 merge tiers cache (:func:`~tpu_node_checker.server.snapshot
@@ -207,21 +216,29 @@ class FeedState:
         with self._cond:
             return dict(self._frames_served), dict(self._resyncs)
 
-    def frame(self, since: str, wait: float) -> Optional[Entity]:
+    def frame(self, since: str, wait: float,
+              rev: Optional[int] = None) -> Optional[Entity]:
         """One watch request → one frame Entity (None = no feed state yet:
         the handler answers the same 503 the collection endpoints do).
 
         Parks up to ``wait`` seconds only when ``since`` IS the current
-        cursor; any other cursor answers immediately (delta when the ring
-        still chains from it, full resync otherwise — never a 404).
+        cursor AND the consumer's ``rev`` (when it sent one) is current;
+        any other cursor answers immediately (delta when the ring still
+        chains from it, full resync otherwise — never a 404).  A current
+        cursor with a stale ``rev`` means the consumer missed a
+        blocks-only update between polls: it answers an immediate
+        entry-less heartbeat carrying the current blocks, never a park —
+        blocks stay at delta speed even for a consumer that was between
+        polls when the publisher fired.
         """
         kind = None
         reason = None
         changed_set: FrozenSet[str] = frozenset()
         removed_set: FrozenSet[str] = frozenset()
         with self._cond:
+            stale_rev = rev is not None and rev != self._rev
             if since and self._etag is not None and since == self._etag \
-                    and not self._closed:
+                    and not self._closed and not stale_rev:
                 start_rev = self._rev
                 deadline = time.monotonic() + max(wait, 0.0)
                 while not self._closed and self._rev == start_rev:
@@ -237,9 +254,14 @@ class FeedState:
                 if not since:
                     kind, reason = "resync", "requested"
                 elif since == self._etag:
-                    # Woken by a blocks-only update (or an aggregator
-                    # steady publish): from == to, no entries.
-                    kind = "delta"
+                    # from == to, no entries: a PARKED consumer woken by a
+                    # blocks-only update (or an aggregator steady publish)
+                    # counts as a delta; a stale-rev consumer that polled
+                    # AFTER the update skipped the park and answers an
+                    # immediate heartbeat — delta/resync counters move
+                    # identically whichever side of the park the update
+                    # landed on.
+                    kind = "heartbeat" if stale_rev else "delta"
                 else:
                     fold = self._fold(since)
                     if fold is None:
@@ -253,6 +275,7 @@ class FeedState:
             etag, seq, ts = self._etag, self._seq, self._ts
             head, key = self._head, self._key
             fragments, gz, blocks = self._fragments, self._gz, self._blocks
+            rev_now = self._rev
         # -- frame assembly, outside the lock --------------------------------
         if kind == "resync":
             names = list(fragments)
@@ -271,6 +294,7 @@ class FeedState:
             "head": head,
             "removed": sorted(removed_set),
             "blocks": blocks,
+            "rev": rev_now,
         }
         if reason is not None:
             meta["reason"] = reason

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import http.client
 import socket
+import sys
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -418,6 +419,16 @@ class _Worker:
     def _run_connection(self, conn, addr) -> None:
         try:
             _serve_connection(self.pool.app, conn, addr, self.pool.idle_timeout)
+        except Exception as exc:  # tnc: allow-broad-except(a handler bug must not kill the connection thread silently — the death is recorded with its reason, the socket still closed, and the server keeps serving every other connection)
+            print(
+                f"fleet-server: connection from {addr!r} died: "
+                f"{type(exc).__name__}: {exc}",
+                file=sys.stderr,
+            )
+            try:
+                conn.close()
+            except OSError:
+                pass
         finally:
             with self._conn_lock:
                 self._conns.discard(conn)
